@@ -1,0 +1,47 @@
+"""sheeprl_tpu — a TPU-native (JAX/XLA/pjit/Pallas) reinforcement-learning framework.
+
+A ground-up rebuild of the capabilities of SheepRL (reference: Eclectic-Sheep/sheeprl
+v0.5.5 fork, PyTorch + Lightning Fabric) designed TPU-first:
+
+- every numeric path is a jitted pure function (models are pytrees of params),
+- sequential loops (RSSM, GAE, lambda-returns) are ``lax.scan``,
+- data parallelism and cross-replica reductions are XLA collectives over a
+  ``jax.sharding.Mesh`` (ICI within a slice, DCN across hosts) instead of NCCL,
+- replay buffers are host-side numpy ring buffers with async device prefetch,
+- compute is bf16 on the MXU with fp32 parameters/optimizer state.
+
+Layer map mirrors the reference (see SURVEY.md): config/CLI -> registry ->
+single-file algorithms -> models/ops/data/envs -> fabric (mesh runtime).
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import importlib
+import importlib.util
+import os
+
+# Algorithm modules register themselves via decorators at import time
+# (same mechanism as the reference's sheeprl/__init__.py:18-46). Only modules
+# that exist are imported so the package stays importable while algorithms are
+# added incrementally; a present-but-broken algo module still raises.
+_ALGO_MODULES = (
+    "a2c",
+    "dreamer_v1",
+    "dreamer_v2",
+    "dreamer_v3",
+    "droq",
+    "p2e_dv1",
+    "p2e_dv2",
+    "p2e_dv3",
+    "ppo",
+    "ppo_recurrent",
+    "sac",
+    "sac_ae",
+)
+
+if not os.environ.get("SHEEPRL_TPU_SKIP_ALGO_IMPORTS"):
+    for _name in _ALGO_MODULES:
+        if importlib.util.find_spec(f"sheeprl_tpu.algos.{_name}") is not None:
+            importlib.import_module(f"sheeprl_tpu.algos.{_name}")

@@ -1,0 +1,23 @@
+from sheeprl_tpu.config.compose import (
+    MISSING,
+    ConfigCompositionError,
+    MissingMandatoryValue,
+    compose,
+    get_class,
+    group_options,
+    instantiate,
+    parse_overrides,
+    resolve,
+)
+
+__all__ = [
+    "MISSING",
+    "ConfigCompositionError",
+    "MissingMandatoryValue",
+    "compose",
+    "get_class",
+    "group_options",
+    "instantiate",
+    "parse_overrides",
+    "resolve",
+]

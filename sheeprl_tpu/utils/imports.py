@@ -1,0 +1,36 @@
+"""Optional-dependency gates (reference: sheeprl/utils/imports.py:1-17).
+
+The reference uses lightning's ``RequirementCache``; here a plain importlib
+probe keeps the framework free of heavyweight optional deps at import time.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+
+
+def module_available(name: str) -> bool:
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+_IS_GYMNASIUM_AVAILABLE = module_available("gymnasium")
+_IS_DMC_AVAILABLE = module_available("dm_control")
+_IS_CV2_AVAILABLE = module_available("cv2")
+_IS_MLFLOW_AVAILABLE = module_available("mlflow")
+_IS_TENSORBOARD_AVAILABLE = module_available("tensorboard") or module_available("tensorboardX")
+_IS_CRAFTER_AVAILABLE = module_available("crafter")
+_IS_MINERL_AVAILABLE = module_available("minerl")
+_IS_MINEDOJO_AVAILABLE = module_available("minedojo")
+_IS_DIAMBRA_AVAILABLE = module_available("diambra")
+_IS_SUPER_MARIO_AVAILABLE = module_available("gym_super_mario_bros")
+_IS_ALE_AVAILABLE = module_available("ale_py")
+
+try:
+    import numpy as _np
+
+    _IS_NUMPY_2 = int(_np.__version__.split(".")[0]) >= 2
+except Exception:  # pragma: no cover
+    _IS_NUMPY_2 = False

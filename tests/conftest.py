@@ -1,0 +1,29 @@
+"""Test harness setup (reference analogue: tests/conftest.py).
+
+Runs everything on CPU with 8 virtual XLA devices so mesh/collective code paths
+are exercised without TPU hardware — the JAX equivalent of the reference's
+2-process gloo trick (SURVEY.md §4.2).  Must run before jax initializes.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_logdir(tmp_path):
+    return str(tmp_path / "logs")
+
+
+@pytest.fixture(autouse=True)
+def _no_env_leaks():
+    """Fail a test that leaks SHEEPRL_TPU_* env vars (reference conftest.py:20-61)."""
+    before = {k: v for k, v in os.environ.items() if k.startswith("SHEEPRL_TPU")}
+    yield
+    after = {k: v for k, v in os.environ.items() if k.startswith("SHEEPRL_TPU")}
+    assert before == after, f"test leaked env vars: {set(after) ^ set(before)}"

@@ -1,0 +1,158 @@
+import os
+
+import pytest
+
+from sheeprl_tpu.config import (
+    ConfigCompositionError,
+    MissingMandatoryValue,
+    compose,
+    instantiate,
+)
+from sheeprl_tpu.utils.utils import dotdict
+
+
+def _write(root, rel, text):
+    path = os.path.join(root, rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(text)
+
+
+@pytest.fixture()
+def tree(tmp_path):
+    root = str(tmp_path / "configs")
+    _write(
+        root,
+        "config.yaml",
+        """# @package _global_
+defaults:
+  - _self_
+  - algo: base
+  - env: base
+  - exp: ???
+seed: 42
+name: ${algo.name}_${env.id}
+""",
+    )
+    _write(root, "algo/base.yaml", "name: base_algo\nlr: 1e-3\nlayers: [64, 64]\n")
+    _write(root, "algo/other.yaml", "defaults:\n  - base\n  - _self_\nname: other\nlr: 3e-4\n")
+    _write(root, "env/base.yaml", "id: CartPole-v1\nnum_envs: 4\n")
+    _write(
+        root,
+        "exp/demo.yaml",
+        """# @package _global_
+defaults:
+  - override /algo: other
+seed: 7
+extra: ${algo.lr}
+""",
+    )
+    _write(
+        root,
+        "exp/with_pkg.yaml",
+        """# @package _global_
+defaults:
+  - /opt@algo.optimizer: adam
+""",
+    )
+    _write(root, "opt/adam.yaml", "kind: adam\nlr: ${algo.lr}\n")
+    return [root]
+
+
+def test_defaults_and_groups(tree):
+    cfg = compose("config", ["exp=demo"], search_path=tree)
+    assert isinstance(cfg, dotdict)
+    assert cfg.algo.name == "other"
+    assert cfg.algo.lr == 3e-4
+    assert cfg.algo.layers == [64, 64]  # inherited from algo/base through sibling include
+    assert cfg.seed == 7  # exp wins over root (_self_ first)
+    assert cfg.env.id == "CartPole-v1"
+
+
+def test_missing_mandatory_group(tree):
+    with pytest.raises(MissingMandatoryValue):
+        compose("config", [], search_path=tree)
+
+
+def test_interpolation(tree):
+    cfg = compose("config", ["exp=demo"], search_path=tree)
+    assert cfg.name == "other_CartPole-v1"
+    assert cfg.extra == 3e-4
+
+
+def test_value_overrides(tree):
+    cfg = compose("config", ["exp=demo", "algo.lr=0.5", "env.num_envs=16", "+env.new_key=hi", "seed=3"], search_path=tree)
+    assert cfg.algo.lr == 0.5
+    assert cfg.env.num_envs == 16
+    assert cfg.env.new_key == "hi"
+    assert cfg.seed == 3
+
+
+def test_group_reselect_from_cli(tree):
+    cfg = compose("config", ["exp=demo", "algo=base"], search_path=tree)
+    assert cfg.algo.name == "base_algo"
+
+
+def test_deletion_and_bad_override(tree):
+    cfg = compose("config", ["exp=demo", "~env.num_envs"], search_path=tree)
+    assert "num_envs" not in cfg.env
+    with pytest.raises(ConfigCompositionError):
+        compose("config", ["exp=demo", "~does.not.exist"], search_path=tree)
+
+
+def test_typoed_override_errors(tree):
+    with pytest.raises(ConfigCompositionError, match="could not override"):
+        compose("config", ["exp=demo", "envv=gym"], search_path=tree)
+    with pytest.raises(ConfigCompositionError, match="could not override"):
+        compose("config", ["exp=demo", "algo.lrr=0.1"], search_path=tree)
+
+
+def test_delete_through_scalar_errors(tree):
+    with pytest.raises(ConfigCompositionError):
+        compose("config", ["exp=demo", "~seed.x"], search_path=tree)
+
+
+def test_env_resolver(tree, tmp_path, monkeypatch):
+    root = str(tmp_path / "c2")
+    _write(root, "config.yaml", "a: ${env:SHEEPRL_TPU_TEST_VAR}\nb: ${env:SHEEPRL_TPU_TEST_MISSING,fallback}\n")
+    monkeypatch.setenv("SHEEPRL_TPU_TEST_VAR", "hello")
+    cfg = compose("config", [], search_path=[root])
+    assert cfg.a == "hello"
+    assert cfg.b == "fallback"
+    monkeypatch.delenv("SHEEPRL_TPU_TEST_VAR")
+    with pytest.raises(ConfigCompositionError, match="not set"):
+        compose("config", [], search_path=[root])
+
+
+def test_missing_inside_list(tree, tmp_path):
+    root = str(tmp_path / "c3")
+    _write(root, "config.yaml", "items:\n  - ???\n")
+    with pytest.raises(ConfigCompositionError):
+        compose("config", [], search_path=[root])
+
+
+def test_package_directive(tree):
+    cfg = compose("config", ["exp=with_pkg"], search_path=tree)
+    assert cfg.algo.optimizer.kind == "adam"
+    assert cfg.algo.optimizer.lr == 1e-3
+
+
+def test_unknown_group_option_lists_alternatives(tree):
+    with pytest.raises(ConfigCompositionError, match="demo"):
+        compose("config", ["exp=nope"], search_path=tree)
+
+
+def test_instantiate():
+    obj = instantiate({"_target_": "collections.OrderedDict", "a": 1})
+    assert dict(obj) == {"a": 1}
+    part = instantiate({"_target_": "collections.OrderedDict", "_partial_": True, "a": 1})
+    assert dict(part(b=2)) == {"a": 1, "b": 2}
+    nested = instantiate({"_target_": "collections.OrderedDict", "inner": {"_target_": "collections.OrderedDict", "x": 2}})
+    assert dict(nested["inner"]) == {"x": 2}
+
+
+def test_builtin_tree_composes():
+    cfg = compose("config", ["exp=default", "algo.name=x", "algo.total_steps=1", "algo.per_rank_batch_size=1", "env.id=e", "env.wrapper=w", "buffer.size=8"])
+    assert cfg.exp_name == "x_e"
+    assert cfg.metric.logger._target_.endswith("TensorBoardLogger")
+    assert cfg.fabric.mesh_axes == ["data"]

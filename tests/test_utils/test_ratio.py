@@ -1,0 +1,51 @@
+from sheeprl_tpu.utils.utils import Ratio, dotdict, polynomial_decay
+
+
+def test_ratio_basic():
+    r = Ratio(0.5)
+    assert r(0) == 1  # first call primes the controller
+    assert r(8) == 4
+    assert r(10) == 1
+
+
+def test_ratio_fractional_carry():
+    r = Ratio(1 / 3)
+    r(0)
+    total = sum(r(s) for s in range(1, 301))
+    assert abs(total - 100) <= 1
+
+
+def test_ratio_zero():
+    r = Ratio(0.0)
+    assert r(100) == 0
+
+
+def test_ratio_state_roundtrip():
+    r = Ratio(0.5)
+    r(0)
+    r(7)
+    state = r.state_dict()
+    r2 = Ratio(0.5).load_state_dict(state)
+    assert r2(11) == r(11)
+
+
+def test_ratio_pretrain():
+    r = Ratio(2.0, pretrain_steps=10)
+    assert r(100) == 20
+
+
+def test_dotdict():
+    d = dotdict({"a": {"b": 1}, "c": [{"d": 2}]})
+    assert d.a.b == 1
+    assert d.c[0].d == 2
+    d.a.e = {"f": 3}
+    assert d.a.e.f == 3
+    assert d.to_dict() == {"a": {"b": 1, "e": {"f": 3}}, "c": [{"d": 2}]}
+    assert d.get_nested("a.b") == 1
+    assert d.get_nested("a.zz", "fallback") == "fallback"
+
+
+def test_polynomial_decay():
+    assert polynomial_decay(0, initial=1.0, final=0.0, max_decay_steps=10) == 1.0
+    assert polynomial_decay(5, initial=1.0, final=0.0, max_decay_steps=10) == 0.5
+    assert polynomial_decay(20, initial=1.0, final=0.0, max_decay_steps=10) == 0.0
