@@ -147,7 +147,8 @@ def parse_overrides(overrides: Sequence[str]) -> Overrides:
         if not ov:
             continue
         if ov.startswith("~"):
-            out.deletions.append(ov[1:])
+            # hydra allows '~key=value'; the value is advisory — strip it
+            out.deletions.append(ov[1:].partition("=")[0])
             continue
         if "=" not in ov:
             raise ConfigCompositionError(f"override {ov!r} is not of the form key=value")
@@ -219,23 +220,24 @@ def _merge_at(dst: dict, package: Optional[str], src: Mapping) -> None:
     _merge(node, src)
 
 
-def _parse_default_entry(entry: Any) -> Tuple[Optional[str], Optional[str], Optional[str], bool]:
-    """Returns (group, option, package, is_self)."""
+def _parse_default_entry(entry: Any) -> Tuple[Optional[str], Optional[str], Optional[str], bool, bool]:
+    """Returns (group, option, package, is_self, is_override)."""
     if entry == "_self_":
-        return None, None, None, True
+        return None, None, None, True, False
     if isinstance(entry, str):
         # bare include of a sibling config file, e.g. "- base"
-        return entry, None, None, False
+        return entry, None, None, False, False
     if isinstance(entry, Mapping) and len(entry) == 1:
         key, option = next(iter(entry.items()))
         key = str(key)
-        if key.startswith("override "):
+        is_override = key.startswith("override ")
+        if is_override:
             key = key[len("override ") :].strip()
         package = None
         if "@" in key:
             key, _, package = key.partition("@")
         key = key.lstrip("/")
-        return key, (None if option is None else str(option)), package, False
+        return key, (None if option is None else str(option)), package, False, is_override
     raise ConfigCompositionError(f"malformed defaults entry: {entry!r}")
 
 
@@ -267,7 +269,7 @@ class _Composer:
                 if not isinstance(defaults, list):
                     raise ConfigCompositionError(f"'defaults' in {path} must be a list")
                 for entry in defaults:
-                    group, option, entry_pkg, is_self = _parse_default_entry(entry)
+                    group, option, entry_pkg, is_self, is_override = _parse_default_entry(entry)
                     if is_self:
                         _merge_at(dst, package, content)
                         own_merged = True
@@ -302,6 +304,18 @@ class _Composer:
                             eff_pkg = entry_pkg[len("_global_.") :]
                         elif package not in (None, "_global_", ""):
                             eff_pkg = f"{package}.{entry_pkg}"
+                    if is_override:
+                        # hydra semantics: `override /group: opt` REPLACES the
+                        # earlier selection — drop what that group already
+                        # merged so stale keys from the old option cannot leak.
+                        clear_at = eff_pkg if eff_pkg is not None else group.replace("/", ".")
+                        if clear_at not in (None, "_global_", ""):
+                            node: Any = dst
+                            parts = clear_at.split(".")
+                            for part in parts[:-1]:
+                                node = node.get(part, {}) if isinstance(node, dict) else {}
+                            if isinstance(node, dict):
+                                node.pop(parts[-1], None)
                     self.compose_file(
                         os.path.join(group, option),
                         dst,
@@ -412,7 +426,10 @@ def compose(
             )
         set_nested(out, entry.key, entry.value)
     for key, value in ovs.additions:
-        set_nested(out, key, value)
+        try:
+            set_nested(out, key, value)
+        except KeyError as e:
+            raise ConfigCompositionError(str(e)) from None
     for key in ovs.deletions:
         try:
             del_nested(out, key)
@@ -457,10 +474,8 @@ def instantiate(node: Any, *args: Any, _recursive_: bool = True, **kwargs: Any) 
     partial = bool(spec.pop("_partial_", False))
     pos = list(spec.pop("_args_", [])) + list(args)
     if _recursive_:
-        spec = {
-            k: instantiate(v) if isinstance(v, Mapping) and "_target_" in v else v
-            for k, v in spec.items()
-        }
+        spec = {k: _instantiate_tree(v) for k, v in spec.items()}
+        pos = [_instantiate_tree(v) for v in pos]
     spec.update(kwargs)
     module_name, _, attr = target.rpartition(".")
     if not module_name:
@@ -469,6 +484,18 @@ def instantiate(node: Any, *args: Any, _recursive_: bool = True, **kwargs: Any) 
     if partial:
         return functools.partial(obj, *pos, **spec)
     return obj(*pos, **spec)
+
+
+def _instantiate_tree(v: Any) -> Any:
+    """Recursively build every ``_target_`` node at any depth (hydra recurses
+    through nested dicts and lists alike)."""
+    if isinstance(v, Mapping):
+        if "_target_" in v:
+            return instantiate(v)
+        return {k: _instantiate_tree(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return type(v)(_instantiate_tree(x) for x in v)
+    return v
 
 
 def get_class(target: str) -> Any:

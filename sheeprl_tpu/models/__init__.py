@@ -1,0 +1,23 @@
+from sheeprl_tpu.models.blocks import (
+    CNN,
+    MLP,
+    DeCNN,
+    LayerNormChannelLast,
+    LayerNormGRUCell,
+    MultiDecoder,
+    MultiEncoder,
+    NatureCNN,
+    get_activation,
+)
+
+__all__ = [
+    "CNN",
+    "MLP",
+    "DeCNN",
+    "LayerNormChannelLast",
+    "LayerNormGRUCell",
+    "MultiDecoder",
+    "MultiEncoder",
+    "NatureCNN",
+    "get_activation",
+]

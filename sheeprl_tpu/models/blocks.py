@@ -1,0 +1,383 @@
+"""NN building blocks (reference: sheeprl/models/models.py + sheeprl/utils/model.py).
+
+flax.linen re-design, not a port:
+
+- **NHWC everywhere.** The reference is NCHW (torch); on TPU the MXU/vector
+  units want channel-last, so every image tensor in this framework is
+  ``[..., H, W, C]`` and convolutions are lowered in NHWC directly.
+- **Shape inference.** flax infers input dims at init; the reference's
+  ``input_dims`` plumbing and dummy-forward output probing (NatureCNN,
+  models.py:303-306) disappear.
+- **Per-layer config.** The reference's ``create_layers`` broadcast
+  (utils/model.py:91-139) maps to scalar-or-sequence fields resolved in
+  ``setup``.
+- **dtype policy.** Modules take ``dtype`` (compute) and ``param_dtype``;
+  the fabric's precision policy passes bf16 compute / fp32 params for
+  ``bf16-mixed`` (reference: Fabric precision, configs/fabric/default.yaml).
+- Activations/norms are referenced by *name* so they can live in YAML configs
+  (the reference uses hydra ``_target_`` class paths for the same reason).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+Dtype = Any
+
+_ACTIVATIONS: Dict[str, Callable[[Array], Array]] = {
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "elu": jax.nn.elu,
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "leaky_relu": jax.nn.leaky_relu,
+    "softplus": jax.nn.softplus,
+    "identity": lambda x: x,
+}
+
+
+def get_activation(name: Optional[Union[str, Callable]]) -> Callable[[Array], Array]:
+    if name is None:
+        return _ACTIVATIONS["identity"]
+    if callable(name):
+        return name
+    # accept torch-style class paths from configs, e.g. "torch.nn.SiLU"
+    key = str(name).rsplit(".", 1)[-1].lower()
+    if key not in _ACTIVATIONS:
+        raise ValueError(f"unknown activation {name!r}; available: {sorted(_ACTIVATIONS)}")
+    return _ACTIVATIONS[key]
+
+
+def _broadcast(spec: Any, n: int) -> Sequence[Any]:
+    """Scalar-or-list per-layer spec (reference utils/model.py:91-139)."""
+    if isinstance(spec, (list, tuple)):
+        if len(spec) != n:
+            raise ValueError(f"per-layer spec of length {len(spec)} does not match {n} layers")
+        return list(spec)
+    return [spec] * n
+
+
+class LayerNorm(nn.Module):
+    """Dtype-preserving LayerNorm (reference models.py:521-525): statistics in
+    fp32, output cast back to the input dtype — the bf16-safe pattern."""
+
+    eps: float = 1e-5
+    use_scale: bool = True
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        return nn.LayerNorm(
+            epsilon=self.eps,
+            use_scale=self.use_scale,
+            use_bias=self.use_bias,
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+        )(x).astype(x.dtype)
+
+
+# NHWC makes channel-last the native layout, so the reference's
+# LayerNormChannelLast permute wrapper (models.py:507-518) is just LayerNorm.
+LayerNormChannelLast = LayerNorm
+
+_NORMS: Dict[str, Callable[..., nn.Module]] = {
+    "layer_norm": LayerNorm,
+    "layernorm": LayerNorm,
+    "layer_norm_channel_last": LayerNormChannelLast,
+}
+
+
+def _make_norm(spec: Any, kwargs: Optional[dict]) -> Optional[nn.Module]:
+    if spec in (None, False):
+        return None
+    if isinstance(spec, str):
+        key = spec.rsplit(".", 1)[-1].lower()
+        if key in ("identity",):
+            return None
+        if key not in _NORMS:
+            raise ValueError(f"unknown norm {spec!r}; available: {sorted(_NORMS)}")
+        kw = dict(kwargs or {})
+        kw.pop("normalized_shape", None)  # shape is inferred in flax
+        return _NORMS[key](**kw)
+    if callable(spec):
+        return spec(**(kwargs or {}))
+    raise ValueError(f"bad norm spec {spec!r}")
+
+
+class MLP(nn.Module):
+    """Configurable linear stack with per-layer dropout/norm/activation
+    (reference models.py:16-119; layer order linear -> dropout -> norm -> act
+    mirrors ``miniblock``, utils/model.py:34-88).
+
+    ``output_dim=None`` omits the final projection (the last hidden layer is
+    the output). ``flatten_dim`` flattens trailing dims starting there.
+    """
+
+    hidden_sizes: Sequence[int] = ()
+    output_dim: Optional[int] = None
+    activation: Any = "relu"
+    act_args: Optional[Any] = None
+    norm_layer: Any = None
+    norm_args: Optional[Any] = None
+    dropout_layer: Any = None  # float rate or per-layer list
+    dropout_args: Optional[Any] = None
+    flatten_dim: Optional[int] = None
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array, deterministic: bool = True) -> Array:
+        n = len(self.hidden_sizes)
+        if n < 1 and self.output_dim is None:
+            raise ValueError("The number of layers should be at least 1.")
+        if self.flatten_dim is not None:
+            x = x.reshape(*x.shape[: self.flatten_dim], -1)
+        activations = _broadcast(self.activation, n)
+        act_args = _broadcast(self.act_args, n)
+        norms = _broadcast(self.norm_layer, n)
+        norm_args = _broadcast(self.norm_args, n)
+        dropouts = _broadcast(self.dropout_layer, n)
+        dropout_args = _broadcast(self.dropout_args, n)
+        for i, size in enumerate(self.hidden_sizes):
+            x = nn.Dense(size, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+            drop = dropouts[i]
+            if drop not in (None, False):
+                rate = drop if isinstance(drop, (int, float)) else (dropout_args[i] or {}).get("p", 0.5)
+                x = nn.Dropout(rate=float(rate))(x, deterministic=deterministic)
+            norm = _make_norm(norms[i], norm_args[i])
+            if norm is not None:
+                x = norm(x)
+            act = get_activation(activations[i])
+            x = act(x, **(act_args[i] or {})) if act_args[i] else act(x)
+        if self.output_dim is not None:
+            x = nn.Dense(self.output_dim, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        return x
+
+
+class CNN(nn.Module):
+    """Conv stack with per-layer config (reference models.py:122-202), NHWC.
+
+    ``layer_args`` entries accept ``kernel_size``/``stride``/``padding`` in the
+    torch style (ints or pairs); defaults padding=VALID like torch Conv2d.
+    """
+
+    hidden_channels: Sequence[int]
+    layer_args: Optional[Any] = None
+    activation: Any = "relu"
+    norm_layer: Any = None
+    norm_args: Optional[Any] = None
+    dropout_layer: Any = None
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array, deterministic: bool = True) -> Array:
+        n = len(self.hidden_channels)
+        layer_args = _broadcast(self.layer_args, n)
+        activations = _broadcast(self.activation, n)
+        norms = _broadcast(self.norm_layer, n)
+        norm_args = _broadcast(self.norm_args, n)
+        dropouts = _broadcast(self.dropout_layer, n)
+        for i, ch in enumerate(self.hidden_channels):
+            args = dict(layer_args[i] or {})
+            kernel = args.get("kernel_size", 3)
+            kernel = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+            stride = args.get("stride", 1)
+            stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+            padding = args.get("padding", 0)
+            if isinstance(padding, str):
+                pad = padding.upper()
+            else:
+                p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+                pad = [(p[0], p[0]), (p[1], p[1])]
+            use_bias = args.get("bias", True)
+            x = nn.Conv(
+                ch,
+                kernel_size=kernel,
+                strides=stride,
+                padding=pad,
+                use_bias=use_bias,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+            )(x)
+            if dropouts[i] not in (None, False):
+                x = nn.Dropout(rate=float(dropouts[i]))(x, deterministic=deterministic)
+            norm = _make_norm(norms[i], norm_args[i])
+            if norm is not None:
+                x = norm(x)
+            x = get_activation(activations[i])(x)
+        return x
+
+
+class DeCNN(nn.Module):
+    """Transposed-conv stack (reference models.py:205-285), NHWC."""
+
+    hidden_channels: Sequence[int]
+    layer_args: Optional[Any] = None
+    activation: Any = "relu"
+    norm_layer: Any = None
+    norm_args: Optional[Any] = None
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        n = len(self.hidden_channels)
+        layer_args = _broadcast(self.layer_args, n)
+        activations = _broadcast(self.activation, n)
+        norms = _broadcast(self.norm_layer, n)
+        norm_args = _broadcast(self.norm_args, n)
+        for i, ch in enumerate(self.hidden_channels):
+            args = dict(layer_args[i] or {})
+            kernel = args.get("kernel_size", 3)
+            kernel = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+            stride = args.get("stride", 1)
+            stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+            padding = args.get("padding", 0)
+            # torch ConvTranspose2d padding=p trims p from both sides of the
+            # full-output; flax ConvTranspose padding counts the same way when
+            # given explicit pairs on the *output*.
+            p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+            k0, k1 = kernel
+            pad = [(k0 - 1 - p[0], k0 - 1 - p[0]), (k1 - 1 - p[1], k1 - 1 - p[1])]
+            use_bias = args.get("bias", True)
+            x = nn.ConvTranspose(
+                ch,
+                kernel_size=kernel,
+                strides=stride,
+                padding=pad,
+                use_bias=use_bias,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+            )(x)
+            norm = _make_norm(norms[i], norm_args[i])
+            if norm is not None:
+                x = norm(x)
+            x = get_activation(activations[i])(x)
+        return x
+
+
+class NatureCNN(nn.Module):
+    """DQN Nature conv net + linear head (reference models.py:288-328):
+    convs (32, 64, 64) with kernels 8/4/3, strides 4/2/1, ReLU, then an
+    optional Dense head with ReLU. No dummy-forward probing needed — flax
+    infers the flattened dim."""
+
+    features_dim: Optional[int] = 512
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        x = CNN(
+            hidden_channels=(32, 64, 64),
+            layer_args=[
+                {"kernel_size": 8, "stride": 4},
+                {"kernel_size": 4, "stride": 2},
+                {"kernel_size": 3, "stride": 1},
+            ],
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )(x)
+        x = x.reshape(*x.shape[:-3], -1)
+        if self.features_dim is not None:
+            x = jax.nn.relu(nn.Dense(self.features_dim, dtype=self.dtype, param_dtype=self.param_dtype)(x))
+        return x
+
+
+class LayerNormGRUCell(nn.Module):
+    """GRU cell with LayerNorm after the joint input projection — Hafner's
+    DreamerV2 variant and the RSSM hot kernel (reference models.py:331-410,
+    math at :396-403):
+
+        x = LN(W [h, i])
+        reset, cand, update = split(x, 3)
+        reset = sigmoid(reset)
+        cand = tanh(reset * cand)
+        update = sigmoid(update - 1)        # -1 bias: favor keeping state
+        h' = update * cand + (1 - update) * h
+
+    Functional (carry, input) -> (carry, output) signature so it drops
+    straight into ``lax.scan`` / ``nn.scan`` — the XLA-compiled time loop that
+    replaces the reference's Python sequence loop (dreamer_v3.py:134-145).
+    """
+
+    hidden_size: int
+    bias: bool = True
+    layer_norm: bool = True
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h: Array, x: Array) -> Tuple[Array, Array]:
+        joint = jnp.concatenate([h, x], axis=-1)
+        proj = nn.Dense(
+            3 * self.hidden_size,
+            use_bias=self.bias,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )(joint)
+        if self.layer_norm:
+            proj = LayerNorm()(proj)
+        reset, cand, update = jnp.split(proj, 3, axis=-1)
+        reset = jax.nn.sigmoid(reset)
+        cand = jnp.tanh(reset * cand)
+        update = jax.nn.sigmoid(update - 1)
+        new_h = update * cand + (1 - update) * h
+        return new_h, new_h
+
+    def initialize_carry(self, batch_shape: Tuple[int, ...]) -> Array:
+        return jnp.zeros(batch_shape + (self.hidden_size,), dtype=self.dtype)
+
+
+class MultiEncoder(nn.Module):
+    """Fuses a cnn encoder and an mlp encoder by concatenating features
+    (reference models.py:413-475). Encoders are any modules mapping an obs
+    dict to a feature vector; either may be None."""
+
+    cnn_encoder: Optional[nn.Module] = None
+    mlp_encoder: Optional[nn.Module] = None
+
+    def __post_init__(self) -> None:
+        super().__post_init__()
+        if self.cnn_encoder is None and self.mlp_encoder is None:
+            raise ValueError("There must be at least one encoder, both cnn and mlp encoders are None")
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, Array], *args: Any, **kwargs: Any) -> Array:
+        outs = []
+        if self.cnn_encoder is not None:
+            outs.append(self.cnn_encoder(obs, *args, **kwargs))
+        if self.mlp_encoder is not None:
+            outs.append(self.mlp_encoder(obs, *args, **kwargs))
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=-1)
+
+
+class MultiDecoder(nn.Module):
+    """Routes a latent to cnn/mlp decoders, returning a dict of per-key
+    reconstructions (reference models.py:478-504)."""
+
+    cnn_decoder: Optional[nn.Module] = None
+    mlp_decoder: Optional[nn.Module] = None
+
+    def __post_init__(self) -> None:
+        super().__post_init__()
+        if self.cnn_decoder is None and self.mlp_decoder is None:
+            raise ValueError("There must be a decoder, both cnn and mlp decoders are None")
+
+    @nn.compact
+    def __call__(self, x: Array) -> Dict[str, Array]:
+        out: Dict[str, Array] = {}
+        if self.cnn_decoder is not None:
+            out.update(self.cnn_decoder(x))
+        if self.mlp_decoder is not None:
+            out.update(self.mlp_decoder(x))
+        return out

@@ -1,0 +1,1 @@
+from sheeprl_tpu.ops import distributions, math  # noqa: F401

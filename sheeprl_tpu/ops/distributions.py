@@ -1,0 +1,516 @@
+"""JAX-native distributions (reference: sheeprl/utils/distribution.py).
+
+Not a port of torch.distributions: each distribution is a frozen
+``flax.struct`` pytree, so instances can be created, returned, and carried
+through ``jit``/``scan``/``vmap`` boundaries. Sampling takes an explicit PRNG
+key; reparameterized sampling (``rsample``) differentiates through the sample
+where the reference's ``has_rsample`` does.
+
+Inventory and reference anchors:
+- ``Normal``/``Independent``               torch.distributions equivalents
+- ``TruncatedNormal``                      distribution.py:25-147
+- ``SymlogDistribution``                   distribution.py:152-193
+- ``MSEDistribution``                      distribution.py:196-221
+- ``TwoHotEncodingDistribution``           distribution.py:224-276
+- ``OneHotCategorical``/``...StraightThrough``  distribution.py:281-404
+- ``BernoulliSafeMode``                    distribution.py:407-414
+- ``TanhNormal``                           SAC squashed Gaussian (algos/sac/agent.py)
+- ``kl_divergence``                        registered KL pairs
+"""
+
+from __future__ import annotations
+
+import math as _math
+from typing import Callable, Tuple
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from sheeprl_tpu.ops.math import symexp, symlog
+
+Array = jax.Array
+
+_LOG_INV_SQRT_2PI = -0.5 * _math.log(2 * _math.pi)
+_LOG_SQRT_2PI_E = 0.5 * _math.log(2 * _math.pi * _math.e)
+
+
+def _std_normal_pdf(x: Array) -> Array:
+    return jnp.exp(-0.5 * jnp.square(x)) / _math.sqrt(2 * _math.pi)
+
+
+def _std_normal_cdf(x: Array) -> Array:
+    return 0.5 * (1.0 + lax.erf(x / _math.sqrt(2.0)))
+
+
+def _std_normal_icdf(p: Array) -> Array:
+    return _math.sqrt(2.0) * lax.erf_inv(2.0 * p - 1.0)
+
+
+# --------------------------------------------------------------------------- #
+# Gaussian family
+# --------------------------------------------------------------------------- #
+
+
+@struct.dataclass
+class Normal:
+    loc: Array
+    scale: Array
+
+    @property
+    def mean(self) -> Array:
+        return self.loc
+
+    @property
+    def mode(self) -> Array:
+        return self.loc
+
+    @property
+    def stddev(self) -> Array:
+        return self.scale
+
+    @property
+    def variance(self) -> Array:
+        return jnp.square(self.scale)
+
+    def sample(self, seed: Array, sample_shape: Tuple[int, ...] = ()) -> Array:
+        shape = sample_shape + jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        eps = jax.random.normal(seed, shape, dtype=self.loc.dtype)
+        return lax.stop_gradient(self.loc + eps * self.scale)
+
+    def rsample(self, seed: Array, sample_shape: Tuple[int, ...] = ()) -> Array:
+        shape = sample_shape + jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        eps = jax.random.normal(seed, shape, dtype=self.loc.dtype)
+        return self.loc + eps * self.scale
+
+    def log_prob(self, value: Array) -> Array:
+        z = (value - self.loc) / self.scale
+        return _LOG_INV_SQRT_2PI - jnp.log(self.scale) - 0.5 * jnp.square(z)
+
+    def entropy(self) -> Array:
+        return _LOG_SQRT_2PI_E + jnp.log(self.scale) * jnp.ones_like(self.loc)
+
+    def cdf(self, value: Array) -> Array:
+        return _std_normal_cdf((value - self.loc) / self.scale)
+
+
+@struct.dataclass
+class Independent:
+    """Reinterprets the last ``reinterpreted_batch_ndims`` batch dims as event
+    dims (sums log_prob/entropy over them) — torch.distributions.Independent."""
+
+    base: "Distribution"
+    reinterpreted_batch_ndims: int = struct.field(pytree_node=False, default=1)
+
+    @property
+    def _dims(self) -> Tuple[int, ...]:
+        return tuple(range(-self.reinterpreted_batch_ndims, 0))
+
+    @property
+    def mean(self) -> Array:
+        return self.base.mean
+
+    @property
+    def mode(self) -> Array:
+        return self.base.mode
+
+    def sample(self, seed: Array, sample_shape: Tuple[int, ...] = ()) -> Array:
+        return self.base.sample(seed, sample_shape)
+
+    def rsample(self, seed: Array, sample_shape: Tuple[int, ...] = ()) -> Array:
+        return self.base.rsample(seed, sample_shape)
+
+    def log_prob(self, value: Array) -> Array:
+        return self.base.log_prob(value).sum(axis=self._dims)
+
+    def entropy(self) -> Array:
+        return self.base.entropy().sum(axis=self._dims)
+
+
+@struct.dataclass
+class TruncatedNormal:
+    """Closed-form truncated normal on [low, high] with icdf-based rsample
+    (reference distribution.py:25-147; Dreamer-V1/V2 continuous actors).
+    Bounds must be finite."""
+
+    loc: Array
+    scale: Array
+    low: Array
+    high: Array
+
+    @property
+    def _a(self) -> Array:  # standardized bounds
+        return (self.low - self.loc) / self.scale
+
+    @property
+    def _b(self) -> Array:
+        return (self.high - self.loc) / self.scale
+
+    @property
+    def _Z(self) -> Array:
+        eps = jnp.finfo(self.loc.dtype).eps
+        return jnp.maximum(_std_normal_cdf(self._b) - _std_normal_cdf(self._a), eps)
+
+    @property
+    def mean(self) -> Array:
+        num = _std_normal_pdf(self._b) - _std_normal_pdf(self._a)
+        return self.loc + self.scale * (-num / self._Z)
+
+    @property
+    def mode(self) -> Array:
+        return jnp.clip(self.loc, self.low, self.high)
+
+    @property
+    def variance(self) -> Array:
+        a, b, Z = self._a, self._b, self._Z
+        phi_a, phi_b = _std_normal_pdf(a), _std_normal_pdf(b)
+        t1 = (b * phi_b - a * phi_a) / Z
+        t2 = (phi_b - phi_a) / Z
+        return jnp.square(self.scale) * (1 - t1 - jnp.square(t2))
+
+    def log_prob(self, value: Array) -> Array:
+        z = (value - self.loc) / self.scale
+        return _LOG_INV_SQRT_2PI - jnp.log(self._Z) - 0.5 * jnp.square(z) - jnp.log(self.scale)
+
+    def cdf(self, value: Array) -> Array:
+        z = (value - self.loc) / self.scale
+        return jnp.clip((_std_normal_cdf(z) - _std_normal_cdf(self._a)) / self._Z, 0.0, 1.0)
+
+    def icdf(self, p: Array) -> Array:
+        std = _std_normal_icdf(_std_normal_cdf(self._a) + p * self._Z)
+        return self.loc + self.scale * std
+
+    def rsample(self, seed: Array, sample_shape: Tuple[int, ...] = ()) -> Array:
+        shape = sample_shape + jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        eps = jnp.finfo(self.loc.dtype).eps
+        p = jax.random.uniform(seed, shape, dtype=self.loc.dtype, minval=eps, maxval=1 - eps)
+        return self.icdf(p)
+
+    def sample(self, seed: Array, sample_shape: Tuple[int, ...] = ()) -> Array:
+        return lax.stop_gradient(self.rsample(seed, sample_shape))
+
+    def entropy(self) -> Array:
+        a, b, Z = self._a, self._b, self._Z
+        phi_a, phi_b = _std_normal_pdf(a), _std_normal_pdf(b)
+        t1 = (b * phi_b - a * phi_a) / Z
+        return _LOG_SQRT_2PI_E + jnp.log(Z) - 0.5 * t1 + jnp.log(self.scale)
+
+
+@struct.dataclass
+class TanhNormal:
+    """Tanh-squashed Gaussian for SAC actors: ``a = tanh(u), u ~ N(loc, scale)``
+    with the change-of-variables log-prob correction computed in the
+    numerically stable softplus form."""
+
+    loc: Array
+    scale: Array
+
+    @property
+    def mode(self) -> Array:
+        return jnp.tanh(self.loc)
+
+    @property
+    def mean(self) -> Array:
+        return jnp.tanh(self.loc)
+
+    def rsample_and_log_prob(self, seed: Array) -> Tuple[Array, Array]:
+        base = Normal(self.loc, self.scale)
+        u = base.rsample(seed)
+        action = jnp.tanh(u)
+        # log|d tanh(u)/du| = log(1 - tanh(u)^2) = 2*(log2 - u - softplus(-2u))
+        log_det = 2.0 * (_math.log(2.0) - u - jax.nn.softplus(-2.0 * u))
+        return action, base.log_prob(u) - log_det
+
+    def rsample(self, seed: Array, sample_shape: Tuple[int, ...] = ()) -> Array:
+        return jnp.tanh(Normal(self.loc, self.scale).rsample(seed, sample_shape))
+
+    def sample(self, seed: Array, sample_shape: Tuple[int, ...] = ()) -> Array:
+        return lax.stop_gradient(self.rsample(seed, sample_shape))
+
+    def log_prob(self, value: Array) -> Array:
+        u = jnp.arctanh(jnp.clip(value, -1 + 1e-6, 1 - 1e-6))
+        log_det = 2.0 * (_math.log(2.0) - u - jax.nn.softplus(-2.0 * u))
+        return Normal(self.loc, self.scale).log_prob(u) - log_det
+
+
+# --------------------------------------------------------------------------- #
+# Categorical family
+# --------------------------------------------------------------------------- #
+
+
+@struct.dataclass
+class Categorical:
+    """Integer-support categorical over the last axis of ``logits``."""
+
+    logits: Array  # unnormalized
+
+    @property
+    def log_probs(self) -> Array:
+        return jax.nn.log_softmax(self.logits, axis=-1)
+
+    @property
+    def probs(self) -> Array:
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    @property
+    def mode(self) -> Array:
+        return jnp.argmax(self.logits, axis=-1)
+
+    def sample(self, seed: Array, sample_shape: Tuple[int, ...] = ()) -> Array:
+        return jax.random.categorical(seed, self.logits, axis=-1, shape=sample_shape + self.logits.shape[:-1])
+
+    def log_prob(self, value: Array) -> Array:
+        return jnp.take_along_axis(self.log_probs, value[..., None], axis=-1)[..., 0]
+
+    def entropy(self) -> Array:
+        lp = self.log_probs
+        return -(jnp.exp(lp) * lp).sum(axis=-1)
+
+
+@struct.dataclass
+class OneHotCategorical:
+    """One-hot-coded categorical (reference distribution.py:281-383)."""
+
+    logits: Array
+
+    @classmethod
+    def from_probs(cls, probs: Array) -> "OneHotCategorical":
+        return cls(logits=jnp.log(jnp.clip(probs, 1e-38, None)))
+
+    @property
+    def log_probs(self) -> Array:
+        return jax.nn.log_softmax(self.logits, axis=-1)
+
+    @property
+    def probs(self) -> Array:
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    @property
+    def mean(self) -> Array:
+        return self.probs
+
+    @property
+    def variance(self) -> Array:
+        p = self.probs
+        return p * (1 - p)
+
+    @property
+    def mode(self) -> Array:
+        n = self.logits.shape[-1]
+        return jax.nn.one_hot(jnp.argmax(self.logits, axis=-1), n, dtype=self.logits.dtype)
+
+    def sample(self, seed: Array, sample_shape: Tuple[int, ...] = ()) -> Array:
+        n = self.logits.shape[-1]
+        idx = jax.random.categorical(seed, self.logits, axis=-1, shape=sample_shape + self.logits.shape[:-1])
+        return jax.nn.one_hot(idx, n, dtype=self.logits.dtype)
+
+    def log_prob(self, value: Array) -> Array:
+        return (value * self.log_probs).sum(axis=-1)
+
+    def entropy(self) -> Array:
+        lp = self.log_probs
+        return -(jnp.exp(lp) * lp).sum(axis=-1)
+
+
+@struct.dataclass
+class OneHotCategoricalStraightThrough(OneHotCategorical):
+    """Straight-through reparameterization: ``sample + (probs - sg(probs))``
+    (reference distribution.py:386-403; Bengio et al. 2013). The RSSM latent
+    sampler."""
+
+    def rsample(self, seed: Array, sample_shape: Tuple[int, ...] = ()) -> Array:
+        samples = self.sample(seed, sample_shape)
+        probs = self.probs
+        return samples + (probs - lax.stop_gradient(probs))
+
+
+# --------------------------------------------------------------------------- #
+# Dreamer-V3 heads
+# --------------------------------------------------------------------------- #
+
+
+def _neg_dims(dims: int) -> Tuple[int, ...]:
+    return tuple(-x for x in range(1, dims + 1))
+
+
+@struct.dataclass
+class SymlogDistribution:
+    """``log_prob = -(pred - symlog(x))^2`` with tolerance; mean/mode = symexp
+    (reference distribution.py:152-193; DV3 vector decoder head)."""
+
+    _mode: Array
+    dims: int = struct.field(pytree_node=False, default=1)
+    dist: str = struct.field(pytree_node=False, default="mse")
+    agg: str = struct.field(pytree_node=False, default="sum")
+    tol: float = struct.field(pytree_node=False, default=1e-8)
+
+    @property
+    def mode(self) -> Array:
+        return symexp(self._mode)
+
+    @property
+    def mean(self) -> Array:
+        return symexp(self._mode)
+
+    def log_prob(self, value: Array) -> Array:
+        assert self._mode.shape == value.shape, (self._mode.shape, value.shape)
+        if self.dist == "mse":
+            distance = jnp.square(self._mode - symlog(value))
+        elif self.dist == "abs":
+            distance = jnp.abs(self._mode - symlog(value))
+        else:
+            raise NotImplementedError(self.dist)
+        distance = jnp.where(distance < self.tol, 0.0, distance)
+        if self.agg == "mean":
+            loss = distance.mean(axis=_neg_dims(self.dims))
+        elif self.agg == "sum":
+            loss = distance.sum(axis=_neg_dims(self.dims))
+        else:
+            raise NotImplementedError(self.agg)
+        return -loss
+
+
+@struct.dataclass
+class MSEDistribution:
+    """Negative MSE as log_prob (reference distribution.py:196-221; DV3 image
+    decoder head)."""
+
+    _mode: Array
+    dims: int = struct.field(pytree_node=False, default=1)
+    agg: str = struct.field(pytree_node=False, default="sum")
+
+    @property
+    def mode(self) -> Array:
+        return self._mode
+
+    @property
+    def mean(self) -> Array:
+        return self._mode
+
+    def log_prob(self, value: Array) -> Array:
+        assert self._mode.shape == value.shape, (self._mode.shape, value.shape)
+        distance = jnp.square(self._mode - value)
+        if self.agg == "mean":
+            loss = distance.mean(axis=_neg_dims(self.dims))
+        elif self.agg == "sum":
+            loss = distance.sum(axis=_neg_dims(self.dims))
+        else:
+            raise NotImplementedError(self.agg)
+        return -loss
+
+
+@struct.dataclass
+class TwoHotEncodingDistribution:
+    """255-bin two-hot distribution over a transformed (symlog) support
+    (reference distribution.py:224-276; DV3 reward & critic heads).
+
+    ``mean = transbwd(sum(softmax(logits) * bins))``; ``log_prob`` is the
+    cross-entropy against the two-hot encoding of ``transfwd(x)``.
+    """
+
+    logits: Array
+    dims: int = struct.field(pytree_node=False, default=0)
+    low: float = struct.field(pytree_node=False, default=-20.0)
+    high: float = struct.field(pytree_node=False, default=20.0)
+    transfwd: Callable[[Array], Array] = struct.field(pytree_node=False, default=symlog)
+    transbwd: Callable[[Array], Array] = struct.field(pytree_node=False, default=symexp)
+
+    @property
+    def bins(self) -> Array:
+        return jnp.linspace(self.low, self.high, self.logits.shape[-1], dtype=self.logits.dtype)
+
+    @property
+    def probs(self) -> Array:
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def _expected(self) -> Array:
+        dims = _neg_dims(self.dims) if self.dims else (-1,)
+        return self.transbwd((self.probs * self.bins).sum(axis=dims, keepdims=True))
+
+    @property
+    def mean(self) -> Array:
+        return self._expected()
+
+    @property
+    def mode(self) -> Array:
+        return self._expected()
+
+    def log_prob(self, x: Array) -> Array:
+        bins = self.bins
+        n = bins.shape[0]
+        x = self.transfwd(x)
+        below = (bins <= x).astype(jnp.int32).sum(axis=-1, keepdims=True) - 1
+        above = jnp.minimum(below + 1, n - 1)
+        below = jnp.maximum(below, 0)
+        equal = below == above
+        dist_to_below = jnp.where(equal, 1.0, jnp.abs(bins[below] - x))
+        dist_to_above = jnp.where(equal, 1.0, jnp.abs(bins[above] - x))
+        total = dist_to_below + dist_to_above
+        weight_below = dist_to_above / total
+        weight_above = dist_to_below / total
+        target = (
+            jax.nn.one_hot(below, n, dtype=self.logits.dtype) * weight_below[..., None]
+            + jax.nn.one_hot(above, n, dtype=self.logits.dtype) * weight_above[..., None]
+        )[..., 0, :]
+        log_pred = jax.nn.log_softmax(self.logits, axis=-1)
+        dims = _neg_dims(self.dims) if self.dims else (-1,)
+        return (target * log_pred).sum(axis=dims)
+
+
+@struct.dataclass
+class Bernoulli:
+    """Bernoulli over logits with a NaN-free mode ``(p > 0.5)`` (reference
+    ``BernoulliSafeMode``, distribution.py:407-414; DV3 continue head)."""
+
+    logits: Array
+
+    @property
+    def probs(self) -> Array:
+        return jax.nn.sigmoid(self.logits)
+
+    @property
+    def mean(self) -> Array:
+        return self.probs
+
+    @property
+    def mode(self) -> Array:
+        return (self.probs > 0.5).astype(self.logits.dtype)
+
+    def sample(self, seed: Array, sample_shape: Tuple[int, ...] = ()) -> Array:
+        u = jax.random.uniform(seed, sample_shape + self.logits.shape, dtype=self.probs.dtype)
+        return (u < self.probs).astype(self.logits.dtype)
+
+    def log_prob(self, value: Array) -> Array:
+        # -BCEWithLogits: value*log(p) + (1-value)*log(1-p), stable form
+        return -jnp.maximum(self.logits, 0) + self.logits * value - jnp.log1p(jnp.exp(-jnp.abs(self.logits)))
+
+    def entropy(self) -> Array:
+        p = self.probs
+        lp = jax.nn.log_sigmoid(self.logits)
+        lq = jax.nn.log_sigmoid(-self.logits)
+        return -(p * lp + (1 - p) * lq)
+
+
+BernoulliSafeMode = Bernoulli  # reference-compatible alias
+
+
+# --------------------------------------------------------------------------- #
+# KL divergences
+# --------------------------------------------------------------------------- #
+
+
+def kl_divergence(p, q) -> Array:
+    """KL(p || q) for matching pairs (reference registers
+    OneHotCategorical x OneHotCategorical at distribution.py:404; Normal pairs
+    are used by Dreamer-V1's KL loss)."""
+    if isinstance(p, Independent) and isinstance(q, Independent):
+        return kl_divergence(p.base, q.base).sum(axis=p._dims)
+    if isinstance(p, (OneHotCategorical, Categorical)) and isinstance(q, (OneHotCategorical, Categorical)):
+        p_lp, q_lp = p.log_probs, q.log_probs
+        return (jnp.exp(p_lp) * (p_lp - q_lp)).sum(axis=-1)
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = jnp.square(p.scale / q.scale)
+        t1 = jnp.square((p.loc - q.loc) / q.scale)
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    raise NotImplementedError(f"kl_divergence not defined for {type(p).__name__} x {type(q).__name__}")

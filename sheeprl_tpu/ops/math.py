@@ -1,0 +1,188 @@
+"""Jittable numeric transforms.
+
+TPU-native counterparts of the reference's scalar/return math
+(sheeprl/utils/utils.py:63-205 and sheeprl/algos/dreamer_v3/utils.py:40-77):
+reverse-time recurrences (GAE, lambda-returns) are ``lax.scan`` instead of
+Python loops, so they compile to a single fused XLA while-loop on device.
+All functions are pure and shape-polymorphic over leading batch dims.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+def symlog(x: Array) -> Array:
+    """sign(x) * log(1 + |x|) (reference utils/utils.py:148-150)."""
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x: Array) -> Array:
+    """Inverse of symlog (reference utils/utils.py:152-153)."""
+    return jnp.sign(x) * jnp.expm1(jnp.abs(x))
+
+
+def two_hot_encoder(x: Array, support_range: int = 300, num_buckets: Optional[int] = None) -> Array:
+    """Two-hot encode ``x`` of shape (..., 1) onto an odd uniform support
+    [-support_range, support_range] (reference utils/utils.py:156-185;
+    DreamerV3 paper eq. 9). Returns (..., num_buckets)."""
+    if x.ndim == 0:
+        x = x[None]
+    if num_buckets is None:
+        num_buckets = support_range * 2 + 1
+    if num_buckets % 2 == 0:
+        raise ValueError("support_size must be odd")
+    x = jnp.clip(x, -support_range, support_range)
+    buckets = jnp.linspace(-support_range, support_range, num_buckets, dtype=x.dtype)
+    bucket_size = buckets[1] - buckets[0] if num_buckets > 1 else jnp.asarray(1.0, x.dtype)
+
+    right_idxs = jnp.searchsorted(buckets, x, side="left")
+    left_idxs = jnp.clip(right_idxs - 1, 0, num_buckets - 1)
+
+    left_weight = jnp.abs(buckets[right_idxs] - x) / bucket_size
+    right_weight = 1.0 - left_weight
+    one_hot_left = jax.nn.one_hot(left_idxs[..., 0], num_buckets, dtype=x.dtype)
+    one_hot_right = jax.nn.one_hot(right_idxs[..., 0], num_buckets, dtype=x.dtype)
+    return one_hot_left * left_weight + one_hot_right * right_weight
+
+
+def two_hot_decoder(x: Array, support_range: int) -> Array:
+    """Expected value under a two-hot vector (reference utils/utils.py:188-205).
+    (..., num_buckets) -> (..., 1)."""
+    num_buckets = x.shape[-1]
+    if num_buckets % 2 == 0:
+        raise ValueError("support_size must be odd")
+    support = jnp.linspace(-support_range, support_range, num_buckets, dtype=x.dtype)
+    return jnp.sum(x * support, axis=-1, keepdims=True)
+
+
+def gae(
+    rewards: Array,
+    values: Array,
+    dones: Array,
+    next_value: Array,
+    gamma: float,
+    gae_lambda: float,
+) -> Tuple[Array, Array]:
+    """Generalized advantage estimation over a time-major rollout.
+
+    Matches the reference recurrence exactly (utils/utils.py:63-100, itself the
+    CleanRL convention where ``dones[t]`` flags the *current* observation):
+    ``delta_t = r_t + gamma * nd_t * V_{t+1} - V_t``;
+    ``A_t = delta_t + gamma * lambda * nd_t * A_{t+1}``,
+    but as a reverse ``lax.scan`` rather than a Python loop.
+
+    Args:
+        rewards/values/dones: ``[T, ...]`` time-major arrays.
+        next_value: ``[...]`` bootstrap value for the observation after step T-1
+            (same trailing shape as ``values[0]``).
+
+    Returns: (returns, advantages), both ``[T, ...]``.
+    """
+    not_dones = 1.0 - dones.astype(values.dtype)
+    next_values = jnp.concatenate([values[1:], next_value[None]], axis=0)
+
+    def step(carry, xs):
+        reward, value, nxt_value, not_done = xs
+        delta = reward + gamma * nxt_value * not_done - value
+        adv = delta + gamma * gae_lambda * not_done * carry
+        return adv, adv
+
+    _, advantages = lax.scan(
+        step,
+        jnp.zeros_like(next_value),
+        (rewards, values, next_values, not_dones),
+        reverse=True,
+    )
+    returns = advantages + values
+    return returns, advantages
+
+
+def compute_lambda_values(
+    rewards: Array,
+    values: Array,
+    continues: Array,
+    lmbda: float = 0.95,
+) -> Array:
+    """TD(lambda) returns for Dreamer imagination rollouts
+    (reference algos/dreamer_v3/utils.py:66-77):
+    ``R_t = r_t + c_t * [(1 - lambda) * v_t + lambda * R_{t+1}]`` with
+    ``R_T = v_{T-1}`` bootstrap, as a reverse ``lax.scan``.
+    All inputs are ``[T, ...]`` time-major."""
+    interm = rewards + continues * values * (1 - lmbda)
+
+    def step(carry, xs):
+        inte, cont = xs
+        ret = inte + cont * lmbda * carry
+        return ret, ret
+
+    _, lambda_values = lax.scan(step, values[-1], (interm, continues), reverse=True)
+    return lambda_values
+
+
+def normalize(x: Array, eps: float = 1e-8, mask: Optional[Array] = None) -> Array:
+    """Standardize ``x`` with optional boolean mask (reference
+    utils/utils.py:120-130). Shape-preserving (masked positions are normalized
+    with the masked statistics too — callers mask the loss, keeping shapes
+    static under jit). Uses the unbiased (n-1) std like ``Tensor.std()``."""
+    if mask is None:
+        mean = x.mean()
+        std = x.std(ddof=1)
+    else:
+        m = mask.astype(x.dtype)
+        n = jnp.maximum(m.sum(), 1.0)
+        mean = (x * m).sum() / n
+        var = (jnp.square(x - mean) * m).sum() / jnp.maximum(n - 1.0, 1.0)
+        std = jnp.sqrt(var)
+    return (x - mean) / (std + eps)
+
+
+# --------------------------------------------------------------------------- #
+# Return-normalization moments (Dreamer-V3)
+# --------------------------------------------------------------------------- #
+
+import flax.struct as struct  # noqa: E402
+
+
+@struct.dataclass
+class MomentsState:
+    """Percentile-EMA return normalizer state (reference
+    algos/dreamer_v3/utils.py:40-63). Checkpointable pytree."""
+
+    low: Array
+    high: Array
+
+
+def init_moments(dtype: jnp.dtype = jnp.float32) -> MomentsState:
+    return MomentsState(low=jnp.zeros((), dtype), high=jnp.zeros((), dtype))
+
+
+def update_moments(
+    state: MomentsState,
+    x: Array,
+    decay: float = 0.99,
+    max_: float = 1e8,
+    percentile_low: float = 0.05,
+    percentile_high: float = 0.95,
+    axis_name: Optional[str] = None,
+) -> Tuple[MomentsState, Tuple[Array, Array]]:
+    """EMA of the (5th, 95th) percentiles of lambda-returns; returns
+    ``(new_state, (low, invscale))``. With ``axis_name`` the percentiles are
+    computed over the values gathered from every mesh replica — the XLA
+    collective that replaces the reference's ``fabric.all_gather``
+    (dreamer_v3/utils.py:57)."""
+    x = lax.stop_gradient(x.astype(jnp.float32))
+    if axis_name is not None:
+        x = lax.all_gather(x, axis_name)
+    low = jnp.quantile(x, percentile_low)
+    high = jnp.quantile(x, percentile_high)
+    new_low = decay * state.low + (1 - decay) * low
+    new_high = decay * state.high + (1 - decay) * high
+    invscale = jnp.maximum(1.0 / max_, new_high - new_low)
+    return MomentsState(low=new_low, high=new_high), (new_low, invscale)

@@ -1,0 +1,46 @@
+"""Checkpoint callback (reference: sheeprl/utils/callback.py:14-148).
+
+Invoked by algorithms through ``fabric.call("on_checkpoint_coupled", ...)``;
+delegates serialization to ``sheeprl_tpu.core.checkpoint`` (orbax) and prunes
+old checkpoints with ``keep_last``.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Dict, Optional
+
+
+class CheckpointCallback:
+    def __init__(self, keep_last: Optional[int] = None) -> None:
+        self.keep_last = keep_last
+
+    def on_checkpoint_coupled(
+        self,
+        fabric: Any,
+        ckpt_path: str,
+        state: Dict[str, Any],
+        replay_buffer: Any = None,
+    ) -> None:
+        if replay_buffer is not None:
+            state = {**state, "rb": replay_buffer}
+        fabric.save(ckpt_path, state)
+        if self.keep_last:
+            self._prune(os.path.dirname(ckpt_path))
+
+    # Decoupled topologies save from the player with trainer-provided state
+    # (reference callback.py:58-78).
+    def on_checkpoint_player(self, fabric: Any, ckpt_path: str, state: Dict[str, Any], replay_buffer: Any = None) -> None:
+        self.on_checkpoint_coupled(fabric, ckpt_path, state, replay_buffer)
+
+    def _prune(self, ckpt_dir: str) -> None:
+        if not os.path.isdir(ckpt_dir):
+            return
+        entries = sorted(
+            (e for e in os.listdir(ckpt_dir) if not e.startswith(".")),
+            key=lambda e: os.path.getmtime(os.path.join(ckpt_dir, e)),
+        )
+        for stale in entries[: -self.keep_last] if len(entries) > self.keep_last else []:
+            path = os.path.join(ckpt_dir, stale)
+            shutil.rmtree(path) if os.path.isdir(path) else os.remove(path)

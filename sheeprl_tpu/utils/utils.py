@@ -75,13 +75,20 @@ class dotdict(dict):
 
 
 def set_nested(d: dict, dotted: str, value: Any, create: bool = True) -> None:
+    """Set a dotted key, creating missing intermediate dicts. An intermediate
+    that exists but is NOT a dict is an error — silently clobbering a scalar
+    with a dict would corrupt the config on a typo'd key."""
     parts = dotted.split(".")
     node = d
     for p in parts[:-1]:
-        if p not in node or not isinstance(node[p], dict):
+        if p not in node:
             if not create:
                 raise KeyError(f"missing intermediate key {p!r} in {dotted!r}")
             node[p] = {}
+        elif not isinstance(node[p], dict):
+            raise KeyError(
+                f"cannot set {dotted!r}: intermediate key {p!r} holds a non-dict value ({node[p]!r})"
+            )
         node = node[p]
     node[parts[-1]] = value
 

@@ -12,6 +12,12 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+# The env var alone is not enough on machines where a TPU platform plugin
+# (axon) overrides it; the config update always wins.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

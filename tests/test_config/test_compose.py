@@ -142,6 +142,55 @@ def test_unknown_group_option_lists_alternatives(tree):
         compose("config", ["exp=nope"], search_path=tree)
 
 
+def test_hydra_style_deletion_with_value(tree):
+    cfg = compose("config", ["exp=demo", "~env.num_envs=4"], search_path=tree)
+    assert "num_envs" not in cfg.env
+
+
+def test_addition_through_scalar_errors(tree):
+    with pytest.raises(ConfigCompositionError, match="non-dict"):
+        compose("config", ["exp=demo", "+env.id.foo=bar"], search_path=tree)
+
+
+def test_override_defaults_replaces_selection(tmp_path):
+    root = str(tmp_path / "c4")
+    _write(root, "config.yaml", "defaults:\n  - opt: sgd\n  - exp: ???\n")
+    _write(root, "opt/sgd.yaml", "kind: sgd\nmomentum: 0.9\n")
+    _write(root, "opt/adam.yaml", "kind: adam\nbetas: [0.9, 0.999]\n")
+    _write(root, "exp/use_adam.yaml", "# @package _global_\ndefaults:\n  - override /opt: adam\n")
+    cfg = compose("config", ["exp=use_adam"], search_path=[root])
+    assert cfg.opt.kind == "adam"
+    assert "momentum" not in cfg.opt  # stale key from sgd must not leak
+    assert cfg.opt.betas == [0.9, 0.999]
+
+
+def test_instantiate_recurses_into_lists_and_nested_dicts():
+    built = instantiate(
+        {
+            "_target_": "collections.OrderedDict",
+            "items_": [{"_target_": "collections.OrderedDict", "x": 1}],
+            "nested": {"inner": {"_target_": "collections.OrderedDict", "y": 2}},
+        }
+    )
+    from collections import OrderedDict
+
+    assert isinstance(built["items_"][0], OrderedDict)
+    assert isinstance(built["nested"]["inner"], OrderedDict)
+
+
+def test_instantiate_builtin_fabric_callbacks_list():
+    cfg = compose(
+        "config",
+        ["exp=default", "algo.name=x", "algo.total_steps=1", "algo.per_rank_batch_size=1", "env.id=e", "env.wrapper=w", "buffer.size=8"],
+    )
+    from sheeprl_tpu.config.compose import _instantiate_tree
+
+    callbacks = _instantiate_tree(cfg.fabric.callbacks)
+    from sheeprl_tpu.utils.callback import CheckpointCallback
+
+    assert isinstance(callbacks[0], CheckpointCallback)
+
+
 def test_instantiate():
     obj = instantiate({"_target_": "collections.OrderedDict", "a": 1})
     assert dict(obj) == {"a": 1}

@@ -1,0 +1,234 @@
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.models import (
+    CNN,
+    MLP,
+    DeCNN,
+    LayerNormGRUCell,
+    MultiDecoder,
+    MultiEncoder,
+    NatureCNN,
+    get_activation,
+)
+from sheeprl_tpu.models.blocks import LayerNorm
+
+KEY = jax.random.PRNGKey(0)
+
+
+# ---- MLP (specs mirror reference tests/test_models/test_mlp.py) ----
+
+
+def test_mlp_output_dim():
+    m = MLP(hidden_sizes=(32, 16), output_dim=4)
+    params = m.init(KEY, jnp.ones((2, 8)))
+    out = m.apply(params, jnp.ones((2, 8)))
+    assert out.shape == (2, 4)
+
+
+def test_mlp_no_output_layer():
+    m = MLP(hidden_sizes=(32, 16))
+    out = m.apply(m.init(KEY, jnp.ones((2, 8))), jnp.ones((2, 8)))
+    assert out.shape == (2, 16)
+
+
+def test_mlp_raises_no_layers():
+    m = MLP(hidden_sizes=(), output_dim=None)
+    with pytest.raises(ValueError):
+        m.init(KEY, jnp.ones((2, 8)))
+
+
+def test_mlp_flatten_dim():
+    m = MLP(hidden_sizes=(8,), flatten_dim=1)
+    out = m.apply(m.init(KEY, jnp.ones((2, 4, 4))), jnp.ones((2, 4, 4)))
+    assert out.shape == (2, 8)
+
+
+def test_mlp_per_layer_activation_and_norm():
+    m = MLP(hidden_sizes=(8, 8), activation=["relu", "tanh"], norm_layer=["layer_norm", None])
+    out = m.apply(m.init(KEY, jnp.ones((2, 4))), jnp.ones((2, 4)))
+    assert out.shape == (2, 8)
+    # tanh output bounded
+    assert np.all(np.abs(np.asarray(out)) <= 1.0)
+
+
+def test_mlp_per_layer_mismatch_raises():
+    m = MLP(hidden_sizes=(8, 8, 8), activation=["relu", "tanh"])
+    with pytest.raises(ValueError):
+        m.init(KEY, jnp.ones((2, 4)))
+
+
+def test_mlp_dropout_deterministic_flag():
+    m = MLP(hidden_sizes=(64,), dropout_layer=0.5)
+    params = m.init(KEY, jnp.ones((2, 8)))
+    out1 = m.apply(params, jnp.ones((2, 8)), deterministic=True)
+    out2 = m.apply(params, jnp.ones((2, 8)), deterministic=True)
+    np.testing.assert_allclose(out1, out2)
+    stoch = m.apply(params, jnp.ones((2, 8)), deterministic=False, rngs={"dropout": KEY})
+    assert not np.allclose(out1, stoch)
+
+
+def test_mlp_bf16_compute_fp32_params():
+    m = MLP(hidden_sizes=(8,), output_dim=3, dtype=jnp.bfloat16)
+    params = m.init(KEY, jnp.ones((2, 4)))
+    leaf = jax.tree_util.tree_leaves(params)[0]
+    assert leaf.dtype == jnp.float32  # params stay fp32
+    out = m.apply(params, jnp.ones((2, 4), jnp.bfloat16))
+    assert out.dtype == jnp.bfloat16  # compute in bf16
+
+
+# ---- CNN / DeCNN (NHWC) ----
+
+
+def test_cnn_shapes_nhwc():
+    m = CNN(hidden_channels=(8, 16), layer_args={"kernel_size": 3, "stride": 2, "padding": 1})
+    x = jnp.ones((2, 16, 16, 3))
+    out = m.apply(m.init(KEY, x), x)
+    assert out.shape == (2, 4, 4, 16)
+
+
+def test_cnn_matches_torch_conv_arithmetic():
+    # kernel 8 stride 4 valid padding on 64x64 -> 15x15 (torch conv formula)
+    m = CNN(hidden_channels=(4,), layer_args={"kernel_size": 8, "stride": 4})
+    x = jnp.ones((1, 64, 64, 1))
+    out = m.apply(m.init(KEY, x), x)
+    assert out.shape == (1, 15, 15, 4)
+
+
+def test_decnn_inverts_cnn_shape():
+    # Dreamer-style: kernel 4, stride 2, padding 1 halves/doubles spatial dims
+    dec = DeCNN(hidden_channels=(8,), layer_args={"kernel_size": 4, "stride": 2, "padding": 1})
+    x = jnp.ones((2, 4, 4, 16))
+    out = dec.apply(dec.init(KEY, x), x)
+    assert out.shape == (2, 8, 8, 8)
+
+
+def test_nature_cnn():
+    m = NatureCNN(features_dim=512)
+    x = jnp.ones((2, 64, 64, 4))
+    out = m.apply(m.init(KEY, x), x)
+    assert out.shape == (2, 512)
+    assert np.all(np.asarray(out) >= 0)  # final relu
+
+
+def test_layer_norm_dtype_preserving():
+    ln = LayerNorm()
+    x = jnp.ones((2, 8), jnp.bfloat16)
+    out = ln.apply(ln.init(KEY, x), x)
+    assert out.dtype == jnp.bfloat16
+
+
+# ---- LayerNormGRUCell: math parity with the reference cell (models.py:396-403) ----
+
+
+def _ref_gru_step(weight, bias, ln_scale, ln_bias, h, x, use_ln=True):
+    """Numpy reimplementation of the reference LayerNormGRUCell forward."""
+    joint = np.concatenate([h, x], -1)
+    proj = joint @ weight + bias
+    if use_ln:
+        mu = proj.mean(-1, keepdims=True)
+        var = proj.var(-1, keepdims=True)
+        proj = (proj - mu) / np.sqrt(var + 1e-5) * ln_scale + ln_bias
+    reset, cand, update = np.split(proj, 3, -1)
+    reset = 1 / (1 + np.exp(-reset))
+    cand = np.tanh(reset * cand)
+    update = 1 / (1 + np.exp(-(update - 1)))
+    return update * cand + (1 - update) * h
+
+
+def test_layernorm_gru_cell_matches_reference_math():
+    cell = LayerNormGRUCell(hidden_size=6, layer_norm=True)
+    h = jnp.asarray(np.random.default_rng(0).normal(size=(3, 6)).astype(np.float32))
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(3, 4)).astype(np.float32))
+    params = cell.init(KEY, h, x)
+    new_h, out = cell.apply(params, h, x)
+    np.testing.assert_allclose(new_h, out)
+
+    dense = params["params"]["Dense_0"]
+    ln = params["params"]["LayerNorm_0"]["LayerNorm_0"]
+    expected = _ref_gru_step(
+        np.asarray(dense["kernel"]),
+        np.asarray(dense["bias"]),
+        np.asarray(ln["scale"]),
+        np.asarray(ln["bias"]),
+        np.asarray(h),
+        np.asarray(x),
+    )
+    np.testing.assert_allclose(new_h, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_cell_scan():
+    cell = LayerNormGRUCell(hidden_size=5)
+    h0 = cell.initialize_carry((2,))
+    xs = jnp.ones((7, 2, 3))
+    params = cell.init(KEY, h0, xs[0])
+
+    def step(h, x):
+        return cell.apply(params, h, x)
+
+    h_final, outs = jax.lax.scan(step, h0, xs)
+    assert outs.shape == (7, 2, 5)
+    np.testing.assert_allclose(h_final, outs[-1])
+
+
+# ---- Multi encoder/decoder ----
+
+
+class _DictCNN(nn.Module):
+    @nn.compact
+    def __call__(self, obs):
+        x = obs["rgb"]
+        x = CNN(hidden_channels=(4,), layer_args={"kernel_size": 3, "stride": 2, "padding": 1})(x)
+        return x.reshape(*x.shape[:-3], -1)
+
+
+class _DictMLP(nn.Module):
+    @nn.compact
+    def __call__(self, obs):
+        return MLP(hidden_sizes=(6,))(obs["state"])
+
+
+def test_multi_encoder_concat():
+    enc = MultiEncoder(cnn_encoder=_DictCNN(), mlp_encoder=_DictMLP())
+    obs = {"rgb": jnp.ones((2, 8, 8, 3)), "state": jnp.ones((2, 5))}
+    out = enc.apply(enc.init(KEY, obs), obs)
+    assert out.shape == (2, 4 * 4 * 4 + 6)
+
+
+def test_multi_encoder_single():
+    enc = MultiEncoder(mlp_encoder=_DictMLP())
+    obs = {"state": jnp.ones((2, 5))}
+    out = enc.apply(enc.init(KEY, obs), obs)
+    assert out.shape == (2, 6)
+
+
+def test_multi_encoder_requires_one():
+    with pytest.raises(ValueError):
+        MultiEncoder()
+
+
+class _SplitDecoder(nn.Module):
+    key: str
+    dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        return {self.key: MLP(hidden_sizes=(self.dim,))(x)}
+
+
+def test_multi_decoder_merges_dicts():
+    dec = MultiDecoder(cnn_decoder=_SplitDecoder(key="rgb", dim=4), mlp_decoder=_SplitDecoder(key="state", dim=2))
+    x = jnp.ones((2, 8))
+    out = dec.apply(dec.init(KEY, x), x)
+    assert set(out.keys()) == {"rgb", "state"}
+    assert out["rgb"].shape == (2, 4) and out["state"].shape == (2, 2)
+
+
+def test_get_activation_accepts_torch_paths():
+    assert get_activation("torch.nn.SiLU") is jax.nn.silu
+    assert get_activation(None)(jnp.asarray(2.0)) == 2.0
+    with pytest.raises(ValueError):
+        get_activation("nope")

@@ -1,0 +1,187 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.stats
+
+from sheeprl_tpu.ops.distributions import (
+    Bernoulli,
+    Categorical,
+    Independent,
+    MSEDistribution,
+    Normal,
+    OneHotCategorical,
+    OneHotCategoricalStraightThrough,
+    SymlogDistribution,
+    TanhNormal,
+    TruncatedNormal,
+    TwoHotEncodingDistribution,
+    kl_divergence,
+)
+from sheeprl_tpu.ops.math import symexp, symlog
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_normal_log_prob_matches_scipy():
+    d = Normal(jnp.asarray(1.5), jnp.asarray(2.0))
+    xs = np.linspace(-3, 5, 7)
+    np.testing.assert_allclose(
+        [float(d.log_prob(jnp.asarray(x))) for x in xs],
+        scipy.stats.norm.logpdf(xs, 1.5, 2.0),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(float(d.entropy()), scipy.stats.norm.entropy(1.5, 2.0), rtol=1e-6)
+
+
+def test_independent_sums_event_dims():
+    d = Independent(Normal(jnp.zeros((4, 3)), jnp.ones((4, 3))), 1)
+    lp = d.log_prob(jnp.zeros((4, 3)))
+    assert lp.shape == (4,)
+    np.testing.assert_allclose(lp, 3 * scipy.stats.norm.logpdf(0.0), rtol=1e-6)
+    assert d.entropy().shape == (4,)
+
+
+def test_truncated_normal_matches_scipy():
+    loc, scale, low, high = 0.3, 0.7, -1.0, 1.0
+    a, b = (low - loc) / scale, (high - loc) / scale
+    ref = scipy.stats.truncnorm(a, b, loc=loc, scale=scale)
+    d = TruncatedNormal(jnp.asarray(loc), jnp.asarray(scale), jnp.asarray(low), jnp.asarray(high))
+    np.testing.assert_allclose(float(d.mean), ref.mean(), rtol=1e-5)
+    np.testing.assert_allclose(float(d.variance), ref.var(), rtol=1e-4)
+    np.testing.assert_allclose(float(d.entropy()), ref.entropy(), rtol=1e-4)
+    xs = np.asarray([-0.9, -0.2, 0.0, 0.5, 0.95])
+    np.testing.assert_allclose(
+        [float(d.log_prob(jnp.asarray(x))) for x in xs], ref.logpdf(xs), rtol=5e-4
+    )
+    samples = d.rsample(KEY, (20000,))
+    assert float(samples.min()) >= low and float(samples.max()) <= high
+    np.testing.assert_allclose(float(samples.mean()), ref.mean(), atol=0.02)
+
+
+def test_truncated_normal_rsample_grads():
+    def f(loc):
+        d = TruncatedNormal(loc, jnp.asarray(0.5), jnp.asarray(-1.0), jnp.asarray(1.0))
+        return d.rsample(KEY, (256,)).mean()
+
+    g = jax.grad(f)(jnp.asarray(0.0))
+    assert np.isfinite(float(g)) and float(g) > 0.0
+
+
+def test_tanh_normal_log_prob_consistency():
+    d = TanhNormal(jnp.asarray([0.2, -0.4]), jnp.asarray([0.5, 0.3]))
+    a, lp = d.rsample_and_log_prob(KEY)
+    assert np.all(np.abs(np.asarray(a)) < 1.0)
+    np.testing.assert_allclose(lp, d.log_prob(a), rtol=1e-4, atol=1e-5)
+
+
+def test_onehot_categorical():
+    logits = jnp.asarray([[2.0, 0.5, -1.0], [0.0, 0.0, 0.0]])
+    d = OneHotCategorical(logits)
+    s = d.sample(KEY)
+    assert s.shape == (2, 3)
+    np.testing.assert_allclose(s.sum(-1), 1.0)
+    assert d.mode[0].argmax() == 0
+    # log_prob of one-hot == log softmax at that index
+    lp = d.log_prob(jax.nn.one_hot(jnp.asarray([0, 2]), 3))
+    np.testing.assert_allclose(lp, jax.nn.log_softmax(logits)[jnp.arange(2), jnp.asarray([0, 2])], rtol=1e-6)
+    # entropy of uniform = log(3)
+    np.testing.assert_allclose(float(d.entropy()[1]), np.log(3), rtol=1e-4)
+
+
+def test_straight_through_gradient():
+    def f(logits):
+        d = OneHotCategoricalStraightThrough(logits)
+        sample = d.rsample(KEY)
+        return (sample * jnp.asarray([1.0, 2.0, 3.0])).sum()
+
+    g = jax.grad(f)(jnp.asarray([0.1, 0.2, 0.3]))
+    # gradient flows through probs (softmax jacobian), not the hard sample
+    assert np.any(np.asarray(g) != 0.0)
+    np.testing.assert_allclose(float(np.sum(g)), 0.0, atol=1e-6)  # softmax jacobian rows sum to 0
+
+
+def test_categorical_sample_log_prob():
+    logits = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+    d = Categorical(logits)
+    samples = d.sample(KEY, (5000,))
+    # empirical distribution close to softmax
+    freq = np.bincount(np.asarray(samples), minlength=4) / 5000
+    np.testing.assert_allclose(freq, jax.nn.softmax(logits), atol=0.02)
+    np.testing.assert_allclose(d.log_prob(jnp.asarray(2)), jax.nn.log_softmax(logits)[2], rtol=1e-6)
+
+
+def test_kl_onehot_pair_zero_and_positive():
+    p = OneHotCategorical(jnp.asarray([1.0, 2.0, 0.0]))
+    np.testing.assert_allclose(float(kl_divergence(p, p)), 0.0, atol=1e-6)
+    q = OneHotCategorical(jnp.asarray([0.0, 0.0, 0.0]))
+    assert float(kl_divergence(p, q)) > 0.0
+
+
+def test_kl_normal_matches_closed_form():
+    p = Normal(jnp.asarray(0.0), jnp.asarray(1.0))
+    q = Normal(jnp.asarray(1.0), jnp.asarray(2.0))
+    expected = np.log(2.0) + (1.0 + 1.0) / (2 * 4.0) - 0.5
+    np.testing.assert_allclose(float(kl_divergence(p, q)), expected, rtol=1e-5)
+
+
+def test_symlog_distribution():
+    mode = jnp.asarray([[0.5, -0.2]])
+    d = SymlogDistribution(mode, dims=1)
+    np.testing.assert_allclose(d.mean, symexp(mode), rtol=1e-6)
+    x = symexp(mode)  # exact prediction -> distance < tol -> log_prob 0
+    np.testing.assert_allclose(np.asarray(d.log_prob(x)).item(), 0.0, atol=1e-6)
+    x2 = symexp(mode + 1.0)
+    np.testing.assert_allclose(np.asarray(d.log_prob(x2)).item(), -2.0, rtol=1e-4)
+
+
+def test_mse_distribution():
+    mode = jnp.asarray([[1.0, 2.0]])
+    d = MSEDistribution(mode, dims=1)
+    np.testing.assert_allclose(np.asarray(d.log_prob(jnp.asarray([[0.0, 0.0]]))).item(), -5.0, rtol=1e-6)
+
+
+def test_two_hot_distribution_mean_and_log_prob():
+    n = 255
+    logits = jnp.zeros((4, n))
+    d = TwoHotEncodingDistribution(logits, dims=1)
+    # uniform logits -> symmetric support -> mean 0
+    np.testing.assert_allclose(d.mean, np.zeros((4, 1)), atol=1e-4)
+    # log_prob is cross-entropy: for uniform logits = -log(n) * total weight
+    lp = d.log_prob(jnp.asarray([[0.0], [1.0], [-3.0], [15.0]]))
+    np.testing.assert_allclose(lp, np.full((4,), -np.log(n)), rtol=1e-5)
+
+
+def test_two_hot_distribution_peaked_mean():
+    n = 255
+    target = 7.3
+    # build logits strongly peaked at the two-hot encoding of symlog(target)
+    bins = np.linspace(-20, 20, n)
+    t = float(symlog(jnp.asarray(target)))
+    idx = int(np.searchsorted(bins, t))
+    logits = np.full((1, n), -30.0)
+    logits[0, idx - 1 : idx + 1] = 10.0
+    d = TwoHotEncodingDistribution(jnp.asarray(logits), dims=1)
+    assert abs(np.asarray(d.mean).item() - target) < 0.5
+
+
+def test_bernoulli_safe_mode():
+    d = Bernoulli(jnp.asarray([2.0, -3.0, 0.0]))
+    np.testing.assert_allclose(d.mode, [1.0, 0.0, 0.0])
+    # log_prob matches scipy bernoulli at p
+    p = float(jax.nn.sigmoid(jnp.asarray(2.0)))
+    np.testing.assert_allclose(float(d.log_prob(jnp.asarray([1.0, 0.0, 1.0]))[0]), np.log(p), rtol=1e-4)
+    s = d.sample(KEY, (1000,))
+    np.testing.assert_allclose(s.mean(0), jax.nn.sigmoid(d.logits), atol=0.05)
+
+
+def test_distributions_jittable():
+    @jax.jit
+    def run(key, logits):
+        d = OneHotCategoricalStraightThrough(logits)
+        s = d.rsample(key)
+        return s, d.entropy(), kl_divergence(d, OneHotCategorical(jnp.zeros_like(logits)))
+
+    s, ent, kl = run(KEY, jnp.asarray([[1.0, 2.0, 3.0]]))
+    assert s.shape == (1, 3)
+    assert np.all(np.isfinite(np.asarray(ent))) and np.all(np.isfinite(np.asarray(kl)))

@@ -1,0 +1,174 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.ops.math import (
+    compute_lambda_values,
+    gae,
+    init_moments,
+    normalize,
+    symexp,
+    symlog,
+    two_hot_decoder,
+    two_hot_encoder,
+    update_moments,
+)
+
+# ---- two-hot: specs ported from reference tests/test_utils/test_two_hot_*.py ----
+
+
+def test_two_hot_standard_case():
+    result = two_hot_encoder(jnp.asarray(2.3), 5)
+    expected = np.zeros(11)
+    expected[5 + 2] = 0.7
+    expected[5 + 3] = 0.3
+    assert result.shape == (11,)
+    np.testing.assert_allclose(result, expected, atol=1e-6)
+
+
+def test_two_hot_more_buckets():
+    result = two_hot_encoder(jnp.asarray(2.3), 5, 21)
+    expected = np.zeros(21)
+    expected[10 + 4] = 0.4
+    expected[10 + 5] = 0.6
+    np.testing.assert_allclose(result, expected, atol=1e-6)
+
+
+def test_two_hot_batch_case():
+    result = two_hot_encoder(jnp.asarray([[2.3], [3.4]]), 5)
+    expected = np.zeros((2, 11))
+    expected[0, 5 + 2] = 0.7
+    expected[0, 5 + 3] = 0.3
+    expected[1, 5 + 3] = 0.6
+    expected[1, 5 + 4] = 0.4
+    assert result.shape == (2, 11)
+    np.testing.assert_allclose(result, expected, atol=1e-6)
+
+
+def test_two_hot_support_size_1():
+    result = two_hot_encoder(jnp.asarray(2.3), 0)
+    np.testing.assert_allclose(result, [1.0])
+
+
+def test_two_hot_overflow_underflow():
+    up = two_hot_encoder(jnp.asarray(6.1), 5)
+    assert up[10] == 1.0 and up.sum() == 1.0
+    down = two_hot_encoder(jnp.asarray(-6.1), 5)
+    assert down[0] == 1.0 and down.sum() == 1.0
+
+
+def test_two_hot_even_buckets_rejected():
+    with pytest.raises(ValueError):
+        two_hot_encoder(jnp.asarray(1.0), 5, 10)
+    with pytest.raises(ValueError):
+        two_hot_decoder(jnp.zeros(10), 5)
+
+
+def test_two_hot_roundtrip():
+    xs = jnp.asarray([[-4.99], [-1.5], [0.0], [0.25], [4.99]])
+    decoded = two_hot_decoder(two_hot_encoder(xs, 5), 5)
+    np.testing.assert_allclose(decoded, xs, atol=1e-5)
+
+
+def test_two_hot_decoder_cases():
+    t = np.zeros(11)
+    t[5 + 2] = 0.7
+    t[5 + 3] = 0.3
+    np.testing.assert_allclose(two_hot_decoder(jnp.asarray(t), 5), [2.3], atol=1e-6)
+    np.testing.assert_allclose(two_hot_decoder(jnp.asarray([1.0]), 0), [0.0])
+
+
+# ---- symlog ----
+
+
+def test_symlog_roundtrip():
+    x = jnp.asarray([-100.0, -1.0, 0.0, 0.5, 1000.0])
+    np.testing.assert_allclose(symexp(symlog(x)), x, rtol=1e-3)
+    assert float(symlog(jnp.asarray(0.0))) == 0.0
+
+
+# ---- GAE: against a numpy port of the reference recurrence (utils.py:63-100) ----
+
+
+def _ref_gae(rewards, values, dones, next_value, gamma, lam):
+    T = rewards.shape[0]
+    lastgaelam = 0.0
+    not_dones = 1.0 - dones
+    nextvalues = next_value
+    nextnonterminal = not_dones[-1]
+    advantages = np.zeros_like(rewards)
+    for t in reversed(range(T)):
+        if t < T - 1:
+            nextnonterminal = not_dones[t]
+            nextvalues = values[t + 1]
+        delta = rewards[t] + nextvalues * nextnonterminal * gamma - values[t]
+        advantages[t] = lastgaelam = delta + nextnonterminal * lastgaelam * gamma * lam
+    return advantages + values, advantages
+
+
+def test_gae_matches_reference_recurrence():
+    rng = np.random.default_rng(0)
+    T, B = 16, 4
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    dones = (rng.random((T, B)) < 0.15).astype(np.float32)
+    next_value = rng.normal(size=(B,)).astype(np.float32)
+    ref_ret, ref_adv = _ref_gae(rewards, values, dones, next_value, 0.99, 0.95)
+    ret, adv = jax.jit(gae, static_argnums=(4, 5))(rewards, values, dones, next_value, 0.99, 0.95)
+    np.testing.assert_allclose(adv, ref_adv, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ret, ref_ret, rtol=1e-4, atol=1e-5)
+
+
+# ---- lambda values: against the reference python loop (dreamer_v3/utils.py:66-77) ----
+
+
+def test_lambda_values_match_reference():
+    rng = np.random.default_rng(1)
+    T, B = 15, 3
+    rewards = rng.normal(size=(T, B, 1)).astype(np.float32)
+    values = rng.normal(size=(T, B, 1)).astype(np.float32)
+    continues = (rng.random((T, B, 1)) < 0.9).astype(np.float32) * 0.997
+
+    vals = [values[-1]]
+    interm = rewards + continues * values * (1 - 0.95)
+    for t in reversed(range(T)):
+        vals.append(interm[t] + continues[t] * 0.95 * vals[-1])
+    expected = np.stack(list(reversed(vals))[:-1])
+
+    got = jax.jit(compute_lambda_values)(rewards, values, continues, 0.95)
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+# ---- normalize ----
+
+
+def test_normalize_unmasked():
+    x = jnp.asarray(np.random.default_rng(2).normal(5, 3, size=(128,)).astype(np.float32))
+    y = normalize(x)
+    assert abs(float(y.mean())) < 1e-5
+    assert abs(float(y.std(ddof=1)) - 1.0) < 1e-3
+
+
+def test_normalize_masked():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(64,)).astype(np.float32)
+    mask = rng.random(64) < 0.5
+    y = normalize(jnp.asarray(x), mask=jnp.asarray(mask))
+    sel = np.asarray(y)[mask]
+    np.testing.assert_allclose(sel.mean(), 0.0, atol=1e-5)
+    np.testing.assert_allclose(sel.std(ddof=1), 1.0, atol=1e-3)
+
+
+# ---- moments ----
+
+
+def test_moments_ema():
+    state = init_moments()
+    x = jnp.linspace(0.0, 100.0, 1000)
+    state, (low, invscale) = update_moments(state, x, decay=0.0)
+    np.testing.assert_allclose(float(low), 5.0, atol=0.2)
+    np.testing.assert_allclose(float(invscale), 90.0, atol=0.5)
+    # decay keeps history
+    state2, (low2, _) = update_moments(state, x, decay=0.99)
+    assert abs(float(low2) - float(low)) < 0.1
