@@ -1,0 +1,21 @@
+"""Data plane: host replay buffers + device prefetch (reference: sheeprl/data)."""
+
+from sheeprl_tpu.data.buffers import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+    to_device,
+)
+from sheeprl_tpu.data.memmap import MemmapArray
+from sheeprl_tpu.data.prefetch import DevicePrefetcher
+
+__all__ = [
+    "DevicePrefetcher",
+    "EnvIndependentReplayBuffer",
+    "EpisodeBuffer",
+    "MemmapArray",
+    "ReplayBuffer",
+    "SequentialReplayBuffer",
+    "to_device",
+]

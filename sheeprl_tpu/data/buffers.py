@@ -1,0 +1,755 @@
+"""Host-side replay buffers (reference: sheeprl/data/buffers.py:20-1180).
+
+Design (TPU-first):
+
+- Storage is a dict of ``[buffer_size, n_envs, ...]`` numpy arrays on the
+  host (optionally disk-backed via :class:`MemmapArray`) — replay data never
+  lives in HBM; only sampled batches cross to the device.
+- ``sample()`` returns numpy; ``sample_device()`` stages the batch into HBM
+  with ``jax.device_put`` (optionally under a ``Sharding`` so a data-parallel
+  batch lands pre-sharded across the mesh, one transfer per shard over PCIe).
+  This replaces the reference's ``sample_tensors(device=...)`` torch path.
+- RNGs are seedable (``seed=``) for reproducible runs; the reference uses an
+  unseeded ``np.random.default_rng()``.
+
+Shapes follow the reference contract exactly so algorithms and tests map 1:1:
+``add`` takes ``[seq_len, n_envs, ...]``; ``ReplayBuffer.sample`` returns
+``[n_samples, batch_size, ...]``; sequential/episode buffers return
+``[n_samples, seq_len, batch_size, ...]``.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import uuid
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Type
+
+import numpy as np
+
+from sheeprl_tpu.data.memmap import MemmapArray, _ALLOWED_MODES
+
+
+def _validate_add_data(data: Dict[str, np.ndarray]) -> None:
+    if not isinstance(data, dict):
+        raise ValueError(f"'data' must be a dictionary of numpy arrays, got {type(data)}")
+    shape0 = None
+    key0 = None
+    for k, v in data.items():
+        if not isinstance(v, (np.ndarray, MemmapArray)):
+            raise ValueError(f"'data' must contain numpy arrays; key {k!r} has type {type(v)}")
+        if v.ndim < 2:
+            raise RuntimeError(
+                f"'data' arrays must be [sequence_length, n_envs, ...]; shape of {k!r} is {v.shape}"
+            )
+        if shape0 is None:
+            shape0, key0 = v.shape[:2], k
+        elif v.shape[:2] != shape0:
+            raise RuntimeError(
+                f"arrays must agree in the first 2 dims: {key0!r} has {shape0}, {k!r} has {v.shape[:2]}"
+            )
+
+
+def to_device(
+    samples: Dict[str, np.ndarray],
+    dtype: Any = None,
+    sharding: Any = None,
+) -> Dict[str, Any]:
+    """Stage a sampled host batch into device HBM.
+
+    With ``sharding`` (a ``jax.sharding.Sharding``) each array is placed
+    pre-sharded across the mesh — the TPU equivalent of the reference's
+    per-rank ``sample_tensors(device=fabric.device)`` (buffers.py:291-326),
+    except one call feeds every replica. ``dtype=None`` keeps host dtypes.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    out: Dict[str, Any] = {}
+    for k, v in samples.items():
+        arr = np.asarray(v)
+        if dtype is not None:
+            arr = arr.astype(dtype, copy=False)
+        out[k] = jax.device_put(arr, sharding) if sharding is not None else jnp.asarray(arr)
+    return out
+
+
+class ReplayBuffer:
+    """Uniform-sampling circular buffer over ``[buffer_size, n_envs, ...]``
+    arrays (reference buffers.py:20-360)."""
+
+    batch_axis: int = 1
+
+    def __init__(
+        self,
+        buffer_size: int,
+        n_envs: int = 1,
+        obs_keys: Sequence[str] = ("observations",),
+        memmap: bool = False,
+        memmap_dir: str | os.PathLike | None = None,
+        memmap_mode: str = "r+",
+        seed: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        if buffer_size <= 0:
+            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+        if n_envs <= 0:
+            raise ValueError(f"The number of environments must be greater than zero, got: {n_envs}")
+        if memmap:
+            if memmap_mode not in _ALLOWED_MODES:
+                raise ValueError(f"Accepted values for memmap_mode are {_ALLOWED_MODES}, got {memmap_mode!r}")
+            if memmap_dir is None:
+                raise ValueError(
+                    "The buffer is memory-mapped but 'memmap_dir' is None. Set it to a known directory."
+                )
+            memmap_dir = Path(memmap_dir)
+            memmap_dir.mkdir(parents=True, exist_ok=True)
+        self._buffer_size = buffer_size
+        self._n_envs = n_envs
+        self._obs_keys = tuple(obs_keys)
+        self._memmap = memmap
+        self._memmap_dir = memmap_dir
+        self._memmap_mode = memmap_mode
+        self._buf: Dict[str, np.ndarray | MemmapArray] = {}
+        self._pos = 0
+        self._full = False
+        self._rng = np.random.default_rng(seed)
+
+    # ------------------------------------------------------------------ #
+    @property
+    def buffer(self) -> Dict[str, np.ndarray]:
+        return self._buf
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def full(self) -> bool:
+        return self._full
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def empty(self) -> bool:
+        return len(self._buf) == 0
+
+    @property
+    def is_memmap(self) -> bool:
+        return self._memmap
+
+    def __len__(self) -> int:
+        return self._buffer_size
+
+    def seed(self, seed: Optional[int]) -> None:
+        self._rng = np.random.default_rng(seed)
+
+    # ------------------------------------------------------------------ #
+    def _allocate(self, key: str, trailing_shape: Sequence[int], dtype: np.dtype) -> np.ndarray | MemmapArray:
+        shape = (self._buffer_size, self._n_envs, *trailing_shape)
+        if self._memmap:
+            return MemmapArray(
+                shape=shape,
+                dtype=dtype,
+                mode=self._memmap_mode,
+                filename=Path(self._memmap_dir) / f"{key}.memmap",
+            )
+        return np.empty(shape, dtype=dtype)
+
+    def add(self, data: "ReplayBuffer" | Dict[str, np.ndarray], validate_args: bool = False) -> None:
+        """Append ``[seq_len, n_envs, ...]`` data at the cursor, wrapping and
+        overwriting the oldest entries (reference buffers.py:145-221)."""
+        if isinstance(data, ReplayBuffer):
+            data = data.buffer
+        if validate_args:
+            _validate_add_data(data)
+        data_len = next(iter(data.values())).shape[0]
+        if data_len > self._buffer_size:
+            # only the last buffer_size rows can survive; keep the cursor
+            # position consistent with having written everything
+            data = {k: v[-self._buffer_size :] for k, v in data.items()}
+            effective_len = self._buffer_size
+        else:
+            effective_len = data_len
+        start = self._pos if effective_len == data_len else (self._pos + data_len) % self._buffer_size
+        idxes = (start + np.arange(effective_len)) % self._buffer_size
+        for k, v in data.items():
+            if k not in self._buf:
+                self._buf[k] = self._allocate(k, v.shape[2:], np.asarray(v).dtype)
+            self._buf[k][idxes] = v[-effective_len:]
+        if self._pos + data_len >= self._buffer_size:
+            self._full = True
+        self._pos = (self._pos + data_len) % self._buffer_size
+
+    # ------------------------------------------------------------------ #
+    def _valid_idxes(self, sample_next_obs: bool) -> np.ndarray:
+        """Start indices whose transition does not straddle the write cursor
+        (reference buffers.py:244-264 validity rules)."""
+        if not self._full and self._pos == 0:
+            raise ValueError(
+                "No sample has been added to the buffer. Please add at least one sample calling 'self.add()'"
+            )
+        if self._full:
+            end = self._pos - 1 if sample_next_obs else self._pos
+            second_end = self._buffer_size if end >= 0 else self._buffer_size + end
+            valid = np.concatenate(
+                [np.arange(0, max(end, 0)), np.arange(self._pos, second_end)]
+            ).astype(np.intp)
+            if len(valid) == 0:
+                raise RuntimeError(
+                    "You want to sample the next observations, but every stored transition straddles "
+                    "the write cursor. Make sure that at least two samples are added."
+                )
+            return valid
+        end = self._pos - 1 if sample_next_obs else self._pos
+        if end == 0:
+            raise RuntimeError(
+                "You want to sample the next observations, but only one sample has been added to the buffer. "
+                "Make sure that at least two samples are added."
+            )
+        return np.arange(0, end, dtype=np.intp)
+
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        """Uniform sample, shape ``[n_samples, batch_size, ...]``."""
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0")
+        if self.empty:
+            raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
+        valid = self._valid_idxes(sample_next_obs)
+        batch_idxes = valid[self._rng.integers(0, len(valid), size=(batch_size * n_samples,), dtype=np.intp)]
+        samples = self._gather(batch_idxes, sample_next_obs=sample_next_obs, clone=clone)
+        return {k: v.reshape(n_samples, batch_size, *v.shape[1:]) for k, v in samples.items()}
+
+    def _gather(
+        self, batch_idxes: np.ndarray, sample_next_obs: bool = False, clone: bool = False
+    ) -> Dict[str, np.ndarray]:
+        env_idxes = self._rng.integers(0, self._n_envs, size=(len(batch_idxes),), dtype=np.intp)
+        out: Dict[str, np.ndarray] = {}
+        for k, v in self._buf.items():
+            arr = np.asarray(v)
+            out[k] = arr[batch_idxes, env_idxes]
+            if clone:
+                out[k] = out[k].copy()
+            if sample_next_obs and k in self._obs_keys:
+                out[f"next_{k}"] = arr[(batch_idxes + 1) % self._buffer_size, env_idxes]
+                if clone:
+                    out[f"next_{k}"] = out[f"next_{k}"].copy()
+        return out
+
+    def sample_device(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        n_samples: int = 1,
+        dtype: Any = None,
+        sharding: Any = None,
+        **kwargs: Any,
+    ) -> Dict[str, Any]:
+        """Sample and stage to HBM (replaces reference ``sample_tensors``)."""
+        samples = self.sample(batch_size, sample_next_obs=sample_next_obs, n_samples=n_samples, **kwargs)
+        return to_device(samples, dtype=dtype, sharding=sharding)
+
+    # ------------------------------------------------------------------ #
+    def __getitem__(self, key: str) -> np.ndarray | MemmapArray:
+        if not isinstance(key, str):
+            raise TypeError("'key' must be a string")
+        if self.empty:
+            raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
+        return self._buf.get(key)
+
+    def __setitem__(self, key: str, value: np.ndarray | MemmapArray) -> None:
+        if not isinstance(value, (np.ndarray, MemmapArray)):
+            raise ValueError(f"the value must be a np.ndarray or MemmapArray, got {type(value)}")
+        if self.empty:
+            raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
+        if tuple(value.shape[:2]) != (self._buffer_size, self._n_envs):
+            raise RuntimeError(
+                f"'value' must be [buffer_size, n_envs, ...]; got shape {value.shape} with "
+                f"buffer_size={self._buffer_size}, n_envs={self._n_envs}"
+            )
+        if self._memmap:
+            filename = value.filename if isinstance(value, MemmapArray) else Path(self._memmap_dir) / f"{key}.memmap"
+            self._buf[key] = MemmapArray.from_array(value, mode=self._memmap_mode, filename=filename)
+        else:
+            self._buf[key] = np.copy(np.asarray(value))
+
+    # checkpointable host state (cursor + fullness; arrays are saved separately)
+    def state_dict(self) -> Dict[str, Any]:
+        return {"pos": self._pos, "full": self._full}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> "ReplayBuffer":
+        self._pos = int(state["pos"])
+        self._full = bool(state["full"])
+        return self
+
+
+class SequentialReplayBuffer(ReplayBuffer):
+    """Samples contiguous length-L windows ignoring episode bounds, returning
+    ``[n_samples, seq_len, batch_size, ...]`` (reference buffers.py:363-526)."""
+
+    batch_axis: int = 2
+
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        sequence_length: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0")
+        if self.empty:
+            raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
+        if not self._full and self._pos == 0:
+            raise ValueError(
+                "No sample has been added to the buffer. Please add at least one sample calling 'self.add()'"
+            )
+        # with next-obs sampling the window effectively spans L+1 slots (the
+        # last element's successor must also be valid)
+        span = sequence_length + 1 if sample_next_obs else sequence_length
+        if not self._full and self._pos - span + 1 < 1:
+            raise ValueError(f"Cannot sample a sequence of length {sequence_length}. Data added so far: {self._pos}")
+        if self._full and span > self._buffer_size:
+            raise ValueError(
+                f"The sequence length ({sequence_length}) is greater than the buffer size ({self._buffer_size})"
+            )
+        batch_dim = batch_size * n_samples
+        if self._full:
+            # valid starts: sequences must not cross the write cursor
+            first_end = self._pos - span + 1
+            second_end = self._buffer_size if first_end >= 0 else self._buffer_size + first_end
+            valid = np.concatenate(
+                [np.arange(0, max(first_end, 0)), np.arange(self._pos, second_end)]
+            ).astype(np.intp)
+            if len(valid) == 0:
+                raise RuntimeError(
+                    f"No valid sequence of length {sequence_length} exists that does not straddle the write cursor."
+                )
+            start_idxes = valid[self._rng.integers(0, len(valid), size=(batch_dim,), dtype=np.intp)]
+        else:
+            start_idxes = self._rng.integers(0, self._pos - span + 1, size=(batch_dim,), dtype=np.intp)
+        offsets = np.arange(sequence_length, dtype=np.intp)
+        idxes = (start_idxes[:, None] + offsets[None, :]) % self._buffer_size  # [batch_dim, L]
+        # one env per sequence
+        env_idxes = self._rng.integers(0, self._n_envs, size=(batch_dim,), dtype=np.intp)
+        env_idxes_tiled = np.repeat(env_idxes[:, None], sequence_length, axis=1)
+
+        out: Dict[str, np.ndarray] = {}
+        for k, v in self._buf.items():
+            arr = np.asarray(v)
+            g = arr[idxes, env_idxes_tiled]  # [batch_dim, L, ...]
+            g = g.reshape(n_samples, batch_size, sequence_length, *g.shape[2:]).swapaxes(1, 2)
+            out[k] = g.copy() if clone else g
+            if sample_next_obs and k in self._obs_keys:
+                nxt = arr[(idxes + 1) % self._buffer_size, env_idxes_tiled]
+                nxt = nxt.reshape(n_samples, batch_size, sequence_length, *nxt.shape[2:]).swapaxes(1, 2)
+                out[f"next_{k}"] = nxt.copy() if clone else nxt
+        return out
+
+
+class EnvIndependentReplayBuffer:
+    """One sub-buffer per environment with independent cursors — needed when
+    envs can restart at different points (reference buffers.py:529-743)."""
+
+    def __init__(
+        self,
+        buffer_size: int,
+        n_envs: int = 1,
+        obs_keys: Sequence[str] = ("observations",),
+        memmap: bool = False,
+        memmap_dir: str | os.PathLike | None = None,
+        memmap_mode: str = "r+",
+        buffer_cls: Type[ReplayBuffer] = ReplayBuffer,
+        seed: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        if buffer_size <= 0:
+            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+        if n_envs <= 0:
+            raise ValueError(f"The number of environments must be greater than zero, got: {n_envs}")
+        if memmap:
+            if memmap_mode not in _ALLOWED_MODES:
+                raise ValueError(f"Accepted values for memmap_mode are {_ALLOWED_MODES}, got {memmap_mode!r}")
+            if memmap_dir is None:
+                raise ValueError(
+                    "The buffer is memory-mapped but 'memmap_dir' is None. Set it to a known directory."
+                )
+            memmap_dir = Path(memmap_dir)
+        self._buf: List[ReplayBuffer] = [
+            buffer_cls(
+                buffer_size=buffer_size,
+                n_envs=1,
+                obs_keys=obs_keys,
+                memmap=memmap,
+                memmap_dir=(memmap_dir / f"env_{i}") if memmap else None,
+                memmap_mode=memmap_mode,
+                seed=None if seed is None else seed + i,
+                **kwargs,
+            )
+            for i in range(n_envs)
+        ]
+        self._buffer_size = buffer_size
+        self._n_envs = n_envs
+        self._rng = np.random.default_rng(seed)
+        self._concat_along_axis = buffer_cls.batch_axis
+
+    @property
+    def buffer(self) -> Sequence[ReplayBuffer]:
+        return tuple(self._buf)
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def full(self) -> Sequence[bool]:
+        return tuple(b.full for b in self._buf)
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def empty(self) -> Sequence[bool]:
+        return tuple(b.empty for b in self._buf)
+
+    @property
+    def is_memmap(self) -> Sequence[bool]:
+        return tuple(b.is_memmap for b in self._buf)
+
+    def __len__(self) -> int:
+        return self._buffer_size
+
+    def add(
+        self,
+        data: "ReplayBuffer" | Dict[str, np.ndarray],
+        indices: Optional[Sequence[int]] = None,
+        validate_args: bool = False,
+    ) -> None:
+        if isinstance(data, ReplayBuffer):
+            data = data.buffer
+        if indices is None:
+            indices = tuple(range(self._n_envs))
+        elif len(indices) != next(iter(data.values())).shape[1]:
+            raise ValueError(
+                f"The length of 'indices' ({len(indices)}) must be equal to the second dimension of the "
+                f"arrays in 'data' ({next(iter(data.values())).shape[1]})"
+            )
+        for data_idx, env_idx in enumerate(indices):
+            env_data = {k: v[:, data_idx : data_idx + 1] for k, v in data.items()}
+            self._buf[env_idx].add(env_data, validate_args=validate_args)
+
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0")
+        # multinomial split of the batch across envs, concat on the batch axis
+        bs_per_buf = np.bincount(self._rng.integers(0, self._n_envs, (batch_size,)), minlength=self._n_envs)
+        per_buf = [
+            b.sample(batch_size=bs, sample_next_obs=sample_next_obs, clone=clone, n_samples=n_samples, **kwargs)
+            for b, bs in zip(self._buf, bs_per_buf)
+            if bs > 0
+        ]
+        return {
+            k: np.concatenate([s[k] for s in per_buf], axis=self._concat_along_axis) for k in per_buf[0].keys()
+        }
+
+    def sample_device(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        n_samples: int = 1,
+        dtype: Any = None,
+        sharding: Any = None,
+        **kwargs: Any,
+    ) -> Dict[str, Any]:
+        samples = self.sample(batch_size, sample_next_obs=sample_next_obs, n_samples=n_samples, **kwargs)
+        return to_device(samples, dtype=dtype, sharding=sharding)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"buffers": [b.state_dict() for b in self._buf]}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> "EnvIndependentReplayBuffer":
+        for b, s in zip(self._buf, state["buffers"]):
+            b.load_state_dict(s)
+        return self
+
+
+class EpisodeBuffer:
+    """Stores whole episodes; samples length-L windows from within episodes
+    (reference buffers.py:746-1155). Used by Dreamer-V1/V2 configs."""
+
+    batch_axis: int = 2
+
+    def __init__(
+        self,
+        buffer_size: int,
+        minimum_episode_length: int,
+        n_envs: int = 1,
+        obs_keys: Sequence[str] = ("observations",),
+        prioritize_ends: bool = False,
+        memmap: bool = False,
+        memmap_dir: str | os.PathLike | None = None,
+        memmap_mode: str = "r+",
+        seed: Optional[int] = None,
+    ) -> None:
+        if buffer_size <= 0:
+            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+        if minimum_episode_length <= 0:
+            raise ValueError(f"The sequence length must be greater than zero, got: {minimum_episode_length}")
+        if buffer_size < minimum_episode_length:
+            raise ValueError(
+                "The sequence length must be lower than the buffer size, "
+                f"got: bs = {buffer_size} and sl = {minimum_episode_length}"
+            )
+        self._n_envs = n_envs
+        self._obs_keys = tuple(obs_keys)
+        self._buffer_size = buffer_size
+        self._minimum_episode_length = minimum_episode_length
+        self._prioritize_ends = prioritize_ends
+        self._open_episodes: List[List[Dict[str, np.ndarray]]] = [[] for _ in range(n_envs)]
+        self._cum_lengths: List[int] = []
+        self._buf: List[Dict[str, np.ndarray | MemmapArray]] = []
+        self._rng = np.random.default_rng(seed)
+        self._memmap = memmap
+        self._memmap_dir = memmap_dir
+        self._memmap_mode = memmap_mode
+        if memmap:
+            if memmap_mode not in _ALLOWED_MODES:
+                raise ValueError(f"Accepted values for memmap_mode are {_ALLOWED_MODES}, got {memmap_mode!r}")
+            if memmap_dir is None:
+                raise ValueError(
+                    "The buffer is memory-mapped but 'memmap_dir' is None. Set it to a known directory."
+                )
+            self._memmap_dir = Path(memmap_dir)
+            self._memmap_dir.mkdir(parents=True, exist_ok=True)
+
+    # ------------------------------------------------------------------ #
+    @property
+    def prioritize_ends(self) -> bool:
+        return self._prioritize_ends
+
+    @prioritize_ends.setter
+    def prioritize_ends(self, value: bool) -> None:
+        self._prioritize_ends = value
+
+    @property
+    def buffer(self) -> Sequence[Dict[str, np.ndarray | MemmapArray]]:
+        return self._buf
+
+    @property
+    def obs_keys(self) -> Sequence[str]:
+        return self._obs_keys
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def minimum_episode_length(self) -> int:
+        return self._minimum_episode_length
+
+    @property
+    def is_memmap(self) -> bool:
+        return self._memmap
+
+    @property
+    def full(self) -> bool:
+        return self._cum_lengths[-1] + self._minimum_episode_length > self._buffer_size if self._buf else False
+
+    def __len__(self) -> int:
+        return self._cum_lengths[-1] if self._buf else 0
+
+    # ------------------------------------------------------------------ #
+    def add(
+        self,
+        data: "ReplayBuffer" | Dict[str, np.ndarray],
+        env_idxes: Sequence[int] | None = None,
+        validate_args: bool = False,
+    ) -> None:
+        """Split ``[seq_len, n_envs, ...]`` data on terminated|truncated and
+        route chunks into per-env open episodes; a chunk ending in done closes
+        and stores the episode (reference buffers.py:875-969)."""
+        if isinstance(data, ReplayBuffer):
+            data = data.buffer
+        if validate_args:
+            if data is None:
+                raise ValueError("The data must be not None")
+            _validate_add_data(data)
+            if "terminated" not in data or "truncated" not in data:
+                raise RuntimeError(
+                    f"The episode must contain the `terminated` and the `truncated` keys, got: {list(data.keys())}"
+                )
+            if env_idxes is not None and (np.asarray(env_idxes) >= self._n_envs).any():
+                raise ValueError(
+                    f"The indices of the environment must be integers in [0, {self._n_envs}), given {env_idxes}"
+                )
+        if env_idxes is None:
+            env_idxes = range(self._n_envs)
+        for data_idx, env in enumerate(env_idxes):
+            env_data = {k: v[:, data_idx] for k, v in data.items()}
+            done = np.logical_or(env_data["terminated"], env_data["truncated"]).reshape(-1)
+            ends = done.nonzero()[0].tolist()
+            if not ends:
+                self._open_episodes[env].append(env_data)
+                continue
+            ends.append(len(done))
+            start = 0
+            for stop in ends:
+                chunk = {k: v[start : stop + 1] for k, v in env_data.items()}
+                if len(chunk["terminated"]) > 0:
+                    self._open_episodes[env].append(chunk)
+                start = stop + 1
+                if self._open_episodes[env] and bool(
+                    np.logical_or(
+                        self._open_episodes[env][-1]["terminated"][-1],
+                        self._open_episodes[env][-1]["truncated"][-1],
+                    )
+                ):
+                    self._save_episode(self._open_episodes[env])
+                    self._open_episodes[env] = []
+
+    def _save_episode(self, episode_chunks: Sequence[Dict[str, np.ndarray]]) -> None:
+        if len(episode_chunks) == 0:
+            raise RuntimeError("Invalid episode, an empty sequence is given. You must pass a non-empty sequence.")
+        episode = {
+            k: np.concatenate([chunk[k] for chunk in episode_chunks], axis=0) for k in episode_chunks[0].keys()
+        }
+        ends = np.logical_or(episode["terminated"], episode["truncated"]).reshape(-1)
+        ep_len = ends.shape[0]
+        if len(ends.nonzero()[0]) != 1 or not ends[-1]:
+            raise RuntimeError(f"The episode must contain exactly one done, got: {len(ends.nonzero()[0])}")
+        if ep_len < self._minimum_episode_length:
+            raise RuntimeError(
+                f"Episode too short (at least {self._minimum_episode_length} steps), got: {ep_len} steps"
+            )
+        if ep_len > self._buffer_size:
+            raise RuntimeError(f"Episode too long (at most {self._buffer_size} steps), got: {ep_len} steps")
+
+        # evict oldest episodes until the new one fits
+        if self.full or len(self) + ep_len > self._buffer_size:
+            cum = np.array(self._cum_lengths)
+            keep_from = int(((len(self) - cum + ep_len) <= self._buffer_size).argmax())
+            evicted, self._buf = self._buf[: keep_from + 1], self._buf[keep_from + 1 :]
+            if self._memmap and self._memmap_dir is not None:
+                for ep in evicted:
+                    dirname = os.path.dirname(str(next(iter(ep.values())).filename))
+                    ep.clear()
+                    shutil.rmtree(dirname, ignore_errors=True)
+            cum = cum[keep_from + 1 :] - cum[keep_from]
+            self._cum_lengths = cum.tolist()
+        self._cum_lengths.append(len(self) + ep_len)
+
+        if self._memmap:
+            episode_dir = Path(self._memmap_dir) / f"episode_{uuid.uuid4()}"
+            episode_dir.mkdir(parents=True, exist_ok=True)
+            stored = {}
+            for k, v in episode.items():
+                stored[k] = MemmapArray(
+                    shape=v.shape, dtype=v.dtype, mode=self._memmap_mode, filename=episode_dir / f"{k}.memmap"
+                )
+                stored[k][:] = v
+            self._buf.append(stored)
+        else:
+            self._buf.append(episode)
+
+    # ------------------------------------------------------------------ #
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        n_samples: int = 1,
+        clone: bool = False,
+        sequence_length: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        """Sample ``[n_samples, seq_len, batch_size, ...]`` windows from
+        stored episodes (reference buffers.py:1033-1120). ``prioritize_ends``
+        biases window starts toward episode tails."""
+        if batch_size <= 0:
+            raise ValueError(f"Batch size must be greater than 0, got: {batch_size}")
+        if n_samples <= 0:
+            raise ValueError(f"The number of samples must be greater than 0, got: {n_samples}")
+        ep_lengths = np.array(self._cum_lengths) - np.array([0] + self._cum_lengths[:-1])
+        min_len = sequence_length + 1 if sample_next_obs else sequence_length
+        valid_eps = [ep for ep, L in zip(self._buf, ep_lengths) if L >= min_len]
+        if len(valid_eps) == 0:
+            raise RuntimeError(
+                "No valid episodes has been added to the buffer. Please add at least one episode of length greater "
+                f"than or equal to {sequence_length} calling `self.add()`"
+            )
+        offsets = np.arange(sequence_length, dtype=np.intp)[None, :]
+        n_per_ep = np.bincount(
+            self._rng.integers(0, len(valid_eps), (batch_size * n_samples,)), minlength=len(valid_eps)
+        )
+        chunks: Dict[str, List[np.ndarray]] = {k: [] for k in valid_eps[0].keys()}
+        if sample_next_obs:
+            chunks.update({f"next_{k}": [] for k in self._obs_keys})
+        for i, n in enumerate(n_per_ep):
+            if n == 0:
+                continue
+            ep = valid_eps[i]
+            ep_len = np.asarray(ep["terminated"]).shape[0]
+            if sample_next_obs:
+                ep_len -= 1
+            upper = ep_len - sequence_length + 1
+            if self._prioritize_ends:
+                upper += sequence_length
+            starts = np.minimum(
+                self._rng.integers(0, upper, size=(n, 1)), ep_len - sequence_length
+            ).astype(np.intp)
+            idxes = starts + offsets
+            for k in ep.keys():
+                arr = np.asarray(ep[k])
+                chunks[k].append(arr[idxes.reshape(-1)].reshape(n, sequence_length, *arr.shape[1:]))
+                if sample_next_obs and k in self._obs_keys:
+                    chunks[f"next_{k}"].append(arr[(idxes + 1).reshape(-1)].reshape(n, sequence_length, *arr.shape[1:]))
+        out: Dict[str, np.ndarray] = {}
+        for k, v in chunks.items():
+            if v:
+                stacked = np.concatenate(v, axis=0).reshape(n_samples, batch_size, sequence_length, *v[0].shape[2:])
+                out[k] = np.moveaxis(stacked, 2, 1)
+                if clone:
+                    out[k] = out[k].copy()
+        return out
+
+    def sample_device(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        n_samples: int = 1,
+        sequence_length: int = 1,
+        dtype: Any = None,
+        sharding: Any = None,
+        **kwargs: Any,
+    ) -> Dict[str, Any]:
+        samples = self.sample(
+            batch_size,
+            sample_next_obs=sample_next_obs,
+            n_samples=n_samples,
+            sequence_length=sequence_length,
+            **kwargs,
+        )
+        return to_device(samples, dtype=dtype, sharding=sharding)

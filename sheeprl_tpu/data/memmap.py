@@ -1,0 +1,194 @@
+"""Disk-backed numpy arrays for replay storage.
+
+TPU-native counterpart of the reference's ``sheeprl/utils/memmap.py:22-270``
+(``MemmapArray``). Replay data lives on host disk via ``np.memmap``; only
+sampled batches are staged to device HBM (see ``sheeprl_tpu.data.prefetch``).
+
+Behavioral contract kept from the reference:
+
+- exactly one *owner* per file: the instance that has ownership unlinks the
+  file on garbage collection; ownership moves with ``from_array`` on the same
+  filename and is dropped when pickling (spawn-safe for AsyncVectorEnv
+  workers — reference memmap.py:240-258);
+- assignment through ``array`` validates shape/dtype;
+- ndarray operator mixin + attribute delegation so a MemmapArray can be used
+  wherever an ndarray is expected.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+_ALLOWED_MODES = ("r+", "w+", "c", "copyonwrite", "readwrite", "write")
+
+
+class MemmapArray(np.lib.mixins.NDArrayOperatorsMixin):
+    """An ``np.memmap`` with explicit file ownership and safe pickling."""
+
+    def __init__(
+        self,
+        shape: Tuple[int, ...],
+        dtype: Any = np.float32,
+        mode: str = "r+",
+        filename: str | os.PathLike = "./memmap_array.bin",
+    ) -> None:
+        if mode not in _ALLOWED_MODES:
+            raise ValueError(f"Accepted values for mode are {_ALLOWED_MODES}, got {mode!r}")
+        self._filename = Path(filename).resolve()
+        self._dtype = np.dtype(dtype)
+        self._shape = tuple(int(s) for s in shape)
+        self._mode = mode
+        self._filename.parent.mkdir(parents=True, exist_ok=True)
+        existed = self._filename.exists()
+        # np.memmap with "r+" requires the file to exist with the right size
+        create_mode = self._mode if existed and self._mode != "w+" else "w+"
+        self._array: Optional[np.memmap] = np.memmap(
+            self._filename, dtype=self._dtype, mode=create_mode, shape=self._shape
+        )
+        self._has_ownership = True
+
+    # ------------------------------------------------------------------ #
+    # properties
+    # ------------------------------------------------------------------ #
+    @property
+    def filename(self) -> Path:
+        return self._filename
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self._dtype
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self._shape
+
+    @property
+    def has_ownership(self) -> bool:
+        return self._has_ownership
+
+    @has_ownership.setter
+    def has_ownership(self, value: bool) -> None:
+        self._has_ownership = bool(value)
+
+    @property
+    def array(self) -> np.memmap:
+        if self._array is None:
+            # re-open after unpickling in a worker process; never with "w+",
+            # which would truncate data another process owns
+            mode = "r+" if self._mode in ("w+", "write") else self._mode
+            self._array = np.memmap(self._filename, dtype=self._dtype, mode=mode, shape=self._shape)
+        return self._array
+
+    @array.setter
+    def array(self, v: np.ndarray) -> None:
+        if not isinstance(v, np.ndarray):
+            raise ValueError(f"The value to be set must be an instance of 'np.ndarray', got {type(v)}")
+        if isinstance(v, np.memmap):
+            # adopt another memmap's file: point at it without taking ownership
+            if v.shape != self._shape or v.dtype != self._dtype:
+                raise ValueError(
+                    f"memmap shape/dtype mismatch: have {self._shape}/{self._dtype}, got {v.shape}/{v.dtype}"
+                )
+            if Path(v.filename).resolve() != self._filename:
+                self._close()
+                self._filename = Path(v.filename).resolve()
+                self._has_ownership = False
+            # re-open without truncating the adopted file
+            mode = "r+" if self._mode in ("w+", "write") else self._mode
+            self._array = np.memmap(self._filename, dtype=self._dtype, mode=mode, shape=self._shape)
+        else:
+            if v.shape != self._shape:
+                raise ValueError(f"shape mismatch: memmap has {self._shape}, value has {v.shape}")
+            self.array[:] = v.astype(self._dtype, copy=False)
+
+    # ------------------------------------------------------------------ #
+    # construction / lifecycle
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def from_array(
+        cls,
+        array: np.ndarray | "MemmapArray",
+        mode: str = "r+",
+        filename: str | os.PathLike = "./memmap_array.bin",
+    ) -> "MemmapArray":
+        """Create a MemmapArray holding a copy of ``array``. If ``array`` is
+        itself a MemmapArray over the *same* file, the source loses ownership
+        and the new instance takes it (reference memmap.py:171-210)."""
+        src = array.array if isinstance(array, MemmapArray) else array
+        same_file = isinstance(array, MemmapArray) and Path(array.filename) == Path(filename).resolve()
+        if same_file:
+            # adopting the source's file: never truncate it ("w+" would zero
+            # the data before the copy is skipped), just take ownership
+            out = cls(shape=src.shape, dtype=src.dtype, mode="r+", filename=filename)
+            out._mode = mode
+            array.has_ownership = False
+        else:
+            out = cls(shape=src.shape, dtype=src.dtype, mode=mode, filename=filename)
+            out.array[:] = src
+            out.array.flush()
+        return out
+
+    def _close(self) -> None:
+        if self._array is not None:
+            self._array.flush()
+            # drop the mmap handle before a possible unlink
+            del self._array
+            self._array = None
+
+    def __del__(self) -> None:
+        try:
+            owns = self._has_ownership
+        except AttributeError:  # partially-constructed instance
+            return
+        self._close()
+        if owns:
+            try:
+                self._filename.unlink(missing_ok=True)
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------------ #
+    # pickling: drop handles, never move ownership across processes
+    # ------------------------------------------------------------------ #
+    def __getstate__(self) -> dict:
+        state = self.__dict__.copy()
+        state["_array"] = None
+        # the unpickled copy (possibly in another process) must not delete the
+        # file out from under the owner
+        state["_has_ownership"] = False
+        return state
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
+
+    # ------------------------------------------------------------------ #
+    # ndarray interop
+    # ------------------------------------------------------------------ #
+    def __array__(self, dtype: Any = None) -> np.ndarray:
+        arr = self.array
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __getattr__(self, attr: str) -> Any:
+        if attr.startswith("_"):
+            raise AttributeError(attr)
+        return getattr(self.array, attr)
+
+    def __getitem__(self, idx: Any) -> np.ndarray:
+        return self.array[idx]
+
+    def __setitem__(self, idx: Any, value: Any) -> None:
+        self.array[idx] = value
+
+    def __len__(self) -> int:
+        return self._shape[0]
+
+    def __repr__(self) -> str:
+        return f"MemmapArray(shape={self._shape}, dtype={self._dtype}, file={self._filename})"

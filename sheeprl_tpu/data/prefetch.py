@@ -1,0 +1,114 @@
+"""Host→HBM double-buffered prefetch pipeline.
+
+The TPU-specific piece of the data plane (SURVEY.md §2.2 note): the reference
+moves each sampled batch host→device synchronously inside the gradient loop
+(``rb.sample_tensors(..., device=fabric.device)``, dreamer_v3.py:659-666),
+stalling the accelerator on PCIe. Here sampling runs on a background thread
+and ``jax.device_put`` is issued one batch ahead, so the transfer of batch
+``i+1`` overlaps the device computation on batch ``i`` (JAX transfers are
+async: ``device_put`` returns immediately and XLA orders the copy before the
+first op that consumes it).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+from sheeprl_tpu.data.buffers import to_device
+
+
+class DevicePrefetcher:
+    """Iterate device-resident batches produced by ``sample_fn``.
+
+    Supports early exit (``break`` / exception) without leaking the worker
+    thread or the HBM batches it holds: leaving the iterator (or calling
+    ``close()``) signals the worker to stop and drains the queue. Each
+    iteration starts a fresh worker, so an instance is reusable.
+
+    Args:
+        sample_fn: zero-arg callable returning a dict of host numpy arrays
+            (e.g. ``lambda: rb.sample(batch_size, ...)``).
+        n_batches: total number of batches to yield (one gradient loop's worth).
+        dtype: optional cast applied on host before transfer (e.g. staging
+            images as uint8 and normalizing on device is cheaper than shipping
+            fp32 — 4x less PCIe traffic).
+        sharding: optional ``jax.sharding.Sharding`` for pre-sharded placement.
+        depth: queue depth; 2 = classic double buffering.
+    """
+
+    def __init__(
+        self,
+        sample_fn: Callable[[], Dict[str, np.ndarray]],
+        n_batches: int,
+        dtype: Any = None,
+        sharding: Any = None,
+        depth: int = 2,
+    ) -> None:
+        if n_batches < 0:
+            raise ValueError(f"'n_batches' must be non-negative, got {n_batches}")
+        self._sample_fn = sample_fn
+        self._n_batches = n_batches
+        self._dtype = dtype
+        self._sharding = sharding
+        self._depth = max(1, depth)
+        self._queue: Optional["queue.Queue[Any]"] = None
+        self._stop: Optional[threading.Event] = None
+        self._thread: Optional[threading.Thread] = None
+        self._err: Optional[BaseException] = None
+
+    def _worker(self, q: "queue.Queue[Any]", stop: threading.Event) -> None:
+        try:
+            for _ in range(self._n_batches):
+                if stop.is_set():
+                    return
+                host = self._sample_fn()
+                dev = to_device(host, dtype=self._dtype, sharding=self._sharding)
+                # bounded put that still observes the stop signal
+                while not stop.is_set():
+                    try:
+                        q.put(dev, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # surfaced on the consumer thread
+            self._err = e
+            try:
+                q.put_nowait(None)
+            except queue.Full:
+                pass
+
+    def close(self) -> None:
+        """Stop the worker and release queued device batches."""
+        if self._stop is not None:
+            self._stop.set()
+        if self._queue is not None:
+            while True:
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    break
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._queue = None
+        self._stop = None
+        self._thread = None
+
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        self.close()  # reset any previous run
+        self._err = None
+        self._queue = queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker, args=(self._queue, self._stop), daemon=True)
+        self._thread.start()
+        try:
+            for _ in range(self._n_batches):
+                batch = self._queue.get()
+                if batch is None:
+                    raise RuntimeError("prefetch worker failed") from self._err
+                yield batch
+        finally:
+            self.close()

@@ -1,0 +1,264 @@
+"""ReplayBuffer specs (reference: tests/test_data/test_buffers.py)."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data import ReplayBuffer
+
+
+def make_data(seq_len, n_envs=1, start=0):
+    obs = (start + np.arange(seq_len * n_envs)).reshape(seq_len, n_envs, 1).astype(np.float32)
+    return {"observations": obs}
+
+
+def test_wrong_buffer_size():
+    with pytest.raises(ValueError):
+        ReplayBuffer(-1)
+
+
+def test_wrong_n_envs():
+    with pytest.raises(ValueError):
+        ReplayBuffer(1, -1)
+
+
+@pytest.mark.parametrize("memmap_mode", ["r", "x", "w", "z"])
+def test_wrong_memmap_mode(tmp_path, memmap_mode):
+    with pytest.raises(ValueError):
+        ReplayBuffer(10, memmap=True, memmap_mode=memmap_mode, memmap_dir=tmp_path)
+
+
+def test_memmap_no_dir():
+    with pytest.raises(ValueError):
+        ReplayBuffer(10, memmap=True, memmap_dir=None)
+
+
+def test_add_not_full():
+    rb = ReplayBuffer(buffer_size=10, n_envs=2)
+    rb.add(make_data(3, 2))
+    assert not rb.full
+    assert rb._pos == 3
+    assert rb["observations"].shape == (10, 2, 1)
+
+
+def test_add_wraps_and_overwrites():
+    rb = ReplayBuffer(buffer_size=5, n_envs=1)
+    rb.add(make_data(4))
+    rb.add(make_data(4, start=100))
+    assert rb.full
+    assert rb._pos == 3
+    # positions 4,0,1,2 hold the new data; position 3 holds old step 3
+    buf = np.asarray(rb["observations"])[:, 0, 0]
+    assert buf[4] == 100 and buf[0] == 101 and buf[1] == 102 and buf[2] == 103
+    assert buf[3] == 3
+
+
+def test_add_exceeding_buffer_size():
+    rb = ReplayBuffer(buffer_size=4, n_envs=1)
+    rb.add(make_data(11))
+    assert rb.full
+    # cursor consistent with writing all 11 rows; last rows retained
+    assert rb._pos == 11 % 4
+    buf = np.asarray(rb["observations"])[:, 0, 0]
+    assert set(buf.tolist()) == {7, 8, 9, 10}
+    assert buf[(rb._pos - 1) % 4] == 10
+
+
+def test_add_multiple_times_exceeding():
+    rb = ReplayBuffer(buffer_size=4, n_envs=1)
+    for i in range(5):
+        rb.add(make_data(3, start=i * 10))
+    assert rb.full
+    assert rb._pos == 15 % 4
+
+
+def test_add_replay_buffer():
+    src = ReplayBuffer(buffer_size=3, n_envs=1)
+    src.add(make_data(3))
+    dst = ReplayBuffer(buffer_size=5, n_envs=1)
+    dst.add(src)
+    assert np.array_equal(np.asarray(dst["observations"])[:3], np.asarray(src["observations"]))
+
+
+def test_add_validate_errors():
+    rb = ReplayBuffer(buffer_size=5)
+    with pytest.raises(ValueError):
+        rb.add([1, 2, 3], validate_args=True)
+    with pytest.raises(ValueError):
+        rb.add({"observations": [1, 2]}, validate_args=True)
+    with pytest.raises(RuntimeError):
+        rb.add({"observations": np.zeros((4,))}, validate_args=True)
+    with pytest.raises(RuntimeError):
+        rb.add(
+            {"a": np.zeros((4, 1, 2)), "b": np.zeros((3, 1, 2))},
+            validate_args=True,
+        )
+
+
+def test_sample_shape():
+    rb = ReplayBuffer(buffer_size=10, n_envs=2)
+    rb.add(make_data(5, 2))
+    s = rb.sample(4, n_samples=3)
+    assert s["observations"].shape == (3, 4, 1)
+
+
+def test_sample_empty_error():
+    rb = ReplayBuffer(buffer_size=10)
+    with pytest.raises(RuntimeError):
+        rb.sample(2)
+
+
+def test_sample_no_add_error():
+    rb = ReplayBuffer(buffer_size=10)
+    with pytest.raises(RuntimeError):
+        rb.sample(1)
+
+
+def test_sample_bad_batch_size():
+    rb = ReplayBuffer(buffer_size=10)
+    rb.add(make_data(3))
+    with pytest.raises(ValueError):
+        rb.sample(0)
+    with pytest.raises(ValueError):
+        rb.sample(2, n_samples=0)
+
+
+def test_sample_next_obs_one_element_error():
+    rb = ReplayBuffer(buffer_size=10)
+    rb.add(make_data(1))
+    with pytest.raises(RuntimeError):
+        rb.sample(1, sample_next_obs=True)
+
+
+def test_sample_next_obs_not_full():
+    rb = ReplayBuffer(buffer_size=10, seed=0)
+    rb.add(make_data(5))
+    s = rb.sample(64, sample_next_obs=True)
+    assert "next_observations" in s
+    # next obs is always current + 1 in our arange data
+    assert np.array_equal(s["next_observations"], s["observations"] + 1)
+    # never samples the last added position as current (its next is invalid)
+    assert s["observations"].max() <= 3
+
+
+def test_sample_next_obs_full_avoids_cursor():
+    rb = ReplayBuffer(buffer_size=5, seed=0)
+    rb.add(make_data(5))
+    rb.add(make_data(2, start=100))  # pos=2, slots 0,1 = 100,101
+    s = rb.sample(256, sample_next_obs=True)
+    # the transition (pos-1 -> pos) crosses the cursor; start pos-1 is invalid
+    starts = s["observations"][..., 0]
+    assert 101 not in starts  # idx 1 = pos-1 is excluded
+    assert 4 not in s["next_observations"][..., 0] or rb._pos != 0
+
+
+def test_sample_full():
+    rb = ReplayBuffer(buffer_size=5, seed=3)
+    rb.add(make_data(5))
+    s = rb.sample(6)
+    assert s["observations"].shape == (1, 6, 1)
+
+
+def test_sample_one_element():
+    rb = ReplayBuffer(buffer_size=1)
+    rb.add(make_data(1))
+    s = rb.sample(1)
+    assert s["observations"][0, 0, 0] == 0
+    with pytest.raises(RuntimeError):
+        rb.sample(1, sample_next_obs=True)
+
+
+def test_memmap_buffer(tmp_path):
+    rb = ReplayBuffer(buffer_size=10, n_envs=2, memmap=True, memmap_dir=tmp_path / "buf")
+    rb.add(make_data(5, 2))
+    assert rb.is_memmap
+    assert (tmp_path / "buf" / "observations.memmap").exists()
+    s = rb.sample(3)
+    assert s["observations"].shape == (1, 3, 1)
+
+
+def test_memmap_buffer_dtype_preserved(tmp_path):
+    rb = ReplayBuffer(buffer_size=8, memmap=True, memmap_dir=tmp_path / "buf")
+    rb.add({"x": np.ones((2, 1, 3), dtype=np.uint8)})
+    assert np.asarray(rb["x"]).dtype == np.uint8
+
+
+def test_obs_keys_sample_next_obs():
+    rb = ReplayBuffer(buffer_size=10, obs_keys=("observations", "vector"))
+    rb.add({**make_data(5), "vector": np.ones((5, 1, 3), dtype=np.float32)})
+    s = rb.sample(4, sample_next_obs=True)
+    assert "next_observations" in s and "next_vector" in s
+
+
+def test_obs_keys_not_in_obs_no_next():
+    rb = ReplayBuffer(buffer_size=10, obs_keys=("observations",))
+    rb.add({**make_data(5), "reward": np.ones((5, 1, 1), dtype=np.float32)})
+    s = rb.sample(4, sample_next_obs=True)
+    assert "next_observations" in s and "next_reward" not in s
+
+
+def test_getitem_errors():
+    rb = ReplayBuffer(buffer_size=5)
+    with pytest.raises(TypeError):
+        rb[1]
+    with pytest.raises(RuntimeError):
+        rb["observations"]
+
+
+def test_setitem():
+    rb = ReplayBuffer(buffer_size=5, n_envs=2)
+    rb.add(make_data(2, 2))
+    v = np.ones((5, 2, 4), dtype=np.float32)
+    rb["extra"] = v
+    assert np.array_equal(np.asarray(rb["extra"]), v)
+    v[0, 0, 0] = 7  # stored copy must be independent
+    assert rb["extra"][0, 0, 0] == 1
+
+
+def test_setitem_memmap(tmp_path):
+    rb = ReplayBuffer(buffer_size=5, memmap=True, memmap_dir=tmp_path / "buf")
+    rb.add(make_data(2))
+    rb["extra"] = np.ones((5, 1, 2), dtype=np.float32)
+    assert (tmp_path / "buf" / "extra.memmap").exists()
+
+
+def test_setitem_errors():
+    rb = ReplayBuffer(buffer_size=5)
+    with pytest.raises(RuntimeError):
+        rb["x"] = np.zeros((5, 1))
+    rb.add(make_data(2))
+    with pytest.raises(ValueError):
+        rb["x"] = [1, 2]
+    with pytest.raises(RuntimeError):
+        rb["x"] = np.zeros((3, 1))
+
+
+def test_sample_device():
+    import jax.numpy as jnp
+
+    rb = ReplayBuffer(buffer_size=10)
+    rb.add(make_data(5))
+    s = rb.sample_device(4, dtype=np.float32)
+    assert isinstance(s["observations"], jnp.ndarray)
+    assert s["observations"].shape == (1, 4, 1)
+
+
+def test_sample_device_sharded():
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    rb = ReplayBuffer(buffer_size=16)
+    rb.add(make_data(16))
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
+    sharding = NamedSharding(mesh, P(None, "data"))
+    s = rb.sample_device(8, sharding=sharding)
+    assert s["observations"].sharding == sharding
+
+
+def test_state_dict_roundtrip():
+    rb = ReplayBuffer(buffer_size=5)
+    rb.add(make_data(7))
+    state = rb.state_dict()
+    rb2 = ReplayBuffer(buffer_size=5)
+    rb2.add(make_data(1))
+    rb2.load_state_dict(state)
+    assert rb2._pos == rb._pos and rb2.full == rb.full

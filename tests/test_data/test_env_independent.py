@@ -1,0 +1,104 @@
+"""EnvIndependentReplayBuffer specs (reference: tests/test_data/test_env_independent_rb.py)."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data import EnvIndependentReplayBuffer, SequentialReplayBuffer
+
+
+def make_data(seq_len, n_envs, start=0):
+    obs = (start + np.arange(seq_len * n_envs)).reshape(seq_len, n_envs, 1).astype(np.float32)
+    return {"observations": obs}
+
+
+def test_wrong_sizes():
+    with pytest.raises(ValueError):
+        EnvIndependentReplayBuffer(-1)
+    with pytest.raises(ValueError):
+        EnvIndependentReplayBuffer(1, -1)
+
+
+def test_missing_memmap_dir():
+    with pytest.raises(ValueError):
+        EnvIndependentReplayBuffer(10, memmap=True, memmap_dir=None)
+
+
+def test_wrong_memmap_mode(tmp_path):
+    with pytest.raises(ValueError):
+        EnvIndependentReplayBuffer(10, memmap=True, memmap_mode="x", memmap_dir=tmp_path)
+
+
+def test_add_all_envs():
+    rb = EnvIndependentReplayBuffer(buffer_size=10, n_envs=3)
+    rb.add(make_data(4, 3))
+    assert all(b._pos == 4 for b in rb.buffer)
+
+
+def test_add_subset_of_envs():
+    rb = EnvIndependentReplayBuffer(buffer_size=10, n_envs=3)
+    rb.add(make_data(4, 2), indices=[0, 2])
+    assert rb.buffer[0]._pos == 4
+    assert rb.buffer[1]._pos == 0
+    assert rb.buffer[2]._pos == 4
+
+
+def test_add_wrong_indices_length():
+    rb = EnvIndependentReplayBuffer(buffer_size=10, n_envs=3)
+    with pytest.raises(ValueError):
+        rb.add(make_data(4, 2), indices=[0])
+
+
+def test_sample_shape():
+    rb = EnvIndependentReplayBuffer(buffer_size=10, n_envs=3, seed=0)
+    rb.add(make_data(6, 3))
+    s = rb.sample(8, n_samples=2)
+    assert s["observations"].shape == (2, 8, 1)
+
+
+def test_sample_sequential_concat_axis():
+    rb = EnvIndependentReplayBuffer(
+        buffer_size=20, n_envs=2, buffer_cls=SequentialReplayBuffer, seed=0
+    )
+    rb.add(make_data(10, 2))
+    s = rb.sample(6, n_samples=2, sequence_length=4)
+    assert s["observations"].shape == (2, 4, 6, 1)
+
+
+def test_per_env_cursors_differ():
+    rb = EnvIndependentReplayBuffer(buffer_size=10, n_envs=2)
+    rb.add(make_data(3, 1), indices=[0])
+    rb.add(make_data(5, 1), indices=[1])
+    assert rb.buffer[0]._pos == 3 and rb.buffer[1]._pos == 5
+
+
+def test_sample_bad_args():
+    rb = EnvIndependentReplayBuffer(buffer_size=10, n_envs=2)
+    rb.add(make_data(3, 2))
+    with pytest.raises(ValueError):
+        rb.sample(0)
+
+
+def test_memmap(tmp_path):
+    rb = EnvIndependentReplayBuffer(buffer_size=10, n_envs=2, memmap=True, memmap_dir=tmp_path / "buf")
+    rb.add(make_data(4, 2))
+    assert (tmp_path / "buf" / "env_0" / "observations.memmap").exists()
+    assert (tmp_path / "buf" / "env_1" / "observations.memmap").exists()
+
+
+def test_sample_device():
+    import jax.numpy as jnp
+
+    rb = EnvIndependentReplayBuffer(buffer_size=10, n_envs=2, seed=0)
+    rb.add(make_data(6, 2))
+    s = rb.sample_device(4)
+    assert isinstance(s["observations"], jnp.ndarray)
+
+
+def test_state_dict_roundtrip():
+    rb = EnvIndependentReplayBuffer(buffer_size=10, n_envs=2)
+    rb.add(make_data(3, 2))
+    state = rb.state_dict()
+    rb2 = EnvIndependentReplayBuffer(buffer_size=10, n_envs=2)
+    rb2.add(make_data(1, 2))
+    rb2.load_state_dict(state)
+    assert [b._pos for b in rb2.buffer] == [b._pos for b in rb.buffer]

@@ -1,0 +1,211 @@
+"""EpisodeBuffer specs (reference: tests/test_data/test_episode_buffer.py)."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data import EpisodeBuffer
+
+
+def make_episode(ep_len, n_envs=1, end=True, start=0):
+    """[seq_len, n_envs, ...] data ending (or not) with a done."""
+    obs = (start + np.arange(ep_len * n_envs)).reshape(ep_len, n_envs, 1).astype(np.float32)
+    terminated = np.zeros((ep_len, n_envs, 1), dtype=np.float32)
+    truncated = np.zeros((ep_len, n_envs, 1), dtype=np.float32)
+    if end:
+        terminated[-1] = 1
+    return {"observations": obs, "terminated": terminated, "truncated": truncated}
+
+
+def test_wrong_sizes():
+    with pytest.raises(ValueError):
+        EpisodeBuffer(-1, 10)
+    with pytest.raises(ValueError):
+        EpisodeBuffer(10, -1)
+    with pytest.raises(ValueError):
+        EpisodeBuffer(5, 10)
+
+
+@pytest.mark.parametrize("memmap_mode", ["r", "x"])
+def test_wrong_memmap_mode(tmp_path, memmap_mode):
+    with pytest.raises(ValueError):
+        EpisodeBuffer(10, 2, memmap=True, memmap_mode=memmap_mode, memmap_dir=tmp_path)
+
+
+def test_add_complete_episode():
+    eb = EpisodeBuffer(buffer_size=50, minimum_episode_length=3)
+    eb.add(make_episode(10))
+    assert len(eb.buffer) == 1
+    assert len(eb) == 10
+
+
+def test_add_open_episode_not_stored():
+    eb = EpisodeBuffer(buffer_size=50, minimum_episode_length=3)
+    eb.add(make_episode(10, end=False))
+    assert len(eb.buffer) == 0
+    assert len(eb._open_episodes[0]) == 1
+
+
+def test_add_chunked_episode():
+    eb = EpisodeBuffer(buffer_size=50, minimum_episode_length=3)
+    eb.add(make_episode(5, end=False))
+    eb.add(make_episode(5, end=True, start=5))
+    assert len(eb.buffer) == 1
+    assert len(eb) == 10
+    assert np.array_equal(
+        eb.buffer[0]["observations"][:, 0], np.arange(10, dtype=np.float32)
+    )
+
+
+def test_add_multiple_episodes_in_one_call():
+    eb = EpisodeBuffer(buffer_size=100, minimum_episode_length=2)
+    data = make_episode(10)
+    # insert a mid-sequence done at t=4 -> two episodes (0..4, 5..9)
+    data["terminated"][4] = 1
+    eb.add(data)
+    assert len(eb.buffer) == 2
+    assert len(eb) == 10
+
+
+def test_add_multi_env():
+    eb = EpisodeBuffer(buffer_size=100, minimum_episode_length=2, n_envs=3)
+    eb.add(make_episode(6, n_envs=3))
+    assert len(eb.buffer) == 3
+
+
+def test_add_only_for_some_envs():
+    eb = EpisodeBuffer(buffer_size=100, minimum_episode_length=2, n_envs=4)
+    eb.add(make_episode(6, n_envs=2), env_idxes=[1, 3])
+    assert len(eb.buffer) == 2
+    assert len(eb._open_episodes[0]) == 0 and len(eb._open_episodes[2]) == 0
+
+
+def test_add_env_idxes_out_of_range():
+    eb = EpisodeBuffer(buffer_size=100, minimum_episode_length=2, n_envs=2)
+    with pytest.raises(ValueError):
+        eb.add(make_episode(6, n_envs=2), env_idxes=[0, 5], validate_args=True)
+
+
+def test_add_missing_done_keys():
+    eb = EpisodeBuffer(buffer_size=100, minimum_episode_length=2)
+    with pytest.raises(RuntimeError):
+        eb.add({"observations": np.zeros((5, 1, 1))}, validate_args=True)
+
+
+def test_save_episode_too_short():
+    eb = EpisodeBuffer(buffer_size=100, minimum_episode_length=5)
+    with pytest.raises(RuntimeError):
+        eb.add(make_episode(3))
+
+
+def test_save_episode_too_long():
+    eb = EpisodeBuffer(buffer_size=10, minimum_episode_length=2)
+    with pytest.raises(RuntimeError):
+        eb.add(make_episode(11))
+
+
+def test_eviction_of_oldest():
+    eb = EpisodeBuffer(buffer_size=20, minimum_episode_length=2)
+    eb.add(make_episode(8, start=0))
+    eb.add(make_episode(8, start=100))
+    eb.add(make_episode(8, start=200))  # 24 > 20: evict the first
+    assert len(eb.buffer) == 2
+    assert eb.buffer[0]["observations"][0, 0] == 100
+    assert len(eb) == 16
+
+
+def test_full_property():
+    eb = EpisodeBuffer(buffer_size=10, minimum_episode_length=4)
+    assert not eb.full
+    eb.add(make_episode(8))
+    assert eb.full  # 8 + 4 > 10
+
+
+def test_sample_shapes():
+    eb = EpisodeBuffer(buffer_size=100, minimum_episode_length=2, seed=0)
+    eb.add(make_episode(20))
+    s = eb.sample(4, n_samples=3, sequence_length=5)
+    assert s["observations"].shape == (3, 5, 4, 1)
+
+
+def test_sample_sequences_within_episode():
+    eb = EpisodeBuffer(buffer_size=100, minimum_episode_length=2, seed=0)
+    eb.add(make_episode(10, start=0))
+    eb.add(make_episode(10, start=100))
+    s = eb.sample(32, sequence_length=4)
+    obs = s["observations"][0, :, :, 0]  # [L, B]
+    assert np.all(np.diff(obs, axis=0) == 1)  # contiguous => never crosses episodes
+
+
+def test_sample_one_element():
+    eb = EpisodeBuffer(buffer_size=10, minimum_episode_length=1, seed=0)
+    eb.add(make_episode(1))
+    s = eb.sample(1, sequence_length=1)
+    assert s["observations"].shape == (1, 1, 1, 1)
+
+
+def test_sample_too_long_error():
+    eb = EpisodeBuffer(buffer_size=100, minimum_episode_length=2)
+    eb.add(make_episode(5))
+    with pytest.raises(RuntimeError):
+        eb.sample(1, sequence_length=6)
+
+
+def test_sample_empty_error():
+    eb = EpisodeBuffer(buffer_size=10, minimum_episode_length=2)
+    with pytest.raises(RuntimeError):
+        eb.sample(1, sequence_length=2)
+
+
+def test_sample_bad_args():
+    eb = EpisodeBuffer(buffer_size=10, minimum_episode_length=2)
+    with pytest.raises(ValueError):
+        eb.sample(0)
+    with pytest.raises(ValueError):
+        eb.sample(1, n_samples=0)
+
+
+def test_prioritize_ends_biases_toward_tail():
+    eb_uniform = EpisodeBuffer(buffer_size=1000, minimum_episode_length=2, seed=0)
+    eb_ends = EpisodeBuffer(buffer_size=1000, minimum_episode_length=2, prioritize_ends=True, seed=0)
+    eb_uniform.add(make_episode(100))
+    eb_ends.add(make_episode(100))
+    L = 10
+    s_uniform = eb_uniform.sample(512, sequence_length=L)
+    s_ends = eb_ends.sample(512, sequence_length=L)
+    # the last possible window ends at 99; prioritized sampling should pick the
+    # final window far more often
+    tail_uniform = (s_uniform["observations"][0, -1, :, 0] == 99).mean()
+    tail_ends = (s_ends["observations"][0, -1, :, 0] == 99).mean()
+    assert tail_ends > tail_uniform
+
+
+def test_sample_next_obs():
+    eb = EpisodeBuffer(buffer_size=100, minimum_episode_length=2, seed=0)
+    eb.add(make_episode(10))
+    s = eb.sample(8, sequence_length=3, sample_next_obs=True)
+    assert np.array_equal(s["next_observations"], s["observations"] + 1)
+
+
+def test_memmap_episode_buffer(tmp_path):
+    eb = EpisodeBuffer(buffer_size=50, minimum_episode_length=2, memmap=True, memmap_dir=tmp_path / "eb")
+    eb.add(make_episode(10))
+    assert len(list((tmp_path / "eb").iterdir())) == 1
+    s = eb.sample(2, sequence_length=3)
+    assert s["observations"].shape == (1, 3, 2, 1)
+
+
+def test_memmap_eviction_removes_files(tmp_path):
+    eb = EpisodeBuffer(buffer_size=16, minimum_episode_length=2, memmap=True, memmap_dir=tmp_path / "eb")
+    eb.add(make_episode(8))
+    eb.add(make_episode(8, start=100))
+    eb.add(make_episode(8, start=200))
+    assert len(list((tmp_path / "eb").iterdir())) == len(eb.buffer)
+
+
+def test_sample_device():
+    import jax.numpy as jnp
+
+    eb = EpisodeBuffer(buffer_size=50, minimum_episode_length=2, seed=0)
+    eb.add(make_episode(10))
+    s = eb.sample_device(2, sequence_length=3)
+    assert isinstance(s["observations"], jnp.ndarray)

