@@ -279,6 +279,11 @@ class ReplayBuffer:
             )
         if self._memmap:
             filename = value.filename if isinstance(value, MemmapArray) else Path(self._memmap_dir) / f"{key}.memmap"
+            old = self._buf.get(key)
+            if isinstance(old, MemmapArray) and Path(old.filename) == Path(filename).resolve():
+                # the displaced array must not unlink the file the new owner
+                # is about to adopt
+                old.has_ownership = False
             self._buf[key] = MemmapArray.from_array(value, mode=self._memmap_mode, filename=filename)
         else:
             self._buf[key] = np.copy(np.asarray(value))

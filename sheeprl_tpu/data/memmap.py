@@ -148,12 +148,14 @@ class MemmapArray(np.lib.mixins.NDArrayOperatorsMixin):
             owns = self._has_ownership
         except AttributeError:  # partially-constructed instance
             return
-        self._close()
-        if owns:
-            try:
+        try:
+            self._close()
+            if owns:
                 self._filename.unlink(missing_ok=True)
-            except OSError:
-                pass
+        except Exception:
+            # interpreter shutdown can tear down pathlib/numpy globals before
+            # __del__ runs; never let cleanup raise
+            pass
 
     # ------------------------------------------------------------------ #
     # pickling: drop handles, never move ownership across processes

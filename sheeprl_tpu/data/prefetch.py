@@ -76,10 +76,14 @@ class DevicePrefetcher:
                         continue
         except BaseException as e:  # surfaced on the consumer thread
             self._err = e
-            try:
-                q.put_nowait(None)
-            except queue.Full:
-                pass
+            # the error sentinel must not be dropped even when the queue is
+            # full, or the consumer would block forever on get()
+            while not stop.is_set():
+                try:
+                    q.put(None, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     def close(self) -> None:
         """Stop the worker and release queued device batches."""

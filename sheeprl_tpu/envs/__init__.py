@@ -1,0 +1,27 @@
+"""Environment plane (reference: sheeprl/envs + sheeprl/utils/env.py).
+
+Host-side gymnasium adapters and the ``make_env`` factory. All image
+observations are **NHWC uint8** (``[H, W, C]``) — the TPU-native layout this
+framework uses everywhere — where the reference is NCHW (utils/env.py:193).
+"""
+
+from sheeprl_tpu.envs.factory import get_dummy_env, make_env
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    FrameStack,
+    GrayscaleRenderWrapper,
+    MaskVelocityWrapper,
+    RestartOnException,
+    RewardAsObservationWrapper,
+)
+
+__all__ = [
+    "ActionRepeat",
+    "FrameStack",
+    "GrayscaleRenderWrapper",
+    "MaskVelocityWrapper",
+    "RestartOnException",
+    "RewardAsObservationWrapper",
+    "get_dummy_env",
+    "make_env",
+]

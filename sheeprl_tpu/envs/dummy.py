@@ -1,0 +1,102 @@
+"""Fake environments backing the algorithm test-suite
+(reference: sheeprl/envs/dummy.py:8-95).
+
+Obs dict: ``rgb`` (NHWC uint8 image — the reference is CHW) and ``state``
+(float32 vector). Episodes end via ``terminated`` after ``n_steps``.
+Observations encode the step index so tests can assert temporal ordering.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+
+class BaseDummyEnv(gym.Env):
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (64, 64, 3),
+        n_steps: int = 128,
+        vector_shape: Tuple[int, ...] = (10,),
+    ) -> None:
+        self.observation_space = gym.spaces.Dict(
+            {
+                "rgb": gym.spaces.Box(0, 255, shape=image_size, dtype=np.uint8),
+                "state": gym.spaces.Box(-20, 20, shape=vector_shape, dtype=np.float32),
+            }
+        )
+        self.reward_range = (-np.inf, np.inf)
+        self.render_mode = "rgb_array"
+        self._current_step = 0
+        self._n_steps = n_steps
+
+    def get_obs(self) -> Dict[str, np.ndarray]:
+        return {
+            "rgb": np.full(self.observation_space["rgb"].shape, self._current_step % 256, dtype=np.uint8),
+            "state": np.full(self.observation_space["state"].shape, self._current_step, dtype=np.float32),
+        }
+
+    def step(self, action):
+        done = self._current_step == self._n_steps
+        self._current_step += 1
+        return self.get_obs(), 0.0, done, False, {}
+
+    def reset(self, seed=None, options=None):
+        super().reset(seed=seed)
+        self._current_step = 0
+        return self.get_obs(), {}
+
+    def render(self):
+        return self.get_obs()["rgb"]
+
+    def close(self):
+        pass
+
+
+class ContinuousDummyEnv(BaseDummyEnv):
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (64, 64, 3),
+        n_steps: int = 128,
+        vector_shape: Tuple[int, ...] = (10,),
+        action_dim: int = 2,
+    ) -> None:
+        super().__init__(image_size=image_size, n_steps=n_steps, vector_shape=vector_shape)
+        self.action_space = gym.spaces.Box(-np.inf, np.inf, shape=(action_dim,))
+
+
+class DiscreteDummyEnv(BaseDummyEnv):
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (64, 64, 3),
+        n_steps: int = 4,
+        vector_shape: Tuple[int, ...] = (10,),
+        action_dim: int = 2,
+    ) -> None:
+        super().__init__(image_size=image_size, n_steps=n_steps, vector_shape=vector_shape)
+        self.action_space = gym.spaces.Discrete(action_dim)
+
+
+class MultiDiscreteDummyEnv(BaseDummyEnv):
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (64, 64, 3),
+        n_steps: int = 128,
+        vector_shape: Tuple[int, ...] = (10,),
+        action_dims: List[int] = (2, 2),
+    ) -> None:
+        super().__init__(image_size=image_size, n_steps=n_steps, vector_shape=vector_shape)
+        self.action_space = gym.spaces.MultiDiscrete(list(action_dims))
+
+
+def get_dummy_env(id: str, **kwargs) -> BaseDummyEnv:
+    """Select a dummy env by id substring (reference utils/env.py:230-245)."""
+    if "continuous" in id:
+        return ContinuousDummyEnv(**kwargs)
+    if "multidiscrete" in id:
+        return MultiDiscreteDummyEnv(**kwargs)
+    if "discrete" in id:
+        return DiscreteDummyEnv(**kwargs)
+    raise ValueError(f"Unrecognized dummy environment: {id}")

@@ -1,0 +1,143 @@
+"""``make_env`` factory (reference: sheeprl/utils/env.py:25-227).
+
+Builds a thunk that instantiates the configured wrapper (``env.wrapper`` is a
+``_target_`` node) and applies the standard pipeline: action repeat →
+velocity masking → dict-ification → image resize/grayscale (NHWC uint8) →
+frame stacking → reward-as-observation → time limit → episode statistics →
+optional video capture. Pure host-side code; written for gymnasium >= 1.0.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Callable, Dict, Optional
+
+import gymnasium as gym
+import numpy as np
+
+from sheeprl_tpu.config.compose import instantiate
+from sheeprl_tpu.envs.dummy import get_dummy_env
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    DictObservation,
+    FrameStack,
+    GrayscaleRenderWrapper,
+    ImageTransform,
+    MaskVelocityWrapper,
+    RenderObservation,
+    RewardAsObservationWrapper,
+)
+
+__all__ = ["make_env", "get_dummy_env"]
+
+
+def make_env(
+    cfg: Dict[str, Any],
+    seed: int,
+    rank: int,
+    run_name: Optional[str] = None,
+    prefix: str = "",
+    vector_env_idx: int = 0,
+) -> Callable[[], gym.Env]:
+    """Return a thunk creating a fully-wrapped env with a Dict observation
+    space. Mirrors the reference factory contract (utils/env.py:25-227)."""
+
+    def thunk() -> gym.Env:
+        wrapper_cfg = cfg.env.wrapper
+        instantiate_kwargs = {}
+        if "seed" in wrapper_cfg:
+            instantiate_kwargs["seed"] = seed
+        if "rank" in wrapper_cfg:
+            instantiate_kwargs["rank"] = rank + vector_env_idx
+        env = instantiate(wrapper_cfg, **instantiate_kwargs)
+
+        if cfg.env.action_repeat > 1:
+            env = ActionRepeat(env, cfg.env.action_repeat)
+
+        if cfg.env.get("mask_velocities", False):
+            env = MaskVelocityWrapper(env)
+
+        raw_cnn, raw_mlp = cfg.algo.cnn_keys.encoder, cfg.algo.mlp_keys.encoder
+        if not isinstance(raw_cnn, (list, tuple)) or not isinstance(raw_mlp, (list, tuple)):
+            raise ValueError(
+                "`algo.cnn_keys.encoder` and `algo.mlp_keys.encoder` must be lists of strings, "
+                f"got cnn={raw_cnn!r} mlp={raw_mlp!r}"
+            )
+        cnn_keys, mlp_keys = list(raw_cnn), list(raw_mlp)
+        if len(cnn_keys + mlp_keys) == 0:
+            raise ValueError(
+                "at least one key must be set across `algo.cnn_keys.encoder` and `algo.mlp_keys.encoder`"
+            )
+
+        # dict-ify the observation space (reference utils/env.py:97-139)
+        obs_space = env.observation_space
+        if isinstance(obs_space, gym.spaces.Box) and len(obs_space.shape) < 2:
+            if len(cnn_keys) > 0:
+                if len(cnn_keys) > 1:
+                    warnings.warn(
+                        f"Multiple cnn keys specified but {cfg.env.id} has a single pixel stream; "
+                        f"keeping {cnn_keys[0]}"
+                    )
+                env = RenderObservation(
+                    env,
+                    pixel_key=cnn_keys[0],
+                    pixels_only=len(mlp_keys) == 0,
+                    state_key=mlp_keys[0] if mlp_keys else "state",
+                )
+            else:
+                if len(mlp_keys) > 1:
+                    warnings.warn(
+                        f"Multiple mlp keys specified but {cfg.env.id} has a single vector stream; "
+                        f"keeping {mlp_keys[0]}"
+                    )
+                env = DictObservation(env, mlp_keys[0])
+        elif isinstance(obs_space, gym.spaces.Box) and 2 <= len(obs_space.shape) <= 3:
+            if len(cnn_keys) == 0:
+                raise ValueError(
+                    "You have selected a pixel observation but no cnn key has been specified. "
+                    "Set at least one cnn key: `algo.cnn_keys.encoder=[your_cnn_key]`"
+                )
+            if len(cnn_keys) > 1:
+                warnings.warn(
+                    f"Multiple cnn keys specified but {cfg.env.id} has a single pixel stream; "
+                    f"keeping {cnn_keys[0]}"
+                )
+            env = DictObservation(env, cnn_keys[0])
+
+        if len(set(env.observation_space.keys()).intersection(set(mlp_keys + cnn_keys))) == 0:
+            raise ValueError(
+                f"The user-specified keys {mlp_keys + cnn_keys} are not a subset of the environment "
+                f"observation keys {list(env.observation_space.keys())}. Check your config."
+            )
+
+        # image standardization on the env's image-like keys we encode
+        env_cnn_keys = {
+            k for k in env.observation_space.spaces.keys() if len(env.observation_space[k].shape) in (2, 3)
+        }
+        used_cnn_keys = sorted(env_cnn_keys.intersection(cnn_keys))
+        if used_cnn_keys:
+            env = ImageTransform(env, used_cnn_keys, cfg.env.screen_size, cfg.env.grayscale)
+
+        if used_cnn_keys and cfg.env.frame_stack > 1:
+            env = FrameStack(env, cfg.env.frame_stack, used_cnn_keys, cfg.env.frame_stack_dilation)
+
+        if cfg.env.reward_as_observation:
+            env = RewardAsObservationWrapper(env)
+
+        env.action_space.seed(seed)
+        env.observation_space.seed(seed)
+        if cfg.env.max_episode_steps and cfg.env.max_episode_steps > 0:
+            env = gym.wrappers.TimeLimit(env, max_episode_steps=cfg.env.max_episode_steps)
+        env = gym.wrappers.RecordEpisodeStatistics(env)
+        if cfg.env.capture_video and rank == 0 and vector_env_idx == 0 and run_name is not None:
+            if cfg.env.grayscale:
+                env = GrayscaleRenderWrapper(env)
+            env = gym.wrappers.RecordVideo(
+                env,
+                os.path.join(run_name, prefix + "_videos" if prefix else "videos"),
+                disable_logger=True,
+            )
+        return env
+
+    return thunk

@@ -1,0 +1,354 @@
+"""Generic gymnasium wrappers (reference: sheeprl/envs/wrappers.py).
+
+Image conventions are NHWC uint8 throughout (TPU layout); the reference's
+channel-first permutes (wrappers.py / utils/env.py:193) have no counterpart.
+Written against gymnasium >= 1.0 (the reference targets 0.29).
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, SupportsFloat, Tuple, Union
+
+import gymnasium as gym
+import numpy as np
+
+
+class MaskVelocityWrapper(gym.ObservationWrapper):
+    """Zero out velocity entries to make classic-control MDPs partially
+    observable (reference wrappers.py:11-43)."""
+
+    velocity_indices: Dict[str, np.ndarray] = {
+        "CartPole-v0": np.array([1, 3]),
+        "CartPole-v1": np.array([1, 3]),
+        "MountainCar-v0": np.array([1]),
+        "MountainCarContinuous-v0": np.array([1]),
+        "Pendulum-v1": np.array([2]),
+        "LunarLander-v2": np.array([2, 3, 5]),
+        "LunarLanderContinuous-v2": np.array([2, 3, 5]),
+    }
+
+    def __init__(self, env: gym.Env):
+        super().__init__(env)
+        if env.unwrapped.spec is None:
+            raise NotImplementedError("Velocity masking requires a registered env with a spec")
+        env_id: str = env.unwrapped.spec.id
+        self.mask = np.ones_like(env.observation_space.sample())
+        try:
+            self.mask[self.velocity_indices[env_id]] = 0.0
+        except KeyError as e:
+            raise NotImplementedError(f"Velocity masking not implemented for {env_id}") from e
+
+    def observation(self, observation: np.ndarray) -> np.ndarray:
+        return observation * self.mask
+
+
+class ActionRepeat(gym.Wrapper):
+    """Repeat each action up to ``amount`` times, summing rewards and cutting
+    short on termination (reference wrappers.py:46-69)."""
+
+    def __init__(self, env: gym.Env, amount: int = 1):
+        super().__init__(env)
+        if amount <= 0:
+            raise ValueError("`amount` should be a positive integer")
+        self._amount = amount
+
+    @property
+    def action_repeat(self) -> int:
+        return self._amount
+
+    def step(self, action):
+        done = truncated = False
+        total_reward = 0.0
+        obs, info = None, {}
+        for _ in range(self._amount):
+            obs, reward, done, truncated, info = self.env.step(action)
+            total_reward += reward
+            if done or truncated:
+                break
+        return obs, total_reward, done, truncated, info
+
+
+class RestartOnException(gym.Wrapper):
+    """Recreate a crashed environment, budgeted by a failure window
+    (reference wrappers.py:72-121). A restart surfaces
+    ``info["restart_on_exception"] = True`` so the algorithm can patch its
+    buffer (e.g. dreamer_v3.py:591-604 marks the last step truncated)."""
+
+    def __init__(
+        self,
+        env_fn: Callable[..., gym.Env],
+        exceptions: Sequence[type] = (Exception,),
+        window: float = 300,
+        maxfails: int = 2,
+        wait: float = 20,
+    ):
+        if not isinstance(exceptions, (tuple, list)):
+            exceptions = (exceptions,)
+        self._env_fn = env_fn
+        self._exceptions = tuple(exceptions)
+        self._window = window
+        self._maxfails = maxfails
+        self._wait = wait
+        self._last = time.time()
+        self._fails = 0
+        super().__init__(env_fn())
+
+    def _register_failure(self, err: BaseException, phase: str) -> None:
+        if time.time() > self._last + self._window:
+            self._last = time.time()
+            self._fails = 1
+        else:
+            self._fails += 1
+        if self._fails > self._maxfails:
+            raise RuntimeError(f"The env crashed too many times: {self._fails}") from err
+        gym.logger.warn(f"{phase} - Restarting env after crash with {type(err).__name__}: {err}")
+        time.sleep(self._wait)
+
+    def step(self, action) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
+        try:
+            return self.env.step(action)
+        except self._exceptions as e:
+            self._register_failure(e, "STEP")
+            self.env = self._env_fn()
+            new_obs, info = self.env.reset()
+            info["restart_on_exception"] = True
+            return new_obs, 0.0, False, False, info
+
+    def reset(self, *, seed=None, options=None) -> Tuple[Any, Dict[str, Any]]:
+        try:
+            return self.env.reset(seed=seed, options=options)
+        except self._exceptions as e:
+            self._register_failure(e, "RESET")
+            self.env = self._env_fn()
+            new_obs, info = self.env.reset(seed=seed, options=options)
+            info["restart_on_exception"] = True
+            return new_obs, info
+
+
+class FrameStack(gym.Wrapper):
+    """Stack the last ``num_stack`` image frames (optionally dilated) for the
+    given dict keys. Output shape is ``[num_stack, H, W, C]`` — NHWC frames
+    stacked on a leading axis (the reference stacks CHW frames the same way,
+    wrappers.py:124-180); encoders fold the stack into channels."""
+
+    def __init__(self, env: gym.Env, num_stack: int, cnn_keys: Sequence[str], dilation: int = 1) -> None:
+        super().__init__(env)
+        if num_stack <= 0:
+            raise ValueError(f"Invalid value for num_stack, expected a value greater than zero, got {num_stack}")
+        if dilation <= 0:
+            raise ValueError(f"The frame stack dilation argument must be greater than zero, got: {dilation}")
+        if not isinstance(env.observation_space, gym.spaces.Dict):
+            raise RuntimeError(
+                f"Expected an observation space of type gym.spaces.Dict, got: {type(env.observation_space)}"
+            )
+        self._num_stack = num_stack
+        self._dilation = dilation
+        self._cnn_keys = [
+            k
+            for k, v in env.observation_space.spaces.items()
+            if k in cnn_keys and len(v.shape) == 3
+        ]
+        if not self._cnn_keys:
+            raise RuntimeError("Specify at least one valid cnn key to be stacked")
+        self.observation_space = copy.deepcopy(env.observation_space)
+        for k in self._cnn_keys:
+            space = env.observation_space[k]
+            self.observation_space[k] = gym.spaces.Box(
+                np.repeat(space.low[None, ...], num_stack, axis=0),
+                np.repeat(space.high[None, ...], num_stack, axis=0),
+                (num_stack, *space.shape),
+                space.dtype,
+            )
+        self._frames = {k: deque(maxlen=num_stack * dilation) for k in self._cnn_keys}
+
+    def _get_obs(self, key: str) -> np.ndarray:
+        frames = list(self._frames[key])[self._dilation - 1 :: self._dilation]
+        assert len(frames) == self._num_stack
+        return np.stack(frames, axis=0)
+
+    def step(self, action):
+        obs, reward, done, truncated, info = self.env.step(action)
+        for k in self._cnn_keys:
+            self._frames[k].append(obs[k])
+            obs[k] = self._get_obs(k)
+        return obs, reward, done, truncated, info
+
+    def reset(self, *, seed=None, options=None):
+        obs, info = self.env.reset(seed=seed, options=options)
+        for k in self._cnn_keys:
+            self._frames[k].clear()
+            for _ in range(self._num_stack * self._dilation):
+                self._frames[k].append(obs[k])
+            obs[k] = self._get_obs(k)
+        return obs, info
+
+
+class RewardAsObservationWrapper(gym.Wrapper):
+    """Expose the scalar reward as a ``reward`` observation key
+    (reference wrappers.py:183-239)."""
+
+    def __init__(self, env: gym.Env) -> None:
+        super().__init__(env)
+        reward_range = getattr(env, "reward_range", None) or (-np.inf, np.inf)
+        reward_space = gym.spaces.Box(*reward_range, (1,), np.float32)
+        if isinstance(env.observation_space, gym.spaces.Dict):
+            self.observation_space = gym.spaces.Dict(
+                {"reward": reward_space, **dict(env.observation_space.items())}
+            )
+        else:
+            self.observation_space = gym.spaces.Dict({"obs": env.observation_space, "reward": reward_space})
+
+    def _convert_obs(self, obs: Any, reward: Union[float, np.ndarray]) -> Dict[str, Any]:
+        reward_obs = np.asarray(reward, dtype=np.float32).reshape(-1)
+        if isinstance(obs, dict):
+            obs["reward"] = reward_obs
+            return obs
+        return {"obs": obs, "reward": reward_obs}
+
+    def step(self, action):
+        obs, reward, done, truncated, info = self.env.step(action)
+        return self._convert_obs(obs, reward), reward, done, truncated, info
+
+    def reset(self, *, seed=None, options=None):
+        obs, info = self.env.reset(seed=seed, options=options)
+        return self._convert_obs(obs, 0.0), info
+
+
+class GrayscaleRenderWrapper(gym.Wrapper):
+    """Expand grayscale render frames to 3 channels so video encoders accept
+    them (reference wrappers.py:242-253)."""
+
+    def render(self) -> Optional[Union[np.ndarray, List[np.ndarray]]]:
+        frame = super().render()
+        if isinstance(frame, np.ndarray):
+            if frame.ndim == 2:
+                frame = frame[..., np.newaxis]
+            if frame.ndim == 3 and frame.shape[-1] == 1:
+                frame = frame.repeat(3, axis=-1)
+        return frame
+
+
+class DictObservation(gym.Wrapper):
+    """Wrap a non-dict observation space into ``gym.spaces.Dict`` under
+    ``key`` (replaces the reference's TransformObservation dict-ification,
+    utils/env.py:100-139, in a gymnasium-1.x-safe way)."""
+
+    def __init__(self, env: gym.Env, key: str) -> None:
+        super().__init__(env)
+        if isinstance(env.observation_space, gym.spaces.Dict):
+            raise RuntimeError("observation space is already a Dict")
+        self._key = key
+        self.observation_space = gym.spaces.Dict({key: env.observation_space})
+
+    def step(self, action):
+        obs, reward, done, truncated, info = self.env.step(action)
+        return {self._key: obs}, reward, done, truncated, info
+
+    def reset(self, *, seed=None, options=None):
+        obs, info = self.env.reset(seed=seed, options=options)
+        return {self._key: obs}, info
+
+
+class RenderObservation(gym.Wrapper):
+    """Add a pixel observation rendered from the env under ``pixel_key``
+    (replaces gym 0.29's PixelObservationWrapper, utils/env.py:111-113)."""
+
+    def __init__(self, env: gym.Env, pixel_key: str, pixels_only: bool = False, state_key: str = "state") -> None:
+        super().__init__(env)
+        if env.render_mode != "rgb_array":
+            raise RuntimeError(
+                f"RenderObservation requires render_mode='rgb_array', got {env.render_mode!r}"
+            )
+        self._pixel_key = pixel_key
+        self._pixels_only = pixels_only
+        self._state_key = state_key
+        frame = self._probe_frame(env)
+        pixel_space = gym.spaces.Box(0, 255, frame.shape, np.uint8)
+        if pixels_only:
+            self.observation_space = gym.spaces.Dict({pixel_key: pixel_space})
+        elif isinstance(env.observation_space, gym.spaces.Dict):
+            self.observation_space = gym.spaces.Dict(
+                {pixel_key: pixel_space, **dict(env.observation_space.items())}
+            )
+        else:
+            self.observation_space = gym.spaces.Dict(
+                {pixel_key: pixel_space, state_key: env.observation_space}
+            )
+
+    @staticmethod
+    def _probe_frame(env: gym.Env) -> np.ndarray:
+        env.reset()
+        frame = env.render()
+        if not isinstance(frame, np.ndarray):
+            raise RuntimeError(f"render() must return an ndarray, got {type(frame)}")
+        return frame
+
+    def _convert(self, obs: Any) -> Dict[str, Any]:
+        frame = np.asarray(self.env.render(), dtype=np.uint8)
+        if self._pixels_only:
+            return {self._pixel_key: frame}
+        if isinstance(obs, dict):
+            return {self._pixel_key: frame, **obs}
+        return {self._pixel_key: frame, self._state_key: obs}
+
+    def step(self, action):
+        obs, reward, done, truncated, info = self.env.step(action)
+        return self._convert(obs), reward, done, truncated, info
+
+    def reset(self, *, seed=None, options=None):
+        obs, info = self.env.reset(seed=seed, options=options)
+        return self._convert(obs), info
+
+
+class ImageTransform(gym.Wrapper):
+    """Resize / grayscale the image keys to ``[screen_size, screen_size, C]``
+    NHWC uint8 (replaces the reference's cv2 TransformObservation,
+    utils/env.py:160-201, minus the final channel-first permute)."""
+
+    def __init__(self, env: gym.Env, cnn_keys: Sequence[str], screen_size: int, grayscale: bool) -> None:
+        super().__init__(env)
+        if not isinstance(env.observation_space, gym.spaces.Dict):
+            raise RuntimeError("ImageTransform requires a Dict observation space")
+        self._cnn_keys = list(cnn_keys)
+        self._screen_size = screen_size
+        self._grayscale = grayscale
+        self.observation_space = copy.deepcopy(env.observation_space)
+        for k in self._cnn_keys:
+            self.observation_space[k] = gym.spaces.Box(
+                0, 255, (screen_size, screen_size, 1 if grayscale else 3), np.uint8
+            )
+
+    def _transform(self, img: np.ndarray) -> np.ndarray:
+        import cv2
+
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[..., np.newaxis]
+        # accept channel-first input from adapters and flip to NHWC
+        if img.shape[0] in (1, 3) and img.shape[-1] not in (1, 3):
+            img = np.transpose(img, (1, 2, 0))
+        if img.shape[:2] != (self._screen_size, self._screen_size):
+            img = cv2.resize(img, (self._screen_size, self._screen_size), interpolation=cv2.INTER_AREA)
+            if img.ndim == 2:
+                img = img[..., np.newaxis]
+        if self._grayscale and img.shape[-1] == 3:
+            img = cv2.cvtColor(img, cv2.COLOR_RGB2GRAY)[..., np.newaxis]
+        if not self._grayscale and img.shape[-1] == 1:
+            img = np.repeat(img, 3, axis=-1)
+        return img.astype(np.uint8)
+
+    def _convert(self, obs: Dict[str, Any]) -> Dict[str, Any]:
+        for k in self._cnn_keys:
+            obs[k] = self._transform(obs[k])
+        return obs
+
+    def step(self, action):
+        obs, reward, done, truncated, info = self.env.step(action)
+        return self._convert(obs), reward, done, truncated, info
+
+    def reset(self, *, seed=None, options=None):
+        obs, info = self.env.reset(seed=seed, options=options)
+        return self._convert(obs), info

@@ -262,3 +262,15 @@ def test_state_dict_roundtrip():
     rb2.add(make_data(1))
     rb2.load_state_dict(state)
     assert rb2._pos == rb._pos and rb2.full == rb.full
+
+
+def test_setitem_memmap_overwrite_keeps_file(tmp_path):
+    rb = ReplayBuffer(buffer_size=5, memmap=True, memmap_dir=tmp_path / "buf")
+    rb.add(make_data(2))
+    f = tmp_path / "buf" / "observations.memmap"
+    rb["observations"] = np.ones((5, 1, 1), dtype=np.float32)
+    import gc
+
+    gc.collect()
+    assert f.exists()
+    assert np.asarray(rb["observations"]).sum() == 5.0

@@ -63,3 +63,20 @@ def test_prefetch_early_break_and_reuse():
             break
     assert pf._thread is None  # worker cleaned up on early exit
     assert len(list(pf)) == 10  # instance is reusable
+
+
+def test_prefetch_error_with_full_queue_does_not_hang():
+    import time
+
+    calls = {"n": 0}
+
+    def sample_fn():
+        calls["n"] += 1
+        if calls["n"] >= 4:
+            raise RuntimeError("boom")
+        return {"observations": np.ones((1, 1, 1), dtype=np.float32)}
+
+    pf = DevicePrefetcher(sample_fn, n_batches=10, depth=2)
+    with pytest.raises(RuntimeError, match="prefetch worker failed"):
+        for _ in pf:
+            time.sleep(0.05)  # slow consumer keeps the queue full

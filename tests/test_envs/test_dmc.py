@@ -1,0 +1,71 @@
+"""DMC adapter specs (reference: sheeprl/envs/dmc.py contract)."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.imports import _IS_DMC_AVAILABLE
+
+if not _IS_DMC_AVAILABLE:
+    pytest.skip("dm_control not installed", allow_module_level=True)
+
+import os
+
+# headless rendering backend (the adapter defaults to EGL too)
+os.environ.setdefault("MUJOCO_GL", "egl")
+
+
+@pytest.fixture(scope="module")
+def vector_env():
+    from sheeprl_tpu.envs.dmc import DMCWrapper
+
+    return DMCWrapper("cartpole", "balance", from_pixels=False, from_vectors=True, seed=0)
+
+
+def test_vector_obs_space(vector_env):
+    obs, _ = vector_env.reset(seed=0)
+    assert set(obs.keys()) == {"state"}
+    assert obs["state"].shape == vector_env.observation_space["state"].shape
+
+
+def test_action_space_normalized(vector_env):
+    assert (vector_env.action_space.low == -1).all()
+    assert (vector_env.action_space.high == 1).all()
+
+
+def test_step_contract(vector_env):
+    vector_env.reset(seed=0)
+    obs, reward, terminated, truncated, info = vector_env.step(vector_env.action_space.sample())
+    assert np.isfinite(reward)
+    assert "discount" in info and "internal_state" in info
+    assert not terminated  # first steps of cartpole-balance never terminate
+
+
+def test_time_limit_truncates(vector_env):
+    vector_env.reset(seed=0)
+    terminated = truncated = False
+    steps = 0
+    while not (terminated or truncated) and steps < 2000:
+        _, _, terminated, truncated, _ = vector_env.step(vector_env.action_space.sample())
+        steps += 1
+    assert truncated and not terminated  # dm_control ends by time limit
+
+
+def test_both_false_raises():
+    from sheeprl_tpu.envs.dmc import DMCWrapper
+
+    with pytest.raises(ValueError):
+        DMCWrapper("cartpole", "balance", from_pixels=False, from_vectors=False)
+
+
+@pytest.mark.skipif(os.environ.get("SHEEPRL_TPU_SKIP_RENDER_TESTS") == "1", reason="no GL")
+def test_pixel_obs_nhwc():
+    from sheeprl_tpu.envs.dmc import DMCWrapper
+
+    try:
+        env = DMCWrapper("cartpole", "balance", from_pixels=True, from_vectors=True, height=32, width=32, seed=0)
+        obs, _ = env.reset(seed=0)
+    except Exception as e:  # rendering backend unavailable in CI container
+        pytest.skip(f"mujoco rendering unavailable: {e}")
+    assert obs["rgb"].shape == (32, 32, 3)
+    assert obs["rgb"].dtype == np.uint8
+    assert obs["state"].ndim == 1

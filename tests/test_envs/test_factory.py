@@ -1,0 +1,144 @@
+"""make_env factory specs (reference: sheeprl/utils/env.py:25-227 contract)."""
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.utils.utils import dotdict
+
+
+def base_cfg(**env_overrides):
+    env = {
+        "id": "dummy_discrete",
+        "num_envs": 1,
+        "frame_stack": 1,
+        "sync_env": True,
+        "screen_size": 64,
+        "action_repeat": 1,
+        "grayscale": False,
+        "clip_rewards": False,
+        "capture_video": False,
+        "frame_stack_dilation": 1,
+        "max_episode_steps": None,
+        "reward_as_observation": False,
+        "wrapper": {"_target_": "sheeprl_tpu.envs.dummy.get_dummy_env", "id": "dummy_discrete"},
+    }
+    env.update(env_overrides)
+    return dotdict(
+        {
+            "env": env,
+            "algo": {"cnn_keys": {"encoder": ["rgb"]}, "mlp_keys": {"encoder": ["state"]}},
+        }
+    )
+
+
+def test_dummy_env_dict_obs():
+    env = make_env(base_cfg(), seed=0, rank=0)()
+    obs, _ = env.reset()
+    assert set(obs.keys()) >= {"rgb", "state"}
+    assert obs["rgb"].shape == (64, 64, 3)
+    assert obs["rgb"].dtype == np.uint8
+
+
+def test_gym_vector_env_mlp_only():
+    cfg = base_cfg(
+        id="CartPole-v1",
+        wrapper={"_target_": "gymnasium.make", "id": "CartPole-v1"},
+    )
+    cfg.algo.cnn_keys.encoder = []
+    env = make_env(cfg, seed=0, rank=0)()
+    obs, _ = env.reset(seed=0)
+    assert set(obs.keys()) == {"state"}
+    assert obs["state"].shape == (4,)
+
+
+def test_gym_pixel_obs_from_render():
+    cfg = base_cfg(
+        id="CartPole-v1",
+        wrapper={"_target_": "gymnasium.make", "id": "CartPole-v1", "render_mode": "rgb_array"},
+        screen_size=32,
+        grayscale=True,
+    )
+    env = make_env(cfg, seed=0, rank=0)()
+    obs, _ = env.reset(seed=0)
+    assert obs["rgb"].shape == (32, 32, 1)
+    assert obs["state"].shape == (4,)
+
+
+def test_frame_stack_integration():
+    cfg = base_cfg(frame_stack=3, screen_size=16)
+    env = make_env(cfg, seed=0, rank=0)()
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (3, 16, 16, 3)
+
+
+def test_action_repeat_integration():
+    cfg = base_cfg(action_repeat=2)
+    env = make_env(cfg, seed=0, rank=0)()
+    env.reset()
+    env.step(env.action_space.sample())
+
+
+def test_reward_as_observation_integration():
+    cfg = base_cfg(reward_as_observation=True)
+    env = make_env(cfg, seed=0, rank=0)()
+    obs, _ = env.reset()
+    assert "reward" in obs
+
+
+def test_time_limit_integration():
+    cfg = base_cfg(max_episode_steps=3, id="dummy_continuous")
+    cfg.env.wrapper["id"] = "dummy_continuous"
+    env = make_env(cfg, seed=0, rank=0)()
+    env.reset()
+    for _ in range(2):
+        _, _, done, trunc, _ = env.step(env.action_space.sample())
+    _, _, done, trunc, _ = env.step(env.action_space.sample())
+    assert trunc
+
+
+def test_bad_keys_error():
+    cfg = base_cfg()
+    cfg.algo.cnn_keys.encoder = ["nope"]
+    cfg.algo.mlp_keys.encoder = ["also_nope"]
+    with pytest.raises(ValueError, match="not a subset"):
+        make_env(cfg, seed=0, rank=0)()
+
+
+def test_no_keys_error():
+    cfg = base_cfg()
+    cfg.algo.cnn_keys.encoder = []
+    cfg.algo.mlp_keys.encoder = []
+    with pytest.raises(ValueError):
+        make_env(cfg, seed=0, rank=0)()
+
+
+def test_pixel_only_env_requires_cnn_key():
+    cfg = base_cfg(
+        id="CarRacing-v3",
+        wrapper={"_target_": "gymnasium.make", "id": "CarRacing-v3"},
+    )
+    cfg.algo.cnn_keys.encoder = []
+    cfg.algo.mlp_keys.encoder = ["state"]
+    with pytest.raises(ValueError, match="no cnn key"):
+        make_env(cfg, seed=0, rank=0)()
+
+
+def test_episode_statistics_present():
+    cfg = base_cfg(id="dummy_discrete")
+    env = make_env(cfg, seed=0, rank=0)()
+    env.reset()
+    done = trunc = False
+    info = {}
+    while not (done or trunc):
+        _, _, done, trunc, info = env.step(env.action_space.sample())
+    assert "episode" in info
+
+
+def test_async_vector_env():
+    cfg = base_cfg()
+    envs = gym.vector.AsyncVectorEnv([make_env(cfg, seed=i, rank=0) for i in range(2)])
+    obs, _ = envs.reset()
+    assert obs["rgb"].shape == (2, 64, 64, 3)
+    envs.close()

@@ -1,0 +1,180 @@
+"""Wrapper specs (reference: sheeprl/envs/wrappers.py behaviors)."""
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    DictObservation,
+    FrameStack,
+    ImageTransform,
+    MaskVelocityWrapper,
+    RestartOnException,
+    RewardAsObservationWrapper,
+)
+
+
+class CountingEnv(gym.Env):
+    """1-D env whose obs is the step count and reward is 1 per step."""
+
+    def __init__(self, n_steps=10):
+        self.observation_space = gym.spaces.Box(-np.inf, np.inf, (1,), np.float32)
+        self.action_space = gym.spaces.Discrete(2)
+        self._t = 0
+        self._n = n_steps
+
+    def step(self, action):
+        self._t += 1
+        done = self._t >= self._n
+        return np.array([self._t], np.float32), 1.0, done, False, {}
+
+    def reset(self, seed=None, options=None):
+        self._t = 0
+        return np.array([0.0], np.float32), {}
+
+
+def test_action_repeat_sums_rewards():
+    env = ActionRepeat(CountingEnv(), 3)
+    env.reset()
+    obs, reward, done, trunc, _ = env.step(0)
+    assert reward == 3.0 and obs[0] == 3.0
+
+
+def test_action_repeat_stops_at_done():
+    env = ActionRepeat(CountingEnv(n_steps=2), 5)
+    env.reset()
+    obs, reward, done, trunc, _ = env.step(0)
+    assert done and reward == 2.0
+
+
+def test_action_repeat_invalid_amount():
+    with pytest.raises(ValueError):
+        ActionRepeat(CountingEnv(), 0)
+
+
+def test_mask_velocity():
+    env = MaskVelocityWrapper(gym.make("CartPole-v1"))
+    obs, _ = env.reset(seed=0)
+    assert obs[1] == 0.0 and obs[3] == 0.0
+
+
+def test_mask_velocity_unsupported():
+    with pytest.raises(NotImplementedError):
+        MaskVelocityWrapper(gym.make("Acrobot-v1"))
+
+
+class FlakyEnv(gym.Env):
+    """Fails the first `fail_times` step() calls."""
+
+    def __init__(self, fail_times=1):
+        self.observation_space = gym.spaces.Box(-1, 1, (1,), np.float32)
+        self.action_space = gym.spaces.Discrete(2)
+        self.fails_left = fail_times
+
+    def step(self, action):
+        if self.fails_left > 0:
+            self.fails_left -= 1
+            raise RuntimeError("env crash")
+        return np.zeros(1, np.float32), 0.0, False, False, {}
+
+    def reset(self, seed=None, options=None):
+        return np.zeros(1, np.float32), {}
+
+
+def test_restart_on_exception_recovers():
+    env = RestartOnException(lambda: FlakyEnv(fail_times=1), wait=0)
+    env.reset()
+    obs, reward, done, trunc, info = env.step(0)
+    assert info.get("restart_on_exception") is True
+    assert not done
+
+
+def test_restart_on_exception_budget_exhausted():
+    def always_broken():
+        return FlakyEnv(fail_times=10**9)
+
+    env = RestartOnException(always_broken, maxfails=2, wait=0)
+    env.reset()
+    env.step(0)
+    env.step(0)
+    with pytest.raises(RuntimeError, match="crashed too many times"):
+        env.step(0)
+
+
+def test_frame_stack_shapes_nhwc():
+    env = FrameStack(DiscreteDummyEnv(image_size=(8, 8, 3), n_steps=20), 4, ["rgb"])
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (4, 8, 8, 3)
+    assert env.observation_space["rgb"].shape == (4, 8, 8, 3)
+    # after reset all stacked frames are the reset frame
+    assert (obs["rgb"] == obs["rgb"][0]).all()
+
+
+def test_frame_stack_rolls():
+    env = FrameStack(DiscreteDummyEnv(image_size=(4, 4, 3), n_steps=20), 2, ["rgb"])
+    env.reset()
+    obs, *_ = env.step(0)
+    obs, *_ = env.step(0)
+    # dummy env encodes step index in pixel values: last frame is newest
+    assert obs["rgb"][1, 0, 0, 0] == obs["rgb"][0, 0, 0, 0] + 1
+
+
+def test_frame_stack_dilation():
+    env = FrameStack(DiscreteDummyEnv(image_size=(4, 4, 3), n_steps=50), 2, ["rgb"], dilation=2)
+    env.reset()
+    for _ in range(4):
+        obs, *_ = env.step(0)
+    assert obs["rgb"].shape == (2, 4, 4, 3)
+    # dilation 2: stacked frames are 2 steps apart
+    assert obs["rgb"][1, 0, 0, 0] - obs["rgb"][0, 0, 0, 0] == 2
+
+
+def test_frame_stack_errors():
+    with pytest.raises(ValueError):
+        FrameStack(DiscreteDummyEnv(), 0, ["rgb"])
+    with pytest.raises(RuntimeError):
+        FrameStack(CountingEnv(), 2, ["rgb"])
+    with pytest.raises(RuntimeError):
+        FrameStack(DiscreteDummyEnv(), 2, ["not_an_image"])
+
+
+def test_reward_as_observation_dict_env():
+    env = RewardAsObservationWrapper(DiscreteDummyEnv())
+    obs, _ = env.reset()
+    assert obs["reward"].shape == (1,) and obs["reward"][0] == 0.0
+    assert "reward" in env.observation_space.spaces
+    obs, *_ = env.step(0)
+    assert obs["reward"][0] == 0.0
+
+
+def test_reward_as_observation_box_env():
+    env = RewardAsObservationWrapper(CountingEnv())
+    obs, _ = env.reset()
+    assert set(obs.keys()) == {"obs", "reward"}
+    obs, reward, *_ = env.step(0)
+    assert obs["reward"][0] == reward
+
+
+def test_dict_observation():
+    env = DictObservation(CountingEnv(), "state")
+    obs, _ = env.reset()
+    assert obs["state"].shape == (1,)
+    assert isinstance(env.observation_space, gym.spaces.Dict)
+    with pytest.raises(RuntimeError):
+        DictObservation(DiscreteDummyEnv(), "x")
+
+
+def test_image_transform_resize_and_grayscale():
+    env = ImageTransform(DiscreteDummyEnv(image_size=(32, 32, 3), n_steps=10), ["rgb"], 16, True)
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (16, 16, 1)
+    assert obs["rgb"].dtype == np.uint8
+    assert env.observation_space["rgb"].shape == (16, 16, 1)
+
+
+def test_image_transform_keeps_rgb():
+    env = ImageTransform(DiscreteDummyEnv(image_size=(32, 32, 3), n_steps=10), ["rgb"], 64, False)
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (64, 64, 3)
