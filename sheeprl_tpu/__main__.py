@@ -1,0 +1,6 @@
+"""``python -m sheeprl_tpu exp=... overrides`` (reference: sheeprl.py:3)."""
+
+from sheeprl_tpu.cli import run
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,2 @@
+from sheeprl_tpu.algos.ppo import ppo  # noqa: F401  (registers the algorithm)
+from sheeprl_tpu.algos.ppo import evaluate  # noqa: F401  (registers the evaluation)

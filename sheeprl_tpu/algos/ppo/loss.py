@@ -1,0 +1,53 @@
+"""PPO losses (reference: sheeprl/algos/ppo/loss.py:6-72) as pure jnp
+functions; the reduction is applied by the caller's mean over the minibatch."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _reduce(x: Array, reduction: str) -> Array:
+    reduction = reduction.lower()
+    if reduction == "none":
+        return x
+    if reduction == "mean":
+        return x.mean()
+    if reduction == "sum":
+        return x.sum()
+    raise ValueError(f"Unrecognized reduction: {reduction}")
+
+
+def policy_loss(
+    new_logprobs: Array,
+    logprobs: Array,
+    advantages: Array,
+    clip_coef: Array,
+    reduction: str = "mean",
+) -> Array:
+    """Clipped surrogate objective, eq. (7) of the PPO paper."""
+    ratio = jnp.exp(new_logprobs - logprobs)
+    pg_loss1 = advantages * ratio
+    pg_loss2 = advantages * jnp.clip(ratio, 1 - clip_coef, 1 + clip_coef)
+    return _reduce(-jnp.minimum(pg_loss1, pg_loss2), reduction)
+
+
+def value_loss(
+    new_values: Array,
+    old_values: Array,
+    returns: Array,
+    clip_coef: Array,
+    clip_vloss: bool,
+    reduction: str = "mean",
+) -> Array:
+    if clip_vloss:
+        values_pred = old_values + jnp.clip(new_values - old_values, -clip_coef, clip_coef)
+    else:
+        values_pred = new_values
+    return _reduce(jnp.square(values_pred - returns), reduction)
+
+
+def entropy_loss(entropy: Array, reduction: str = "mean") -> Array:
+    return _reduce(-entropy, reduction)

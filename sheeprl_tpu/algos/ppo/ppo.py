@@ -1,0 +1,402 @@
+"""PPO, coupled (reference: sheeprl/algos/ppo/ppo.py:30-452) — TPU-native.
+
+Differences from the reference that are the point of the redesign:
+
+- **One SPMD process per host, no launcher.** The reference spawns DDP ranks
+  (cli.py:190); here the rollout data ``[T*E, ...]`` is sharded across the
+  mesh's data axis and the whole optimization (epochs x minibatches) runs as
+  a single jitted ``shard_map`` — the per-minibatch gradient ``pmean`` over
+  ICI is the DDP all-reduce (ppo.py:93 ``fabric.backward``).
+- **Whole-update fusion.** The reference's Python epoch/minibatch loops with
+  per-batch optimizer steps become two nested ``lax.scan``s inside one XLA
+  program: one dispatch per update instead of epochs*minibatches.
+- **GAE on device** as a reverse ``lax.scan`` (reference utils.py:63-100 is
+  a Python loop over T).
+- **uint8 to the MXU.** Pixels cross PCIe as bytes; normalization happens
+  inside the agent (agent.py CNNEncoder), not in ``normalize_obs``.
+- Annealed coefficients (clip/entropy) are *dynamic scalars* fed to the
+  jitted step — annealing never recompiles.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from functools import partial
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from sheeprl_tpu.algos.ppo.agent import PPOPlayer, build_agent, evaluate_actions
+from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
+from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, prepare_obs, test
+from sheeprl_tpu.config.compose import instantiate
+from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.ops.math import gae
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
+
+
+def make_train_fn(fabric, agent, tx, cfg, obs_keys, n_local: int):
+    """Build the fused update: epochs x shuffled minibatches, grad-pmean'd
+    over the data axis, one jit (replaces reference train(), ppo.py:30-102)."""
+    batch_size = int(cfg.algo.per_rank_batch_size)
+    update_epochs = int(cfg.algo.update_epochs)
+    num_minibatches = n_local // batch_size
+    if num_minibatches == 0:
+        raise ValueError(
+            f"per_rank_batch_size ({batch_size}) is larger than the per-device rollout ({n_local})"
+        )
+    dropped = n_local - num_minibatches * batch_size
+    if dropped:
+        warnings.warn(
+            f"{dropped} of {n_local} per-device rollout samples are dropped each epoch because "
+            f"per_rank_batch_size ({batch_size}) does not divide the per-device rollout; "
+            "choose rollout_steps*num_envs divisible by (devices*batch_size) to use all data."
+        )
+    vf_coef = float(cfg.algo.vf_coef)
+    clip_vloss = bool(cfg.algo.clip_vloss)
+    normalize_adv = bool(cfg.algo.normalize_advantages)
+    reduction = str(cfg.algo.loss_reduction)
+    data_axis = fabric.data_axis
+
+    def local_train(params, opt_state, data, key, clip_coef, ent_coef):
+        # distinct permutation stream per device (reference: per-rank sampler)
+        key = jax.random.fold_in(key, lax.axis_index(data_axis))
+
+        def minibatch_step(carry, batch):
+            params, opt_state = carry
+
+            def loss_fn(p):
+                obs = {k: batch[k] for k in obs_keys}
+                new_logprobs, entropy, new_values = evaluate_actions(agent, p, obs, batch["actions"])
+                adv = batch["advantages"]
+                if normalize_adv:
+                    adv = (adv - adv.mean()) / (adv.std(ddof=1) + 1e-8)
+                pg = policy_loss(new_logprobs, batch["logprobs"], adv, clip_coef, reduction)
+                v = value_loss(new_values, batch["values"], batch["returns"], clip_coef, clip_vloss, reduction)
+                ent = entropy_loss(entropy, reduction)
+                return pg + vf_coef * v + ent_coef * ent, (pg, v, ent)
+
+            (_, (pg, v, ent)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads = lax.pmean(grads, data_axis)  # the DDP all-reduce, over ICI
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), jnp.stack([pg, v, ent])
+
+        def epoch_step(carry, _):
+            params, opt_state, key = carry
+            key, perm_key = jax.random.split(key)
+            perm = jax.random.permutation(perm_key, n_local)[: num_minibatches * batch_size]
+            minibatches = jax.tree.map(
+                lambda x: x[perm].reshape(num_minibatches, batch_size, *x.shape[1:]), data
+            )
+            (params, opt_state), metrics = lax.scan(minibatch_step, (params, opt_state), minibatches)
+            return (params, opt_state, key), metrics
+
+        (params, opt_state, _), metrics = lax.scan(
+            epoch_step, (params, opt_state, key), None, length=update_epochs
+        )
+        # [epochs, minibatches, 3] -> [3], identical on every device after pmean
+        return params, opt_state, lax.pmean(metrics.mean(axis=(0, 1)), data_axis)
+
+    train_fn = shard_map(
+        local_train,
+        mesh=fabric.mesh,
+        in_specs=(P(), P(), P(data_axis), P(), P(), P()),
+        out_specs=(P(), P(), P()),
+        check_rep=False,
+    )
+    return jax.jit(train_fn, donate_argnums=(0, 1))
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any]):
+    if cfg.checkpoint.resume_from:
+        state = fabric.load(cfg.checkpoint.resume_from)
+
+    log_dir = get_log_dir(cfg)
+    logger = get_logger(cfg, log_dir)
+    fabric.logger = logger
+    logger.log_hyperparams(cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg))
+    print(f"Log dir: {log_dir}")
+
+    initial_clip_coef = float(cfg.algo.clip_coef)
+    initial_ent_coef = float(cfg.algo.ent_coef)
+
+    # environment setup (reference ppo.py:137-163); SAME_STEP autoreset keeps
+    # the 0.29 semantics the algorithms were specified against
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    rank = fabric.process_index
+    envs = vectorized_env(
+        [
+            make_env(
+                cfg,
+                cfg.seed + rank * cfg.env.num_envs + i,
+                rank * cfg.env.num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
+            )
+            for i in range(cfg.env.num_envs)
+        ],
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+    )
+    observation_space = envs.single_observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+    if not obs_keys:
+        raise RuntimeError(
+            "You should specify at least one CNN key or MLP key from the cli: "
+            "`algo.cnn_keys.encoder=[rgb]` or `algo.mlp_keys.encoder=[state]`"
+        )
+
+    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        envs.single_action_space.shape
+        if is_continuous
+        else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
+    )
+
+    agent, params = build_agent(
+        fabric,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["agent"] if cfg.checkpoint.resume_from else None,
+    )
+    player = PPOPlayer(agent, params)
+
+    num_envs = int(cfg.env.num_envs)
+    rollout_steps = int(cfg.algo.rollout_steps)
+    world_size = fabric.world_size
+    policy_steps_per_update = num_envs * rollout_steps * fabric.num_nodes
+    num_updates = int(cfg.algo.total_steps) // policy_steps_per_update if not cfg.dry_run else 1
+
+    n_global = rollout_steps * num_envs
+    if n_global % world_size != 0:
+        raise ValueError(
+            f"rollout_steps*num_envs ({n_global}) must be divisible by the number of devices ({world_size})"
+        )
+    n_local = n_global // world_size
+    num_minibatches = n_local // int(cfg.algo.per_rank_batch_size)
+    if num_minibatches == 0:
+        raise ValueError(
+            f"per_rank_batch_size ({cfg.algo.per_rank_batch_size}) is larger than the "
+            f"per-device rollout ({n_local})"
+        )
+
+    # optimizer; lr annealing is an optax schedule (reference PolynomialLR)
+    opt_cfg = dict(cfg.algo.optimizer.to_dict() if hasattr(cfg.algo.optimizer, "to_dict") else cfg.algo.optimizer)
+    if cfg.algo.max_grad_norm and float(cfg.algo.max_grad_norm) > 0:
+        opt_cfg["max_grad_norm"] = float(cfg.algo.max_grad_norm)
+    if cfg.algo.anneal_lr:
+        steps_per_update = int(cfg.algo.update_epochs) * num_minibatches
+        opt_cfg["schedule"] = optax.linear_schedule(
+            float(opt_cfg.get("lr", 1e-3)), 0.0, num_updates * steps_per_update
+        )
+    tx = instantiate(opt_cfg)
+    opt_state = fabric.replicate(tx.init(jax.device_get(params)))
+    if cfg.checkpoint.resume_from:
+        opt_state = fabric.replicate(
+            jax.tree.map(jnp.asarray, state["opt_state"], is_leaf=lambda x: isinstance(x, np.ndarray))
+        )
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = MetricAggregator(cfg.metric.get("aggregator", {}).get("metrics", {}) or {})
+    for k in AGGREGATOR_KEYS - set(aggregator.metrics):
+        aggregator.add(k, "mean")
+
+    if cfg.buffer.size < rollout_steps:
+        raise ValueError(
+            f"The size of the buffer ({cfg.buffer.size}) cannot be lower "
+            f"than the rollout steps ({rollout_steps})"
+        )
+    # the rollout is consumed in-place each update (on-policy); unlike the
+    # reference there is no staging ReplayBuffer copy — host lists are the
+    # only transient storage
+
+    train_fn = make_train_fn(fabric, agent, tx, cfg, obs_keys, n_local)
+    gae_fn = jax.jit(partial(gae, gamma=float(cfg.algo.gamma), gae_lambda=float(cfg.algo.gae_lambda)))
+
+    # counters (reference ppo.py:214-231)
+    start_update = (state["update"] + 1) if cfg.checkpoint.resume_from else 1
+    policy_step = state["update"] * policy_steps_per_update if cfg.checkpoint.resume_from else 0
+    last_log = state["last_log"] if cfg.checkpoint.resume_from else 0
+    last_checkpoint = state["last_checkpoint"] if cfg.checkpoint.resume_from else 0
+    train_step = 0
+    last_train = 0
+
+    key = jax.random.PRNGKey(int(cfg.seed))
+    if cfg.checkpoint.resume_from and "rng_key" in state:
+        key = jnp.asarray(state["rng_key"])
+
+    clip_coef = float(cfg.algo.clip_coef)
+    ent_coef = float(cfg.algo.ent_coef)
+
+    next_obs, _ = envs.reset(seed=cfg.seed)
+    next_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=num_envs)
+
+    for update in range(start_update, num_updates + 1):
+        rollout = {k: [] for k in (*obs_keys, "dones", "values", "actions", "logprobs", "rewards")}
+        with timer("Time/env_interaction_time"):
+            for _ in range(rollout_steps):
+                policy_step += num_envs * fabric.num_nodes
+                key, action_key = jax.random.split(key)
+                actions, logprobs, values = player.get_actions(next_obs, action_key)
+                # ONE device->host fetch per step: over a remote-attached TPU
+                # a round trip costs ~100ms, so separate np.asarray() calls on
+                # actions/logprobs/values would triple the rollout latency
+                actions_np, logprobs_np, values_np = jax.device_get((actions, logprobs, values))
+                if is_continuous:
+                    real_actions = actions_np
+                else:
+                    splits = np.cumsum(actions_dim)[:-1]
+                    real_actions = np.stack(
+                        [p.argmax(-1) for p in np.split(actions_np, splits, axis=-1)], axis=-1
+                    )
+                    if real_actions.shape[-1] == 1 and not is_multidiscrete:
+                        real_actions = real_actions[..., 0]
+
+                obs, rewards, terminated, truncated, info = envs.step(
+                    real_actions.reshape(envs.action_space.shape)
+                )
+                rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, 1)
+
+                # truncation bootstrap (reference ppo.py:286-305)
+                truncated_envs = np.nonzero(truncated)[0]
+                if len(truncated_envs) > 0 and "final_obs" in info:
+                    final_obs = {
+                        k: np.stack([np.asarray(info["final_obs"][e][k]) for e in truncated_envs])
+                        for k in obs_keys
+                    }
+                    final_obs = prepare_obs(final_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
+                    vals = np.asarray(player.get_values(final_obs)).reshape(len(truncated_envs))
+                    rewards[truncated_envs, 0] += float(cfg.algo.gamma) * vals
+
+                dones = np.logical_or(terminated, truncated).reshape(num_envs, 1).astype(np.float32)
+                for k in obs_keys:
+                    rollout[k].append(next_obs[k])
+                rollout["dones"].append(dones)
+                rollout["values"].append(values_np)
+                rollout["actions"].append(actions_np)
+                rollout["logprobs"].append(logprobs_np)
+                rollout["rewards"].append(rewards)
+
+                next_obs = prepare_obs(obs, cnn_keys=cnn_keys, num_envs=num_envs)
+
+                if cfg.metric.log_level > 0 and "final_info" in info:
+                    ep = info["final_info"].get("episode")
+                    if ep is not None:
+                        for i in np.nonzero(ep.get("_r", []))[0]:
+                            aggregator.update("Rewards/rew_avg", float(ep["r"][i]))
+                            aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
+                            print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep['r'][i]}")
+
+        local_data = {k: np.stack(v, axis=0) for k, v in rollout.items()}  # [T, E, ...]
+
+        # GAE on device (reference ppo.py:345-360)
+        next_values = np.asarray(player.get_values(next_obs))  # [E, 1]
+        returns, advantages = gae_fn(
+            jnp.asarray(local_data["rewards"]),
+            jnp.asarray(local_data["values"]),
+            jnp.asarray(local_data["dones"]),
+            jnp.asarray(next_values),
+        )
+        local_data["returns"] = np.asarray(returns)
+        local_data["advantages"] = np.asarray(advantages)
+
+        # flatten [T, E, ...] -> [T*E, ...]; shard_map splits over devices
+        flat = {k: v.reshape(v.shape[0] * v.shape[1], *v.shape[2:]) for k, v in local_data.items()}
+
+        with timer("Time/train_time"):
+            key, train_key = jax.random.split(key)
+            params, opt_state, metrics = train_fn(
+                params,
+                opt_state,
+                flat,
+                train_key,
+                jnp.float32(clip_coef),
+                jnp.float32(ent_coef),
+            )
+            metrics = jax.block_until_ready(metrics)
+        player.params = params
+        train_step += world_size
+
+        if cfg.metric.log_level > 0:
+            aggregator.update("Loss/policy_loss", float(metrics[0]))
+            aggregator.update("Loss/value_loss", float(metrics[1]))
+            aggregator.update("Loss/entropy_loss", float(metrics[2]))
+
+            if policy_step - last_log >= cfg.metric.log_every or update == num_updates:
+                metrics_dict = aggregator.compute()
+                logger.log_metrics(metrics_dict, policy_step)
+                aggregator.reset()
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if timer_metrics.get("Time/train_time"):
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                            policy_step,
+                        )
+                    if timer_metrics.get("Time/env_interaction_time"):
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) * cfg.env.action_repeat
+                                )
+                                / timer_metrics["Time/env_interaction_time"]
+                            },
+                            policy_step,
+                        )
+                    timer.reset()
+                last_log = policy_step
+                last_train = train_step
+
+        # anneal coefficients (reference ppo.py:414-424)
+        if cfg.algo.anneal_clip_coef:
+            clip_coef = polynomial_decay(
+                update, initial=initial_clip_coef, final=0.0, max_decay_steps=num_updates, power=1.0
+            )
+        if cfg.algo.anneal_ent_coef:
+            ent_coef = polynomial_decay(
+                update, initial=initial_ent_coef, final=0.0, max_decay_steps=num_updates, power=1.0
+            )
+
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            update == num_updates and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": jax.device_get(params),
+                "opt_state": jax.device_get(opt_state),
+                "update": update,
+                "batch_size": int(cfg.algo.per_rank_batch_size) * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+                "rng_key": jax.device_get(key),
+            }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
+            fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(player, fabric, cfg, log_dir)
+    logger.finalize()

@@ -1,0 +1,83 @@
+"""PPO helpers (reference: sheeprl/algos/ppo/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/entropy_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], cnn_keys: Sequence[str] = (), num_envs: int = 1
+) -> Dict[str, np.ndarray]:
+    """Shape env observations for the agent (reference utils.py prepare_obs):
+    fold a frame-stack axis into channels (``[E,S,H,W,C] -> [E,H,W,S*C]``)
+    and ensure a leading batch axis. Pixel dtype stays uint8 — the agent
+    normalizes on device."""
+    out: Dict[str, np.ndarray] = {}
+    for k, v in obs.items():
+        v = np.asarray(v)
+        if k in cnn_keys:
+            if v.ndim == 3:  # single env, unstacked [H,W,C]
+                v = v[None]
+            if v.ndim == 4 and v.shape[0] != num_envs:  # [S,H,W,C] single env stack
+                v = v[None]
+            if v.ndim == 5:  # [E,S,H,W,C] -> [E,H,W,S*C]
+                e, s, h, w, c = v.shape
+                v = np.moveaxis(v, 1, 3).reshape(e, h, w, s * c)
+        else:
+            if v.ndim == 1:
+                v = v[None]
+            v = v.astype(np.float32)
+        out[k] = v
+    return out
+
+
+def test(player: Any, fabric: Any, cfg: Dict[str, Any], log_dir: str) -> None:
+    """Greedy evaluation episode (reference utils.py test): runs one episode
+    and logs Test/cumulative_reward."""
+    from sheeprl_tpu.envs import make_env
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    key = jax.random.PRNGKey(cfg.seed)
+    obs, _ = env.reset(seed=cfg.seed)
+    while not done:
+        key, sub = jax.random.split(key)
+        torch_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder)
+        actions, _, _ = player.get_actions(torch_obs, sub, greedy=True)
+        actions = np.asarray(actions)
+        if player.agent.is_continuous:
+            real_actions = actions[0]
+        else:
+            splits = np.cumsum(player.agent.actions_dim)[:-1]
+            real_actions = np.array([p.argmax(-1) for p in np.split(actions[0], splits, axis=-1)])
+            if len(real_actions) == 1:
+                real_actions = real_actions[0]
+        obs, reward, terminated, truncated, _ = env.step(real_actions)
+        done = terminated or truncated
+        cumulative_rew += float(reward)
+    fabric_print = getattr(fabric, "print", print)
+    fabric_print(f"Test - Reward: {cumulative_rew}")
+    if cfg.metric.log_level > 0 and getattr(fabric, "logger", None) is not None:
+        fabric.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()
+
+
+def normalize_obs(
+    obs: Dict[str, Any], cnn_keys: Sequence[str], obs_keys: Sequence[str]
+) -> Dict[str, Any]:
+    """Reference utils.py normalize_obs — here a passthrough selector: pixel
+    normalization happens inside the agent module (agent.py CNNEncoder)."""
+    return {k: obs[k] for k in obs_keys}

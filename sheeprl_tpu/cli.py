@@ -1,0 +1,188 @@
+"""CLI dispatcher (reference: sheeprl/cli.py:23-436).
+
+``python -m sheeprl_tpu exp=<exp> key=value ...`` composes the config tree,
+validates it, looks the algorithm up in the registry and calls its
+entrypoint. Unlike the reference there is no ``fabric.launch`` process spawn:
+JAX is SPMD — one process per host drives every local chip, and multi-host
+runs start the same command on every host (``jax.distributed`` connects
+them), replacing the launcher model of cli.py:190.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import sys
+import warnings
+from typing import Any, Dict, List, Optional
+
+from sheeprl_tpu.config import compose
+from sheeprl_tpu.config.compose import instantiate
+from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry
+from sheeprl_tpu.utils.utils import dotdict, print_config
+
+
+def resume_from_checkpoint(cfg: dotdict) -> dotdict:
+    """Merge the run config stored beside the checkpoint, keeping the current
+    run's checkpoint/resume settings (reference cli.py:23-48)."""
+    import yaml
+
+    ckpt_path = cfg.checkpoint.resume_from
+    old_cfg_path = os.path.join(os.path.dirname(os.path.dirname(ckpt_path)), "config.yaml")
+    if not os.path.isfile(old_cfg_path):
+        raise ValueError(f"no config.yaml found next to the checkpoint: {old_cfg_path}")
+    with open(old_cfg_path) as f:
+        old_cfg = dotdict(yaml.safe_load(f))
+    if old_cfg.env.id != cfg.env.id:
+        raise ValueError(
+            f"This experiment is run with a different environment from the checkpoint: "
+            f"{cfg.env.id} vs {old_cfg.env.id}"
+        )
+    if old_cfg.algo.name != cfg.algo.name:
+        raise ValueError(
+            f"This experiment is run with a different algorithm from the checkpoint: "
+            f"{cfg.algo.name} vs {old_cfg.algo.name}"
+        )
+    merged = dotdict(old_cfg.to_dict())
+    merged.checkpoint = dotdict(cfg.checkpoint.to_dict())
+    merged.root_dir = cfg.root_dir
+    merged.run_name = cfg.run_name
+    return merged
+
+
+def check_configs(cfg: dotdict) -> None:
+    """Config sanity checks (reference cli.py:262-331)."""
+    if cfg.algo.name is None:
+        raise ValueError("algo.name must be set")
+    entry = _find_entry(cfg.algo.name)
+    if entry is None:
+        registered = sorted({e["name"] for entries in algorithm_registry.values() for e in entries})
+        raise ValueError(
+            f"Given the algorithm named '{cfg.algo.name}', no registered algorithm has been found. "
+            f"Registered algorithms: {registered}"
+        )
+    if cfg.metric.log_level > 0 and not cfg.metric.get("aggregator"):
+        raise ValueError("metric.aggregator must be set when metric.log_level > 0")
+
+
+def _find_entry(algo_name: str) -> Optional[Dict[str, Any]]:
+    for module, entries in algorithm_registry.items():
+        for entry in entries:
+            if entry["name"] == algo_name:
+                return {"module": module, **entry}
+    return None
+
+
+def run_algorithm(cfg: dotdict) -> None:
+    """Registry lookup → fabric build → entrypoint (reference cli.py:51-190)."""
+    entry = _find_entry(cfg.algo.name)
+    module = importlib.import_module(entry["module"])
+    entrypoint = getattr(module, entry["entrypoint"])
+
+    fabric_cfg = dict(cfg.fabric.to_dict() if isinstance(cfg.fabric, dotdict) else cfg.fabric)
+    callbacks = [instantiate(cb) for cb in fabric_cfg.pop("callbacks", None) or []]
+    fabric = instantiate({**fabric_cfg, "callbacks": callbacks})
+
+    # keep the aggregator's metric whitelist aligned with what the algorithm
+    # produces (reference cli.py:142-156)
+    utils_module_name = entry["module"].rsplit(".", 1)[0] + ".utils"
+    try:
+        algo_utils = importlib.import_module(utils_module_name)
+        keys = set(getattr(algo_utils, "AGGREGATOR_KEYS", set()))
+        agg_cfg = cfg.metric.get("aggregator", {})
+        metrics = agg_cfg.get("metrics", {}) or {}
+        dropped = [k for k in metrics if k not in keys]
+        for k in dropped:
+            metrics.pop(k)
+    except ModuleNotFoundError:
+        pass
+
+    entrypoint(fabric, cfg)
+
+
+def run(args: Optional[List[str]] = None) -> None:
+    """Main entry (reference cli.py:344-352)."""
+    overrides = list(sys.argv[1:] if args is None else args)
+    cfg = compose("config", overrides)
+    cfg = dotdict(cfg)
+    if cfg.checkpoint.resume_from:
+        cfg = resume_from_checkpoint(cfg)
+    if cfg.metric.log_level > 0:
+        print_config(cfg)
+    check_configs(cfg)
+    os.environ.setdefault("OMP_NUM_THREADS", str(cfg.num_threads))
+    run_algorithm(cfg)
+
+
+def eval_algorithm(cfg: dotdict) -> None:
+    """Load a checkpoint and run the registered evaluation
+    (reference cli.py:193-259)."""
+    entry = None
+    for module, entries in evaluation_registry.items():
+        for e in entries:
+            if e["name"] == cfg.algo.name:
+                entry = {"module": module, **e}
+    if entry is None:
+        registered = sorted({e["name"] for entries in evaluation_registry.values() for e in entries})
+        raise ValueError(
+            f"no registered evaluation for algorithm '{cfg.algo.name}'; available: {registered}"
+        )
+    module = importlib.import_module(entry["module"])
+    evaluate_fn = getattr(module, entry["entrypoint"])
+
+    from sheeprl_tpu.parallel.fabric import Fabric
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+    fabric = Fabric(devices=1, precision=str(cfg.fabric.get("precision", "fp32")))
+    state = load_checkpoint(cfg.checkpoint_path)
+    evaluate_fn(fabric, cfg, state)
+
+
+def evaluation(args: Optional[List[str]] = None) -> None:
+    """``python -m sheeprl_tpu.cli_eval checkpoint_path=... [overrides]``
+    (reference cli.py:355-391): rebuild the training config stored beside the
+    checkpoint, force single-device / single-env, then evaluate."""
+    import yaml
+
+    overrides = list(sys.argv[1:] if args is None else args)
+    kv = dict(o.split("=", 1) for o in overrides if "=" in o and not o.startswith(("+", "~")))
+    ckpt_path = kv.get("checkpoint_path")
+    if not ckpt_path:
+        raise ValueError("checkpoint_path=<file> is required")
+    cfg_path = os.path.join(os.path.dirname(os.path.dirname(ckpt_path)), "config.yaml")
+    with open(cfg_path) as f:
+        cfg = dotdict(yaml.safe_load(f))
+    cfg.checkpoint_path = ckpt_path
+    cfg.env.num_envs = 1
+    cfg.env.capture_video = kv.get("env.capture_video", "False").lower() in ("1", "true")
+    cfg.fabric["devices"] = 1
+    for k, v in kv.items():
+        if k in ("checkpoint_path", "env.capture_video"):
+            continue
+        node = cfg
+        parts = k.split(".")
+        for p in parts[:-1]:
+            node = node[p]
+        node[parts[-1]] = yaml.safe_load(v)
+    eval_algorithm(cfg)
+
+
+def available_agents() -> None:
+    """Print the registry as a table (reference available_agents.py:7)."""
+    try:
+        from rich.console import Console
+        from rich.table import Table
+
+        table = Table(title="SheepRL-TPU agents")
+        table.add_column("Module")
+        table.add_column("Algorithm")
+        table.add_column("Entrypoint")
+        table.add_column("Decoupled")
+        for module, entries in algorithm_registry.items():
+            for e in entries:
+                table.add_row(module, e["name"], e["entrypoint"], str(e["decoupled"]))
+        Console().print(table)
+    except ImportError:
+        for module, entries in algorithm_registry.items():
+            for e in entries:
+                print(f"{module}: {e['name']} ({e['entrypoint']}), decoupled={e['decoupled']}")

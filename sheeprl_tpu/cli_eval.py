@@ -1,0 +1,6 @@
+"""``python -m sheeprl_tpu.cli_eval checkpoint_path=...`` (reference: sheeprl_eval.py)."""
+
+from sheeprl_tpu.cli import evaluation
+
+if __name__ == "__main__":
+    evaluation()

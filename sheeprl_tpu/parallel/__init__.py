@@ -1,0 +1,17 @@
+"""Mesh runtime + collectives (replaces lightning.fabric, SURVEY.md §2.7)."""
+
+from sheeprl_tpu.parallel.fabric import Fabric, Precision, seed_everything
+from sheeprl_tpu.parallel.collectives import (
+    all_gather_object,
+    broadcast_object,
+    host_allreduce_sum,
+)
+
+__all__ = [
+    "Fabric",
+    "Precision",
+    "all_gather_object",
+    "broadcast_object",
+    "host_allreduce_sum",
+    "seed_everything",
+]

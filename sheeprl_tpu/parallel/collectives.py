@@ -1,0 +1,74 @@
+"""Collectives (reference: §2.7 call-site inventory — fabric.all_gather /
+all_reduce and TorchCollective object collectives).
+
+Two planes:
+
+- **Device plane**: inside jitted/shard_mapped code use ``jax.lax.psum`` /
+  ``pmean`` / ``all_gather`` with a mesh axis name directly — XLA lowers them
+  onto ICI. Nothing to wrap; algorithms reference ``fabric.data_axis``.
+- **Host/object plane**: the reference moves *Python objects* (log dirs,
+  configs, replay-buffer gathers) over gloo object collectives
+  (utils/logger.py:52-88, callback.py:40-51). The JAX counterpart here rides
+  the device ICI/DCN fabric: objects are pickled to uint8 arrays and moved
+  with ``jax.experimental.multihost_utils``-style broadcast built on
+  ``process_allgather`` semantics. Single-process fall-through is free.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, List
+
+import jax
+import numpy as np
+
+
+def broadcast_object(obj: Any, src: int = 0) -> Any:
+    """Broadcast a picklable object from process ``src`` to every process
+    (replaces TorchCollective.broadcast_object_list, utils/logger.py:83-88)."""
+    if jax.process_count() == 1:
+        return obj
+    from jax.experimental import multihost_utils
+
+    payload = pickle.dumps(obj) if jax.process_index() == src else b""
+    # equalize lengths: first agree on the size, then ship the bytes
+    size = np.asarray([len(payload)], dtype=np.int64)
+    all_sizes = multihost_utils.process_allgather(size)
+    max_size = int(all_sizes.max())
+    buf = np.zeros(max_size, dtype=np.uint8)
+    if jax.process_index() == src:
+        buf[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    gathered = multihost_utils.process_allgather(buf)
+    data = gathered[src]
+    length = int(all_sizes[src, 0])
+    return pickle.loads(data[:length].tobytes())
+
+
+def all_gather_object(obj: Any) -> List[Any]:
+    """Gather one picklable object per process to every process (replaces
+    gloo ``gather_object`` buffer gathers, callback.py:40-51)."""
+    if jax.process_count() == 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+
+    payload = pickle.dumps(obj)
+    size = np.asarray([len(payload)], dtype=np.int64)
+    all_sizes = multihost_utils.process_allgather(size)
+    max_size = int(all_sizes.max())
+    buf = np.zeros(max_size, dtype=np.uint8)
+    buf[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    gathered = multihost_utils.process_allgather(buf)
+    return [
+        pickle.loads(gathered[p, : int(all_sizes[p, 0])].tobytes()) for p in range(jax.process_count())
+    ]
+
+
+def host_allreduce_sum(value: float) -> float:
+    """Sum a host scalar across processes (replaces small fabric.all_reduce
+    host syncs, e.g. metric counters)."""
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    arr = np.asarray([value], dtype=np.float64)
+    return float(multihost_utils.process_allgather(arr).sum())

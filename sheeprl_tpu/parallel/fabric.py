@@ -1,0 +1,247 @@
+"""TPU mesh runtime — the framework's replacement for ``lightning.fabric``
+(reference L0, SURVEY.md §1/§2.7).
+
+Where the reference wraps each module in DDP and all-reduces gradients over
+NCCL (``fabric.setup_module`` / ``fabric.backward``), here distribution is
+*declarative*: a ``jax.sharding.Mesh`` with a ``data`` axis (optionally a
+``model`` axis for param sharding), batches placed with a data-axis
+``NamedSharding`` and params replicated. A ``jax.jit`` train step closed over
+those shardings gets its gradient all-reduce inserted by XLA as an ICI
+collective — there is no imperative backward/all-reduce pair to call.
+
+Multi-host: ``jax.distributed.initialize`` (DCN) is triggered by env vars or
+explicit coordinator config; the same mesh then spans all processes and the
+identical jitted step runs on every host (SPMD), replacing the reference's
+launcher-spawned DDP ranks (cli.py:190).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def seed_everything(seed: int) -> jax.Array:
+    """Seed numpy + return the root PRNG key (reference reproducibility
+    wrapper, cli.py:174-189; torch/cudnn flags have no TPU counterpart —
+    XLA is deterministic modulo collective reduction order)."""
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+_PRECISIONS = ("fp32", "bf16-mixed", "bf16-true")
+# lightning-style spellings accepted from configs (reference fabric configs)
+_PRECISION_ALIASES = {"32-true": "fp32", "32": "fp32", "bf16": "bf16-mixed"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Precision:
+    """Numeric policy (reference: Fabric precision ``bf16-mixed``,
+    configs/fabric/default.yaml; SURVEY §2.8.3).
+
+    - ``fp32``: everything float32.
+    - ``bf16-mixed``: fp32 params/optimizer state, bf16 compute on the MXU —
+      the policy matching the reference's GPU recipe.
+    - ``bf16-true``: bf16 params and compute (halves HBM, used by the
+      reference test-suite).
+    """
+
+    name: str = "fp32"
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "name", _PRECISION_ALIASES.get(self.name, self.name))
+        if self.name not in _PRECISIONS:
+            raise ValueError(
+                f"unknown precision {self.name!r}; choose from {_PRECISIONS} (aliases: {_PRECISION_ALIASES})"
+            )
+
+    @property
+    def param_dtype(self) -> jnp.dtype:
+        return jnp.bfloat16 if self.name == "bf16-true" else jnp.float32
+
+    @property
+    def compute_dtype(self) -> jnp.dtype:
+        return jnp.bfloat16 if self.name in ("bf16-mixed", "bf16-true") else jnp.float32
+
+    def cast_to_compute(self, tree: Any) -> Any:
+        dtype = self.compute_dtype
+        return jax.tree.map(
+            lambda x: x.astype(dtype) if isinstance(x, (jax.Array, np.ndarray)) and jnp.issubdtype(x.dtype, jnp.floating) else x,
+            tree,
+        )
+
+
+class Fabric:
+    """Device mesh + precision + process topology in one handle.
+
+    Args:
+        devices: number of devices to use (``-1`` / ``None`` = all).
+        precision: one of ``fp32`` / ``bf16-mixed`` / ``bf16-true``.
+        mesh_axes: axis names; first axis is the data axis. Default 1-D
+            ``("data",)`` — pure DP, the reference's only strategy
+            (SURVEY §2.7). A 2-D ``("data", "model")`` mesh enables param
+            sharding for larger models.
+        mesh_shape: sizes per axis; ``-1`` infers from the device count.
+    """
+
+    def __init__(
+        self,
+        devices: Optional[int | str] = None,
+        precision: str = "fp32",
+        accelerator: str = "auto",
+        num_nodes: int = 1,
+        mesh_axes: Sequence[str] = ("data",),
+        mesh_shape: Optional[Sequence[int]] = None,
+        callbacks: Optional[Sequence[Any]] = None,
+        distributed_coordinator: Optional[str] = None,
+        num_processes: Optional[int] = None,
+        process_id: Optional[int] = None,
+    ) -> None:
+        self._maybe_init_distributed(distributed_coordinator, num_processes, process_id)
+        if accelerator not in ("auto", "tpu", "cpu", "gpu"):
+            raise ValueError(f"unknown accelerator {accelerator!r}")
+        if accelerator == "cpu":
+            # must happen before the first device query in this process
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except RuntimeError:
+                pass  # backend already initialized; devices below reflect it
+        self.accelerator = accelerator
+        self.num_nodes = num_nodes
+        self.callbacks = list(callbacks or [])
+        if devices in ("auto", "-1"):
+            devices = None
+        all_devices = jax.devices()
+        n = len(all_devices) if devices in (None, -1) else int(devices)
+        if n <= 0 or n > len(all_devices):
+            raise ValueError(f"requested {devices} devices but {len(all_devices)} are available")
+        self.devices = all_devices[:n]
+        self.precision = Precision(precision)
+        axes = tuple(mesh_axes)
+        if mesh_shape is None:
+            shape: Tuple[int, ...] = (n,) + (1,) * (len(axes) - 1)
+        else:
+            shape = tuple(mesh_shape)
+            inferred = [i for i, s in enumerate(shape) if s == -1]
+            if len(inferred) > 1:
+                raise ValueError("at most one mesh axis may be -1")
+            if inferred:
+                known = int(np.prod([s for s in shape if s != -1])) or 1
+                shape = tuple(n // known if s == -1 else s for s in shape)
+        if int(np.prod(shape)) != n:
+            raise ValueError(f"mesh shape {shape} does not cover {n} devices")
+        self.mesh = Mesh(np.asarray(self.devices).reshape(shape), axes)
+        self.data_axis = axes[0]
+
+    @staticmethod
+    def _maybe_init_distributed(
+        coordinator: Optional[str], num_processes: Optional[int], process_id: Optional[int]
+    ) -> None:
+        """DCN process-group bring-up (replaces TorchCollective.setup,
+        ppo_decoupled.py:645-649). No-op on a single host."""
+        if coordinator is None and "SHEEPRL_TPU_COORDINATOR" in os.environ:
+            coordinator = os.environ["SHEEPRL_TPU_COORDINATOR"]
+            num_processes = int(os.environ["SHEEPRL_TPU_NUM_PROCESSES"]) if "SHEEPRL_TPU_NUM_PROCESSES" in os.environ else None
+            process_id = int(os.environ["SHEEPRL_TPU_PROCESS_ID"]) if "SHEEPRL_TPU_PROCESS_ID" in os.environ else None
+        if coordinator is None:
+            return
+        # a configured coordinator with a missing/1 process count is a broken
+        # launch, not a single-host run: every host would train independently
+        # as process 0 with no cross-host reduction
+        if not num_processes or num_processes <= 1 or process_id is None:
+            raise ValueError(
+                "distributed coordinator is set but num_processes/process_id are not — set "
+                "SHEEPRL_TPU_NUM_PROCESSES (> 1) and SHEEPRL_TPU_PROCESS_ID on every host"
+            )
+        if jax.process_count() == 1:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+
+    # ------------------------------------------------------------------ #
+    # topology
+    # ------------------------------------------------------------------ #
+    @property
+    def world_size(self) -> int:
+        return len(self.devices)
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    @property
+    def is_global_zero(self) -> bool:
+        return jax.process_index() == 0
+
+    @property
+    def local_device_count(self) -> int:
+        return len([d for d in self.devices if d.process_index == jax.process_index()])
+
+    # ------------------------------------------------------------------ #
+    # placement
+    # ------------------------------------------------------------------ #
+    def sharding(self, *spec: Any) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def batch_sharding(self) -> NamedSharding:
+        """Leading-axis data-parallel placement."""
+        return NamedSharding(self.mesh, P(self.data_axis))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def shard_batch(self, tree: Any) -> Any:
+        """Place host arrays with the leading axis split across the data axis
+        (replaces per-rank ``to(device)`` copies; one transfer per shard)."""
+        return jax.device_put(tree, self.batch_sharding)
+
+    def replicate(self, tree: Any) -> Any:
+        """Fully replicate params/state across the mesh (the JAX counterpart
+        of DDP module broadcast, dreamer_v3/agent.py:1205-1214)."""
+        return jax.device_put(tree, self.replicated)
+
+    def local_batch_size(self, global_batch_size: int) -> int:
+        data_size = self.mesh.shape[self.data_axis]
+        if global_batch_size % data_size != 0:
+            raise ValueError(
+                f"global batch size {global_batch_size} is not divisible by the data-axis size {data_size}"
+            )
+        return global_batch_size // data_size
+
+    # ------------------------------------------------------------------ #
+    # checkpoint I/O (process-0 writes; reference fabric.save/load)
+    # ------------------------------------------------------------------ #
+    def save(self, path: str, state: Dict[str, Any]) -> None:
+        from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+        if self.is_global_zero:
+            save_checkpoint(path, state)
+
+    def load(self, path: str) -> Dict[str, Any]:
+        from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+        return load_checkpoint(path)
+
+    def call(self, hook: str, **kwargs: Any) -> None:
+        """Invoke ``hook`` on every registered callback (replaces
+        ``fabric.call("on_checkpoint_coupled")``, dreamer_v3.py:752-758)."""
+        for cb in self.callbacks:
+            fn = getattr(cb, hook, None)
+            if callable(fn):
+                fn(fabric=self, **kwargs)
+
+    def __repr__(self) -> str:
+        return (
+            f"Fabric(devices={self.world_size}, mesh={dict(self.mesh.shape)}, "
+            f"precision={self.precision.name!r}, processes={jax.process_count()})"
+        )

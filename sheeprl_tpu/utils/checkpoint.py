@@ -1,0 +1,49 @@
+"""Checkpoint serialization (reference: fabric.save/load via lightning;
+callback.py:87-142 buffer fixup semantics live in the algorithms).
+
+State trees mix jax array pytrees (params, optimizer state), plain Python
+state dicts (Ratio, counters) and optionally replay-buffer numpy arrays.
+Everything is pulled to host (``jax.device_get``) and pickled atomically —
+single-file checkpoints that restore across process counts (sharded arrays
+are saved dense; on load the trainer re-places them under its own mesh).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+
+def _to_host(tree: Any) -> Any:
+    def leaf(x: Any) -> Any:
+        if isinstance(x, jax.Array):
+            return np.asarray(jax.device_get(x))
+        return x
+
+    return jax.tree.map(leaf, tree)
+
+
+def save_checkpoint(path: str, state: Dict[str, Any]) -> None:
+    """Atomic single-file checkpoint write (tmp + rename)."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    host_state = _to_host(state)
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+
+
+def load_checkpoint(path: str) -> Dict[str, Any]:
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,90 @@
+"""Run loggers (reference: sheeprl/utils/logger.py:12-89).
+
+TensorBoard writer built on process 0 only; the versioned log dir is chosen
+on process 0 and broadcast so every host agrees (the reference broadcasts it
+over gloo, logger.py:83-88 — here it rides ``broadcast_object``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Mapping, Optional
+
+from sheeprl_tpu.parallel.collectives import broadcast_object
+
+
+class TensorBoardLogger:
+    """Thin SummaryWriter wrapper with the subset of the lightning logger API
+    the algorithms use (log_metrics / log_hyperparams / finalize)."""
+
+    def __init__(self, log_dir: str) -> None:
+        from torch.utils.tensorboard import SummaryWriter
+
+        os.makedirs(log_dir, exist_ok=True)
+        self.log_dir = log_dir
+        self._writer = SummaryWriter(log_dir=log_dir)
+
+    def log_metrics(self, metrics: Mapping[str, float], step: int) -> None:
+        for k, v in metrics.items():
+            self._writer.add_scalar(k, v, global_step=step)
+
+    def log_hyperparams(self, params: Mapping[str, Any]) -> None:
+        try:
+            import yaml
+
+            self._writer.add_text("hparams", f"```\n{yaml.safe_dump(dict(params), sort_keys=False)}\n```")
+        except Exception:
+            pass
+
+    def finalize(self) -> None:
+        self._writer.flush()
+        self._writer.close()
+
+
+class NoOpLogger:
+    """Used on non-zero processes and when logging is disabled."""
+
+    log_dir: Optional[str] = None
+
+    def log_metrics(self, metrics: Mapping[str, float], step: int) -> None:
+        pass
+
+    def log_hyperparams(self, params: Mapping[str, Any]) -> None:
+        pass
+
+    def finalize(self) -> None:
+        pass
+
+
+def get_log_dir(cfg: Mapping[str, Any], root_dir: Optional[str] = None, run_name: Optional[str] = None) -> str:
+    """Versioned run directory ``<root>/<run_name>/version_N``, chosen once on
+    process 0 and broadcast (reference logger.py:39-89)."""
+    import jax
+
+    root_dir = root_dir or cfg["root_dir"]
+    run_name = run_name or cfg["run_name"]
+    base = os.path.join("logs", "runs", root_dir, run_name)
+    if jax.process_index() == 0:
+        version = 0
+        while os.path.isdir(os.path.join(base, f"version_{version}")):
+            version += 1
+        log_dir = os.path.join(base, f"version_{version}")
+        os.makedirs(log_dir, exist_ok=True)
+    else:
+        log_dir = None
+    return broadcast_object(log_dir, src=0)
+
+
+def get_logger(cfg: Mapping[str, Any], log_dir: str):
+    """Build the process-0 logger (reference logger.py:12-36). Returns a
+    NoOpLogger on other processes or when ``metric.log_level`` is 0."""
+    import jax
+
+    metric_cfg: Dict[str, Any] = cfg.get("metric", {})
+    if jax.process_index() != 0 or int(metric_cfg.get("log_level", 1)) <= 0:
+        return NoOpLogger()
+    logger_cfg = cfg.get("logger", {}) or {}
+    kind = str(logger_cfg.get("name", "tensorboard")).lower()
+    if kind == "tensorboard":
+        return TensorBoardLogger(log_dir)
+    raise ValueError(f"unknown logger {kind!r}; available: ['tensorboard']")

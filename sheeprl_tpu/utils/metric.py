@@ -1,0 +1,191 @@
+"""Host-side metric aggregation (reference: sheeprl/utils/metric.py:17-195).
+
+torchmetrics is replaced with tiny pure-Python accumulators — metric state
+lives on the host (device values are pulled with ``float()`` at update time,
+which also acts as the block-until-ready sync point at log boundaries).
+Cross-replica reduction of *device* metrics is unnecessary here: jitted train
+steps return already-psum'd scalars (the XLA-native counterpart of
+``sync_on_compute``).
+"""
+
+from __future__ import annotations
+
+import warnings
+from math import isnan
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+
+class MetricAggregatorException(Exception):
+    pass
+
+
+class Metric:
+    """Minimal accumulator interface: update / compute / reset."""
+
+    def update(self, value: Any) -> None:
+        raise NotImplementedError
+
+    def compute(self) -> float:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+
+class MeanMetric(Metric):
+    def __init__(self) -> None:
+        self._sum = 0.0
+        self._count = 0
+
+    def update(self, value: Any) -> None:
+        v = float(value)
+        self._sum += v
+        self._count += 1
+
+    def compute(self) -> float:
+        return self._sum / self._count if self._count else float("nan")
+
+    def reset(self) -> None:
+        self._sum, self._count = 0.0, 0
+
+
+class SumMetric(Metric):
+    def __init__(self) -> None:
+        self._sum = 0.0
+        self._any = False
+
+    def update(self, value: Any) -> None:
+        self._sum += float(value)
+        self._any = True
+
+    def compute(self) -> float:
+        return self._sum if self._any else float("nan")
+
+    def reset(self) -> None:
+        self._sum, self._any = 0.0, False
+
+
+class LastValueMetric(Metric):
+    def __init__(self) -> None:
+        self._value = float("nan")
+
+    def update(self, value: Any) -> None:
+        self._value = float(value)
+
+    def compute(self) -> float:
+        return self._value
+
+    def reset(self) -> None:
+        self._value = float("nan")
+
+
+class MaxMetric(Metric):
+    def __init__(self) -> None:
+        self._value = float("nan")
+
+    def update(self, value: Any) -> None:
+        v = float(value)
+        self._value = v if isnan(self._value) else max(self._value, v)
+
+    def compute(self) -> float:
+        return self._value
+
+    def reset(self) -> None:
+        self._value = float("nan")
+
+
+_METRIC_TYPES = {
+    "mean": MeanMetric,
+    "sum": SumMetric,
+    "last": LastValueMetric,
+    "max": MaxMetric,
+}
+
+
+def make_metric(spec: Any) -> Metric:
+    """Build a metric from a name ("mean"), a class, or a ``_target_`` node
+    (the reference instantiates torchmetrics via hydra, configs/metric)."""
+    if isinstance(spec, Metric):
+        return spec
+    if isinstance(spec, type) and issubclass(spec, Metric):
+        return spec()
+    if isinstance(spec, dict) and "_target_" in spec:
+        name = spec["_target_"].rsplit(".", 1)[-1].replace("Metric", "").lower()
+        return _METRIC_TYPES[name]()
+    if isinstance(spec, str):
+        key = spec.rsplit(".", 1)[-1].replace("Metric", "").lower()
+        if key in _METRIC_TYPES:
+            return _METRIC_TYPES[key]()
+    raise ValueError(f"unknown metric spec {spec!r}; available: {sorted(_METRIC_TYPES)}")
+
+
+class MetricAggregator:
+    """Keyed metric registry with class-level disable and NaN-dropping
+    compute (reference metric.py:17-143)."""
+
+    disabled: bool = False
+
+    def __init__(self, metrics: Optional[Dict[str, Any]] = None, raise_on_missing: bool = False) -> None:
+        self.metrics: Dict[str, Metric] = {}
+        if metrics:
+            for k, v in metrics.items():
+                self.metrics[k] = make_metric(v)
+        self._raise_on_missing = raise_on_missing
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.metrics.keys())
+
+    def _missing(self, name: str, action: str) -> None:
+        if self._raise_on_missing:
+            raise MetricAggregatorException(f"Metric {name} does not exist")
+        warnings.warn(f"The key '{name}' is missing from the metric aggregator. Nothing will be {action}.")
+
+    def add(self, name: str, metric: Any) -> None:
+        if self.disabled:
+            return
+        if name in self.metrics:
+            if self._raise_on_missing:
+                raise MetricAggregatorException(f"Metric {name} already exists")
+            warnings.warn(f"The key '{name}' is already in the metric aggregator. Nothing will be added.")
+            return
+        self.metrics[name] = make_metric(metric)
+
+    def update(self, name: str, value: Any) -> None:
+        if self.disabled:
+            return
+        if name not in self.metrics:
+            self._missing(name, "added")
+            return
+        v = np.asarray(value)
+        if v.ndim == 0:
+            self.metrics[name].update(v)
+        else:
+            for x in v.ravel():
+                self.metrics[name].update(x)
+
+    def pop(self, name: str) -> None:
+        if self.disabled:
+            return
+        if name not in self.metrics:
+            self._missing(name, "popped")
+        self.metrics.pop(name, None)
+
+    def reset(self) -> None:
+        if self.disabled:
+            return
+        for m in self.metrics.values():
+            m.reset()
+
+    def compute(self) -> Dict[str, float]:
+        """Reduce all metrics, dropping NaN (empty) entries
+        (reference metric.py:110-143)."""
+        if self.disabled:
+            return {}
+        out = {}
+        for k, m in self.metrics.items():
+            v = m.compute()
+            if not isnan(v):
+                out[k] = v
+        return out

@@ -1,0 +1,134 @@
+"""PPO smoke tests (reference: tests/test_algos/test_algos.py::test_ppo).
+
+One full CLI-driven update on tiny nets against dummy/gym envs — the
+integration layer of the test pyramid (SURVEY.md §4.1). Runs on the 8-device
+virtual CPU mesh from conftest, so the shard_map data-parallel path is
+exercised on every test.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import run
+
+
+def standard_args(tmp_path):
+    return [
+        "exp=ppo",
+        "dry_run=True",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "algo.rollout_steps=32",
+        "algo.per_rank_batch_size=8",
+        "algo.update_epochs=2",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.encoder.cnn_features_dim=16",
+        "algo.encoder.mlp_features_dim=8",
+        "env.num_envs=2",
+        "algo.run_test=True",
+        "checkpoint.save_last=True",
+        "metric.log_level=1",
+        f"log_base_dir={tmp_path}/logs",
+    ]
+
+
+def find_checkpoints(tmp_path):
+    ckpts = []
+    for root, _, files in os.walk(tmp_path):
+        ckpts += [os.path.join(root, f) for f in files if f.endswith(".ckpt")]
+    return ckpts
+
+
+def test_ppo_cartpole_vector(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(standard_args(tmp_path))
+    assert find_checkpoints(tmp_path)
+
+
+def test_ppo_dummy_discrete_pixels(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(
+        standard_args(tmp_path)
+        + [
+            "env=dummy",
+            "env.id=dummy_discrete",
+            "env.screen_size=36",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+    )
+
+
+def test_ppo_dummy_continuous(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(
+        standard_args(tmp_path)
+        + [
+            "env=dummy",
+            "env.id=dummy_continuous",
+            "env.screen_size=36",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+    )
+
+
+def test_ppo_dummy_multidiscrete(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(
+        standard_args(tmp_path)
+        + [
+            "env=dummy",
+            "env.id=dummy_multidiscrete",
+            "env.screen_size=36",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+        ]
+    )
+
+
+def test_ppo_frame_stack(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(
+        standard_args(tmp_path)
+        + [
+            "env=dummy",
+            "env.id=dummy_discrete",
+            "env.screen_size=36",
+            "env.frame_stack=2",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+        ]
+    )
+
+
+def test_ppo_resume_from_checkpoint(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(standard_args(tmp_path))
+    (ckpt,) = find_checkpoints(tmp_path)
+    run(standard_args(tmp_path) + [f"checkpoint.resume_from={ckpt}"])
+
+
+def test_ppo_resume_env_mismatch_raises(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(standard_args(tmp_path))
+    (ckpt,) = find_checkpoints(tmp_path)
+    with pytest.raises(ValueError, match="different environment"):
+        run(standard_args(tmp_path) + [f"checkpoint.resume_from={ckpt}", "env.id=Acrobot-v1"])
+
+
+def test_ppo_evaluate_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(standard_args(tmp_path))
+    (ckpt,) = find_checkpoints(tmp_path)
+    from sheeprl_tpu.cli import evaluation
+
+    evaluation([f"checkpoint_path={ckpt}"])
+
+
+def test_ppo_unknown_algo_error(tmp_path):
+    with pytest.raises(ValueError, match="no registered algorithm"):
+        run(standard_args(tmp_path) + ["algo.name=not_an_algo"])

@@ -1,0 +1,130 @@
+"""Fabric/mesh runtime specs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.parallel import Fabric, Precision
+
+
+def test_fabric_defaults_all_devices():
+    f = Fabric()
+    assert f.world_size == len(jax.devices())
+    assert dict(f.mesh.shape) == {"data": len(jax.devices())}
+
+
+def test_fabric_device_subset():
+    f = Fabric(devices=4)
+    assert f.world_size == 4
+
+
+def test_fabric_too_many_devices():
+    with pytest.raises(ValueError):
+        Fabric(devices=10**6)
+
+
+def test_fabric_2d_mesh():
+    f = Fabric(devices=8, mesh_axes=("data", "model"), mesh_shape=(4, 2))
+    assert dict(f.mesh.shape) == {"data": 4, "model": 2}
+
+
+def test_fabric_mesh_infer_axis():
+    f = Fabric(devices=8, mesh_axes=("data", "model"), mesh_shape=(-1, 2))
+    assert dict(f.mesh.shape) == {"data": 4, "model": 2}
+
+
+def test_fabric_bad_mesh_shape():
+    with pytest.raises(ValueError):
+        Fabric(devices=8, mesh_axes=("data", "model"), mesh_shape=(3, 2))
+
+
+def test_shard_batch_and_replicate():
+    f = Fabric(devices=8)
+    batch = {"x": np.arange(16, dtype=np.float32).reshape(16, 1)}
+    sharded = f.shard_batch(batch)
+    assert sharded["x"].sharding == f.batch_sharding
+    params = f.replicate({"w": np.ones((3,), np.float32)})
+    assert params["w"].sharding == f.replicated
+
+
+def test_local_batch_size():
+    f = Fabric(devices=8)
+    assert f.local_batch_size(64) == 8
+    with pytest.raises(ValueError):
+        f.local_batch_size(63)
+
+
+def test_precision_aliases():
+    assert Precision("32-true").name == "fp32"
+    assert Precision("bf16").name == "bf16-mixed"
+    with pytest.raises(ValueError):
+        Precision("fp16")
+
+
+def test_precision_dtypes():
+    p = Precision("bf16-mixed")
+    assert p.param_dtype == jnp.float32
+    assert p.compute_dtype == jnp.bfloat16
+    t = Precision("bf16-true")
+    assert t.param_dtype == jnp.bfloat16
+
+
+def test_precision_cast_to_compute():
+    p = Precision("bf16-mixed")
+    tree = {"a": jnp.ones((2,), jnp.float32), "b": jnp.ones((2,), jnp.int32)}
+    out = p.cast_to_compute(tree)
+    assert out["a"].dtype == jnp.bfloat16
+    assert out["b"].dtype == jnp.int32  # non-floating leaves untouched
+
+
+def test_save_load_roundtrip(tmp_path):
+    f = Fabric(devices=1)
+    state = {"params": {"w": jnp.arange(4.0)}, "step": 7, "ratio": {"_prev": None}}
+    path = str(tmp_path / "ckpt" / "state.ckpt")
+    f.save(path, state)
+    loaded = f.load(path)
+    assert loaded["step"] == 7
+    assert np.array_equal(loaded["params"]["w"], np.arange(4.0))
+    assert loaded["ratio"]["_prev"] is None
+
+
+def test_fabric_call_dispatches_to_callbacks():
+    calls = []
+
+    class CB:
+        def on_checkpoint_coupled(self, fabric, **kw):
+            calls.append(kw)
+
+    f = Fabric(devices=1, callbacks=[CB()])
+    f.call("on_checkpoint_coupled", ckpt_path="x", state={})
+    assert calls == [{"ckpt_path": "x", "state": {}}]
+
+
+def test_grad_pmean_matches_single_device():
+    """DP gradient on an 8-way mesh == single-device gradient on full batch."""
+    from functools import partial
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    f = Fabric(devices=8)
+    w = jnp.asarray([2.0, -1.0])
+    x = np.random.default_rng(0).normal(size=(16, 2)).astype(np.float32)
+
+    def loss(w, x):
+        return jnp.mean(jnp.square(x @ w))
+
+    full_grad = jax.grad(loss)(w, jnp.asarray(x))
+
+    @partial(
+        shard_map,
+        mesh=f.mesh,
+        in_specs=(P(), P("data")),
+        out_specs=P(),
+        check_rep=False,
+    )
+    def dp_grad(w, x):
+        return jax.lax.pmean(jax.grad(loss)(w, x), "data")
+
+    np.testing.assert_allclose(jax.jit(dp_grad)(w, x), full_grad, rtol=1e-5)

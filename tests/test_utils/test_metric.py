@@ -1,0 +1,96 @@
+"""MetricAggregator / timer specs (reference: utils/metric.py + timer.py)."""
+
+import time
+
+import pytest
+
+from sheeprl_tpu.utils.metric import (
+    LastValueMetric,
+    MaxMetric,
+    MeanMetric,
+    MetricAggregator,
+    MetricAggregatorException,
+    SumMetric,
+)
+from sheeprl_tpu.utils.timer import TimerError, timer
+
+
+def test_mean_metric():
+    m = MeanMetric()
+    m.update(1.0)
+    m.update(3.0)
+    assert m.compute() == 2.0
+    m.reset()
+    assert m.compute() != m.compute() or m.compute() != 0  # NaN
+
+
+def test_sum_last_max():
+    s, l, mx = SumMetric(), LastValueMetric(), MaxMetric()
+    for v in (1.0, 5.0, 3.0):
+        s.update(v)
+        l.update(v)
+        mx.update(v)
+    assert s.compute() == 9.0
+    assert l.compute() == 3.0
+    assert mx.compute() == 5.0
+
+
+def test_aggregator_compute_drops_empty():
+    agg = MetricAggregator({"a": "mean", "b": "mean"})
+    agg.update("a", 2.0)
+    assert agg.compute() == {"a": 2.0}
+
+
+def test_aggregator_missing_key_warns():
+    agg = MetricAggregator({"a": "mean"})
+    with pytest.warns(UserWarning):
+        agg.update("nope", 1.0)
+
+
+def test_aggregator_missing_key_raises():
+    agg = MetricAggregator({"a": "mean"}, raise_on_missing=True)
+    with pytest.raises(MetricAggregatorException):
+        agg.update("nope", 1.0)
+
+
+def test_aggregator_add_duplicate_warns():
+    agg = MetricAggregator({"a": "mean"})
+    with pytest.warns(UserWarning):
+        agg.add("a", "mean")
+
+
+def test_aggregator_target_specs():
+    agg = MetricAggregator({"x": {"_target_": "sheeprl_tpu.utils.metric.MeanMetric"}})
+    agg.update("x", 4.0)
+    assert agg.compute() == {"x": 4.0}
+
+
+def test_aggregator_array_update():
+    import numpy as np
+
+    agg = MetricAggregator({"a": "mean"})
+    agg.update("a", np.array([1.0, 3.0]))
+    assert agg.compute() == {"a": 2.0}
+
+
+def test_timer_accumulates():
+    timer.disabled = False
+    timer.timers.clear()
+    with timer("Time/test_section"):
+        time.sleep(0.01)
+    with timer("Time/test_section"):
+        time.sleep(0.01)
+    total = timer.compute()["Time/test_section"]
+    assert total >= 0.02
+    timer.reset()
+
+
+def test_timer_double_start_raises():
+    t = timer("Time/x")
+    t.start()
+    with pytest.raises(TimerError):
+        t.start()
+    t.stop()
+    with pytest.raises(TimerError):
+        t.stop()
+    timer.timers.clear()
