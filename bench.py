@@ -2,7 +2,7 @@
 
 Workload: the reference's PPO benchmark recipe (benchmarks/benchmark.py:11-18
 + configs/exp/ppo_benchmarks.yaml — CartPole-v1, vector obs, logging off)
-scaled to 16384 policy steps. Metric: end-to-end env steps per second
+scaled to 32768 policy steps. Metric: end-to-end env steps per second
 (rollout + GAE + fused train update) on whatever accelerator jax selects
 (the real TPU chip under the driver).
 

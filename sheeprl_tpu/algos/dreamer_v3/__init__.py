@@ -1,0 +1,2 @@
+from sheeprl_tpu.algos.dreamer_v3 import dreamer_v3  # noqa: F401  (registers the algorithm)
+from sheeprl_tpu.algos.dreamer_v3 import evaluate  # noqa: F401  (registers the evaluation)

@@ -1,0 +1,70 @@
+"""Dreamer-V3 world-model loss (reference: sheeprl/algos/dreamer_v3/loss.py:9-88)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.ops.distributions import (
+    Independent,
+    OneHotCategoricalStraightThrough,
+    kl_divergence,
+)
+
+Array = jax.Array
+
+
+def reconstruction_loss(
+    po: Dict[str, object],
+    observations: Dict[str, Array],
+    pr: object,
+    rewards: Array,
+    priors_logits: Array,
+    posteriors_logits: Array,
+    kl_dynamic: float = 0.5,
+    kl_representation: float = 0.1,
+    kl_free_nats: float = 1.0,
+    kl_regularizer: float = 1.0,
+    pc: Optional[object] = None,
+    continue_targets: Optional[Array] = None,
+    continue_scale_factor: float = 1.0,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Eq. 5 of the DV3 paper: observation + reward + continue NLL plus the
+    KL-balanced dynamics/representation terms with free nats.
+
+    ``priors_logits``/``posteriors_logits`` are ``[T, B, S, D]``.
+    Returns ``(loss, kl, state_loss, reward_loss, observation_loss,
+    continue_loss)`` — same order as the reference.
+    """
+    observation_loss = -sum(po[k].log_prob(observations[k].astype(jnp.float32)) for k in po.keys())
+    reward_loss = -pr.log_prob(rewards)
+
+    sg = jax.lax.stop_gradient
+    dyn_loss = kl = kl_divergence(
+        Independent(OneHotCategoricalStraightThrough(logits=sg(posteriors_logits)), 1),
+        Independent(OneHotCategoricalStraightThrough(logits=priors_logits), 1),
+    )
+    dyn_loss = kl_dynamic * jnp.maximum(dyn_loss, kl_free_nats)
+    repr_loss = kl_divergence(
+        Independent(OneHotCategoricalStraightThrough(logits=posteriors_logits), 1),
+        Independent(OneHotCategoricalStraightThrough(logits=sg(priors_logits)), 1),
+    )
+    repr_loss = kl_representation * jnp.maximum(repr_loss, kl_free_nats)
+    kl_loss = dyn_loss + repr_loss
+
+    if pc is not None and continue_targets is not None:
+        continue_loss = continue_scale_factor * -pc.log_prob(continue_targets)
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+
+    total = (kl_regularizer * kl_loss + observation_loss + reward_loss + continue_loss).mean()
+    return (
+        total,
+        kl.mean(),
+        kl_loss.mean(),
+        reward_loss.mean(),
+        observation_loss.mean(),
+        continue_loss.mean(),
+    )

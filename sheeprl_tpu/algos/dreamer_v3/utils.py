@@ -1,0 +1,95 @@
+"""Dreamer-V3 helpers (reference: sheeprl/algos/dreamer_v3/utils.py).
+
+``Moments`` and ``compute_lambda_values`` live in ``sheeprl_tpu.ops.math``
+(``MomentsState``/``update_moments`` as a functional pytree; lambda values as
+a reverse ``lax.scan``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic", "moments"}
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], cnn_keys: Sequence[str] = (), num_envs: int = 1
+) -> Dict[str, np.ndarray]:
+    """[E, ...] obs dict for the player: frame stacks are folded into
+    channels, pixels stay uint8 (normalized in-graph — reference
+    utils.py:80-91 normalizes on host)."""
+    out: Dict[str, np.ndarray] = {}
+    for k, v in obs.items():
+        v = np.asarray(v)
+        if k in cnn_keys:
+            if v.ndim == 3:
+                v = v[None]
+            if v.ndim == 4 and v.shape[0] != num_envs:
+                v = v[None]
+            if v.ndim == 5:  # [E,S,H,W,C] -> [E,H,W,S*C]
+                e, s, h, w, c = v.shape
+                v = np.moveaxis(v, 1, 3).reshape(e, h, w, s * c)
+        else:
+            v = v.reshape(num_envs, -1).astype(np.float32)
+        out[k] = v
+    return out
+
+
+def test(
+    player: Any,
+    fabric: Any,
+    cfg: Dict[str, Any],
+    log_dir: str,
+    test_name: str = "",
+    greedy: bool = True,
+) -> None:
+    """Frozen-policy evaluation episode (reference utils.py:94-139)."""
+    from sheeprl_tpu.envs import make_env
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else ""))()
+    done = False
+    cumulative_rew = 0.0
+    obs, _ = env.reset(seed=cfg.seed)
+    saved_num_envs = player.num_envs
+    player.num_envs = 1
+    player.init_states()
+    key = jax.random.PRNGKey(cfg.seed)
+    while not done:
+        key, sub = jax.random.split(key)
+        torch_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder)
+        actions = player.get_actions(torch_obs, sub, greedy=greedy)
+        if player.actor.is_continuous:
+            real_actions = actions[0]
+        else:
+            splits = np.cumsum(player.actions_dim)[:-1]
+            real_actions = np.array([p.argmax(-1) for p in np.split(actions[0], splits, axis=-1)])
+            if len(real_actions) == 1:
+                real_actions = real_actions[0]
+        obs, reward, terminated, truncated, _ = env.step(real_actions.reshape(env.action_space.shape))
+        done = terminated or truncated or cfg.dry_run
+        cumulative_rew += float(reward)
+    print(f"Test - Reward: {cumulative_rew}")
+    if cfg.metric.log_level > 0 and getattr(fabric, "logger", None) is not None:
+        fabric.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    player.num_envs = saved_num_envs
+    env.close()

@@ -185,21 +185,17 @@ def main(fabric, cfg: Dict[str, Any]):
     num_envs = int(cfg.env.num_envs)
     rollout_steps = int(cfg.algo.rollout_steps)
     world_size = fabric.world_size
-    policy_steps_per_update = num_envs * rollout_steps * fabric.num_nodes
+    policy_steps_per_update = num_envs * rollout_steps * fabric.num_processes
     num_updates = int(cfg.algo.total_steps) // policy_steps_per_update if not cfg.dry_run else 1
 
-    n_global = rollout_steps * num_envs
+    # global rollout spans every process's envs; shard over all devices
+    n_global = rollout_steps * num_envs * fabric.num_processes
     if n_global % world_size != 0:
         raise ValueError(
-            f"rollout_steps*num_envs ({n_global}) must be divisible by the number of devices ({world_size})"
+            f"rollout_steps*num_envs*processes ({n_global}) must be divisible by the device count ({world_size})"
         )
     n_local = n_global // world_size
-    num_minibatches = n_local // int(cfg.algo.per_rank_batch_size)
-    if num_minibatches == 0:
-        raise ValueError(
-            f"per_rank_batch_size ({cfg.algo.per_rank_batch_size}) is larger than the "
-            f"per-device rollout ({n_local})"
-        )
+    num_minibatches = max(1, n_local // int(cfg.algo.per_rank_batch_size))
 
     # optimizer; lr annealing is an optax schedule (reference PolynomialLR)
     opt_cfg = dict(cfg.algo.optimizer.to_dict() if hasattr(cfg.algo.optimizer, "to_dict") else cfg.algo.optimizer)
@@ -258,7 +254,7 @@ def main(fabric, cfg: Dict[str, Any]):
         rollout = {k: [] for k in (*obs_keys, "dones", "values", "actions", "logprobs", "rewards")}
         with timer("Time/env_interaction_time"):
             for _ in range(rollout_steps):
-                policy_step += num_envs * fabric.num_nodes
+                policy_step += num_envs * fabric.num_processes
                 key, action_key = jax.random.split(key)
                 actions, logprobs, values = player.get_actions(next_obs, action_key)
                 # ONE device->host fetch per step: over a remote-attached TPU
@@ -323,8 +319,11 @@ def main(fabric, cfg: Dict[str, Any]):
         local_data["returns"] = np.asarray(returns)
         local_data["advantages"] = np.asarray(advantages)
 
-        # flatten [T, E, ...] -> [T*E, ...]; shard_map splits over devices
+        # flatten [T, E, ...] -> [T*E, ...]; shard_map splits over devices;
+        # multi-host runs assemble the per-process blocks into a global array
         flat = {k: v.reshape(v.shape[0] * v.shape[1], *v.shape[2:]) for k, v in local_data.items()}
+        if fabric.num_processes > 1:
+            flat = fabric.make_global(flat, (fabric.data_axis,))
 
         with timer("Time/train_time"):
             key, train_key = jax.random.split(key)

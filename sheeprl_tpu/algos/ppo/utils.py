@@ -66,7 +66,7 @@ def test(player: Any, fabric: Any, cfg: Dict[str, Any], log_dir: str) -> None:
             if len(real_actions) == 1:
                 real_actions = real_actions[0]
         obs, reward, terminated, truncated, _ = env.step(real_actions)
-        done = terminated or truncated
+        done = terminated or truncated or cfg.dry_run
         cumulative_rew += float(reward)
     fabric_print = getattr(fabric, "print", print)
     fabric_print(f"Test - Reward: {cumulative_rew}")

@@ -1,0 +1,2 @@
+from sheeprl_tpu.algos.sac import sac  # noqa: F401  (registers the algorithm)
+from sheeprl_tpu.algos.sac import evaluate  # noqa: F401  (registers the evaluation)

@@ -1,0 +1,25 @@
+"""SAC losses (reference: sheeprl/algos/sac/loss.py:10-27)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def policy_loss(alpha: Array, logprobs: Array, qf_values: Array) -> Array:
+    """Eq. 7."""
+    return ((alpha * logprobs) - qf_values).mean()
+
+
+def critic_loss(qf_values: Array, next_qf_value: Array, num_critics: int) -> Array:
+    """Eq. 5: sum of per-critic MSE against the shared target."""
+    return sum(
+        jnp.mean(jnp.square(qf_values[..., i : i + 1] - next_qf_value)) for i in range(num_critics)
+    )
+
+
+def entropy_loss(log_alpha: Array, logprobs: Array, target_entropy: float) -> Array:
+    """Eq. 17."""
+    return (-log_alpha * (logprobs + target_entropy)).mean()

@@ -1,0 +1,415 @@
+"""SAC, coupled (reference: sheeprl/algos/sac/sac.py:32-424) — TPU-native.
+
+Redesign highlights:
+
+- **All G gradient steps of an update fused into one jit**: the sampled
+  ``[G, B, ...]`` batch is scanned on device (critic, EMA, actor, alpha
+  updates per step) — the reference dispatches each minibatch from Python
+  (sac.py:337-351).
+- **Critic ensemble is vmapped**, not looped.
+- The reference's per-rank sample → ``fabric.all_gather`` → DistributedSampler
+  round-robin (sac.py:303-333) collapses to: host samples the global batch,
+  shard_map splits it over the data axis, gradient ``pmean`` restores DDP
+  semantics (including the explicit ``log_alpha.grad`` all-reduce,
+  sac.py:72).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from sheeprl_tpu.algos.sac.agent import (
+    SACAgent,
+    actor_action_and_log_prob,
+    build_agent,
+    critic_ensemble_apply,
+)
+from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
+from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
+from sheeprl_tpu.config.compose import instantiate
+from sheeprl_tpu.data import ReplayBuffer
+from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+
+def make_train_fn(fabric, agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg):
+    gamma = float(cfg.algo.gamma)
+    tau = float(cfg.algo.tau)
+    target_entropy = agent.target_entropy
+    num_critics = agent.num_critics
+    actor, critic = agent.actor, agent.critic
+    data_axis = fabric.data_axis
+    multi_device = fabric.world_size > 1
+    # EMA cadence in gradient steps (reference sac.py:56 ties it to updates)
+    ema_every = max(1, int(cfg.algo.critic.target_network_frequency) // max(1, int(cfg.env.num_envs)))
+
+    def pmean(x):
+        return lax.pmean(x, data_axis) if multi_device else x
+
+    def local_train(
+        actor_params, critic_params, target_params, log_alpha,
+        actor_opt, critic_opt, alpha_opt, grad_counter, data, key,
+    ):
+        if multi_device:
+            key = jax.random.fold_in(key, lax.axis_index(data_axis))
+
+        def one_step(carry, batch):
+            (actor_params, critic_params, target_params, log_alpha,
+             actor_opt, critic_opt, alpha_opt, counter, key) = carry
+            key, k_next, k_actor = jax.random.split(key, 3)
+            alpha = jnp.exp(log_alpha)
+
+            # soft critic update (Eq. 5)
+            next_actions, next_logpi = actor_action_and_log_prob(
+                actor, actor_params, batch["next_observations"], k_next
+            )
+            q_next = critic_ensemble_apply(critic, target_params, batch["next_observations"], next_actions)
+            min_q_next = jnp.min(q_next, axis=-1, keepdims=True) - alpha * next_logpi
+            target = batch["rewards"] + (1 - batch["terminated"]) * gamma * min_q_next
+            target = lax.stop_gradient(target)
+
+            def critic_loss_fn(p):
+                q = critic_ensemble_apply(critic, p, batch["observations"], batch["actions"])
+                return critic_loss(q, target, num_critics)
+
+            qf_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(critic_params)
+            critic_grads = pmean(critic_grads)
+            updates, critic_opt = critic_tx.update(critic_grads, critic_opt, critic_params)
+            critic_params = optax.apply_updates(critic_params, updates)
+
+            # target EMA (reference agent.py:264-267)
+            do_ema = (counter % ema_every) == 0
+            target_params = jax.tree.map(
+                lambda c, t: jnp.where(do_ema, tau * c + (1 - tau) * t, t), critic_params, target_params
+            )
+
+            # actor update (Eq. 7)
+            def actor_loss_fn(p):
+                actions, logpi = actor_action_and_log_prob(actor, p, batch["observations"], k_actor)
+                q = critic_ensemble_apply(critic, critic_params, batch["observations"], actions)
+                min_q = jnp.min(q, axis=-1, keepdims=True)
+                return policy_loss(alpha, logpi, min_q), logpi
+
+            (a_loss, logpi), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(actor_params)
+            actor_grads = pmean(actor_grads)
+            updates, actor_opt = actor_tx.update(actor_grads, actor_opt, actor_params)
+            actor_params = optax.apply_updates(actor_params, updates)
+
+            # entropy coefficient update (Eq. 17; grad all-reduced like
+            # reference sac.py:72)
+            alpha_grad = jax.grad(lambda la: entropy_loss(la, lax.stop_gradient(logpi), target_entropy))(
+                log_alpha
+            )
+            alpha_grad = pmean(alpha_grad)
+            updates, alpha_opt = alpha_tx.update(alpha_grad, alpha_opt, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, updates)
+
+            alpha_l = entropy_loss(log_alpha, logpi, target_entropy)
+            carry = (actor_params, critic_params, target_params, log_alpha,
+                     actor_opt, critic_opt, alpha_opt, counter + 1, key)
+            return carry, jnp.stack([qf_loss, a_loss, alpha_l])
+
+        carry = (actor_params, critic_params, target_params, log_alpha,
+                 actor_opt, critic_opt, alpha_opt, grad_counter, key)
+        carry, metrics = lax.scan(one_step, carry, data)
+        (actor_params, critic_params, target_params, log_alpha,
+         actor_opt, critic_opt, alpha_opt, grad_counter, _) = carry
+        return (
+            actor_params, critic_params, target_params, log_alpha,
+            actor_opt, critic_opt, alpha_opt, grad_counter,
+            pmean(metrics.mean(axis=0)),
+        )
+
+    if multi_device:
+        train_fn = shard_map(
+            local_train,
+            mesh=fabric.mesh,
+            in_specs=(P(), P(), P(), P(), P(), P(), P(), P(), P(None, data_axis), P()),
+            out_specs=(P(), P(), P(), P(), P(), P(), P(), P(), P()),
+            check_rep=False,
+        )
+    else:
+        train_fn = local_train
+    return jax.jit(train_fn, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any]):
+    rank = fabric.process_index
+    world_size = fabric.world_size  # devices: sets the global batch split
+    num_processes = fabric.num_processes  # hosts: sets the env-step accounting
+    num_envs = int(cfg.env.num_envs)
+
+    if cfg.checkpoint.resume_from:
+        state = fabric.load(cfg.checkpoint.resume_from)
+
+    if len(cfg.algo.cnn_keys.encoder) > 0:
+        warnings.warn("SAC algorithm cannot allow to use images as observations, the CNN keys will be ignored")
+        cfg.algo.cnn_keys.encoder = []
+
+    log_dir = get_log_dir(cfg)
+    logger = get_logger(cfg, log_dir)
+    fabric.logger = logger
+    logger.log_hyperparams(cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg))
+    print(f"Log dir: {log_dir}")
+
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            make_env(
+                cfg,
+                cfg.seed + rank * num_envs + i,
+                rank * num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
+            )
+            for i in range(num_envs)
+        ],
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC agent")
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    if len(mlp_keys) == 0:
+        raise RuntimeError("You should specify at least one MLP key for the encoder: `mlp_keys.encoder=[state]`")
+    for k in mlp_keys:
+        if len(observation_space[k].shape) > 1:
+            raise ValueError(
+                "Only environments with vector-only observations are supported by the SAC agent. "
+                f"The observation with key '{k}' has shape {observation_space[k].shape}."
+            )
+
+    agent, player = build_agent(
+        fabric, cfg, observation_space, action_space, state["agent"] if cfg.checkpoint.resume_from else None
+    )
+
+    def build_tx(opt_cfg):
+        return instantiate(dict(opt_cfg.to_dict() if hasattr(opt_cfg, "to_dict") else opt_cfg))
+
+    critic_tx = build_tx(cfg.algo.critic.optimizer)
+    actor_tx = build_tx(cfg.algo.actor.optimizer)
+    alpha_tx = build_tx(cfg.algo.alpha.optimizer)
+    critic_opt = fabric.replicate(critic_tx.init(jax.device_get(agent.critic_params)))
+    actor_opt = fabric.replicate(actor_tx.init(jax.device_get(agent.actor_params)))
+    alpha_opt = fabric.replicate(alpha_tx.init(jax.device_get(agent.log_alpha)))
+    if cfg.checkpoint.resume_from:
+        critic_opt = fabric.replicate(jax.tree.map(jnp.asarray, state["qf_optimizer"]))
+        actor_opt = fabric.replicate(jax.tree.map(jnp.asarray, state["actor_optimizer"]))
+        alpha_opt = fabric.replicate(jax.tree.map(jnp.asarray, state["alpha_optimizer"]))
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = MetricAggregator(cfg.metric.get("aggregator", {}).get("metrics", {}) or {})
+    for k in AGGREGATOR_KEYS - set(aggregator.metrics):
+        aggregator.add(k, "mean")
+
+    buffer_size = cfg.buffer.size // int(num_envs * num_processes) if not cfg.dry_run else 1
+    rb = ReplayBuffer(
+        buffer_size,
+        num_envs,
+        obs_keys=("observations",),
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        seed=cfg.seed,
+    )
+    if cfg.checkpoint.resume_from and cfg.buffer.checkpoint:
+        rb = state["rb"]
+
+    train_fn = make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg)
+
+    train_step = 0
+    last_train = 0
+    start_step = state["update"] + 1 if cfg.checkpoint.resume_from else 1
+    policy_step = state["update"] * num_envs * num_processes if cfg.checkpoint.resume_from else 0
+    last_log = state["last_log"] if cfg.checkpoint.resume_from else 0
+    last_checkpoint = state["last_checkpoint"] if cfg.checkpoint.resume_from else 0
+    policy_steps_per_update = int(num_envs * num_processes)
+    num_updates = int(cfg.algo.total_steps // policy_steps_per_update) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_update if not cfg.dry_run else 0
+    per_rank_batch_size = int(cfg.algo.per_rank_batch_size)
+    if cfg.checkpoint.resume_from:
+        per_rank_batch_size = state["batch_size"] // world_size
+        if not cfg.buffer.checkpoint:
+            learning_starts += start_step
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if cfg.checkpoint.resume_from:
+        ratio.load_state_dict(state["ratio"])
+
+    key = jax.random.PRNGKey(int(cfg.seed))
+    grad_counter = jnp.zeros((), jnp.int32)
+
+    obs, _ = envs.reset(seed=cfg.seed)
+    cumulative_per_rank_gradient_steps = 0
+    step_data: Dict[str, np.ndarray] = {}
+    for update in range(start_step, num_updates + 1):
+        policy_step += num_envs * num_processes
+
+        with timer("Time/env_interaction_time"):
+            if update <= learning_starts:
+                actions = envs.action_space.sample()
+            else:
+                key, action_key = jax.random.split(key)
+                np_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=num_envs)
+                actions = player.get_actions(np_obs, action_key)
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                np.asarray(actions).reshape(envs.action_space.shape)
+            )
+
+        if cfg.metric.log_level > 0 and "final_info" in infos:
+            ep = infos["final_info"].get("episode")
+            if ep is not None:
+                for i in np.nonzero(ep.get("_r", []))[0]:
+                    aggregator.update("Rewards/rew_avg", float(ep["r"][i]))
+                    aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
+                    print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep['r'][i]}")
+
+        real_next_obs = {k: np.asarray(v).copy() for k, v in next_obs.items()}
+        if "final_obs" in infos:
+            for idx, final_obs in enumerate(infos["final_obs"]):
+                if final_obs is not None:
+                    for k, v in final_obs.items():
+                        real_next_obs[k][idx] = v
+
+        step_data["terminated"] = np.asarray(terminated, np.float32).reshape(1, num_envs, 1)
+        step_data["truncated"] = np.asarray(truncated, np.float32).reshape(1, num_envs, 1)
+        step_data["actions"] = np.asarray(actions, np.float32).reshape(1, num_envs, -1)
+        step_data["observations"] = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=num_envs)[np.newaxis]
+        if not cfg.buffer.sample_next_obs:
+            step_data["next_observations"] = prepare_obs(
+                real_next_obs, mlp_keys=mlp_keys, num_envs=num_envs
+            )[np.newaxis]
+        step_data["rewards"] = np.asarray(rewards, np.float32).reshape(1, num_envs, 1)
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+        obs = next_obs
+
+        if update >= learning_starts:
+            per_rank_gradient_steps = ratio(policy_step / num_processes)
+            if per_rank_gradient_steps > 0:
+                # [G, B_total, ...] so the whole gradient loop runs in one jit
+                # each process samples its share of the global batch; the
+                # shards are assembled into one global array over the mesh
+                sample = rb.sample(
+                    batch_size=per_rank_batch_size * fabric.local_device_count,
+                    n_samples=per_rank_gradient_steps,
+                    sample_next_obs=cfg.buffer.sample_next_obs,
+                )
+                data = {k: np.asarray(v, np.float32) for k, v in sample.items()}
+                data = fabric.make_global(data, (None, fabric.data_axis)) if num_processes > 1 else data
+                with timer("Time/train_time"):
+                    key, train_key = jax.random.split(key)
+                    (
+                        agent.actor_params,
+                        agent.critic_params,
+                        agent.target_critic_params,
+                        agent.log_alpha,
+                        actor_opt,
+                        critic_opt,
+                        alpha_opt,
+                        grad_counter,
+                        metrics,
+                    ) = train_fn(
+                        agent.actor_params,
+                        agent.critic_params,
+                        agent.target_critic_params,
+                        agent.log_alpha,
+                        actor_opt,
+                        critic_opt,
+                        alpha_opt,
+                        grad_counter,
+                        data,
+                        train_key,
+                    )
+                    metrics = np.asarray(jax.device_get(metrics))
+                    train_step += num_processes
+                cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                player.params = agent.actor_params
+                if cfg.metric.log_level > 0:
+                    aggregator.update("Loss/value_loss", float(metrics[0]))
+                    aggregator.update("Loss/policy_loss", float(metrics[1]))
+                    aggregator.update("Loss/alpha_loss", float(metrics[2]))
+
+        if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or update == num_updates):
+            metrics_dict = aggregator.compute()
+            logger.log_metrics(metrics_dict, policy_step)
+            aggregator.reset()
+            if policy_step > 0:
+                logger.log_metrics(
+                    {"Params/replay_ratio": cumulative_per_rank_gradient_steps * num_processes / policy_step},
+                    policy_step,
+                )
+            if not timer.disabled:
+                timer_metrics = timer.compute()
+                if timer_metrics.get("Time/train_time"):
+                    logger.log_metrics(
+                        {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                        policy_step,
+                    )
+                if timer_metrics.get("Time/env_interaction_time"):
+                    logger.log_metrics(
+                        {
+                            "Time/sps_env_interaction": (
+                                (policy_step - last_log) / num_processes * cfg.env.action_repeat
+                            )
+                            / timer_metrics["Time/env_interaction_time"]
+                        },
+                        policy_step,
+                    )
+                timer.reset()
+            last_log = policy_step
+            last_train = train_step
+
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            update == num_updates and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": {
+                    "actor": jax.device_get(agent.actor_params),
+                    "critics": jax.device_get(agent.critic_params),
+                    "target_critics": jax.device_get(agent.target_critic_params),
+                    "log_alpha": jax.device_get(agent.log_alpha),
+                },
+                "qf_optimizer": jax.device_get(critic_opt),
+                "actor_optimizer": jax.device_get(actor_opt),
+                "alpha_optimizer": jax.device_get(alpha_opt),
+                "ratio": ratio.state_dict(),
+                "update": update,
+                "batch_size": per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
+            fabric.call(
+                "on_checkpoint_coupled",
+                ckpt_path=ckpt_path,
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(player, fabric, cfg, log_dir)
+    logger.finalize()

@@ -1,0 +1,46 @@
+"""SAC helpers (reference: sheeprl/algos/sac/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], mlp_keys: Sequence[str] = (), num_envs: int = 1
+) -> np.ndarray:
+    """Concatenate vector keys -> [num_envs, obs_dim] float32 (reference
+    utils.py:31-34)."""
+    return np.concatenate([np.asarray(obs[k], np.float32) for k in mlp_keys], axis=-1).reshape(
+        num_envs, -1
+    )
+
+
+def test(player: Any, fabric: Any, cfg: Dict[str, Any], log_dir: str) -> None:
+    """Greedy evaluation episode (reference utils.py:38-62)."""
+    from sheeprl_tpu.envs import make_env
+
+    env = make_env(cfg, None, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    obs, _ = env.reset(seed=cfg.seed)
+    while not done:
+        np_obs = prepare_obs(obs, mlp_keys=cfg.algo.mlp_keys.encoder)
+        action = player.get_actions(np_obs, greedy=True)
+        obs, reward, terminated, truncated, _ = env.step(action.reshape(env.action_space.shape))
+        done = terminated or truncated or cfg.dry_run
+        cumulative_rew += float(reward)
+    print(f"Test - Reward: {cumulative_rew}")
+    if cfg.metric.log_level > 0 and getattr(fabric, "logger", None) is not None:
+        fabric.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()

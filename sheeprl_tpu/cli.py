@@ -75,6 +75,13 @@ def _find_entry(algo_name: str) -> Optional[Dict[str, Any]]:
 
 def run_algorithm(cfg: dotdict) -> None:
     """Registry lookup → fabric build → entrypoint (reference cli.py:51-190)."""
+    from sheeprl_tpu.utils.metric import MetricAggregator
+    from sheeprl_tpu.utils.timer import timer
+
+    # wire the observability kill-switches (reference cli.py:142-156)
+    timer.disabled = bool(cfg.metric.get("disable_timer", False)) or cfg.metric.log_level <= 0
+    MetricAggregator.disabled = cfg.metric.log_level <= 0
+
     entry = _find_entry(cfg.algo.name)
     module = importlib.import_module(entry["module"])
     entrypoint = getattr(module, entry["entrypoint"])

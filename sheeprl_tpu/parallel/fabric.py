@@ -178,6 +178,13 @@ class Fabric:
         return jax.process_index()
 
     @property
+    def num_processes(self) -> int:
+        """Host-process count — the analogue of the reference's rank count for
+        step accounting (each process drives ``num_envs`` envs). NOT the chip
+        count: one SPMD process feeds many chips."""
+        return jax.process_count()
+
+    @property
     def is_global_zero(self) -> bool:
         return jax.process_index() == 0
 
@@ -209,6 +216,17 @@ class Fabric:
         """Fully replicate params/state across the mesh (the JAX counterpart
         of DDP module broadcast, dreamer_v3/agent.py:1205-1214)."""
         return jax.device_put(tree, self.replicated)
+
+    def make_global(self, tree: Any, spec: Any) -> Any:
+        """Assemble per-process host arrays into one global sharded array
+        (multi-host only; single process returns the tree untouched). ``spec``
+        is the PartitionSpec of the GLOBAL array — each process contributes
+        its local block along the sharded axes, replacing the reference's
+        per-rank DistributedSampler feeding (SURVEY §2.7)."""
+        if jax.process_count() == 1:
+            return tree
+        sharding = NamedSharding(self.mesh, spec if isinstance(spec, P) else P(*spec))
+        return jax.tree.map(lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)), tree)
 
     def local_batch_size(self, global_batch_size: int) -> int:
         data_size = self.mesh.shape[self.data_axis]
