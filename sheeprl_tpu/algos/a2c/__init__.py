@@ -1,0 +1,2 @@
+from sheeprl_tpu.algos.a2c import a2c  # noqa: F401  (registers the algorithm)
+from sheeprl_tpu.algos.a2c import evaluate  # noqa: F401  (registers the evaluation)

@@ -1,0 +1,281 @@
+"""A2C (reference: sheeprl/algos/a2c/a2c.py:25-361) — TPU-native.
+
+The PPO skeleton without clipping: one gradient step per update over the
+whole rollout. The reference emulates a full-batch gradient by accumulating
+minibatch backward passes with ``no_backward_sync`` (a2c.py:62-96); here the
+sum/mean reduction over the sharded rollout inside one jitted shard_map step
+IS that accumulation — a gradient ``pmean`` replaces the final DDP sync.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from sheeprl_tpu.algos.a2c.agent import build_agent
+from sheeprl_tpu.algos.a2c.loss import policy_loss, value_loss
+from sheeprl_tpu.algos.a2c.utils import AGGREGATOR_KEYS, prepare_obs, test
+from sheeprl_tpu.algos.ppo.agent import PPOPlayer, evaluate_actions
+from sheeprl_tpu.config.compose import instantiate
+from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.ops.math import gae
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import save_configs
+
+
+def make_train_fn(fabric, agent, tx, cfg, obs_keys):
+    reduction = str(cfg.algo.loss_reduction)
+    data_axis = fabric.data_axis
+    multi_device = fabric.world_size > 1
+
+    def local_train(params, opt_state, data):
+        def loss_fn(p):
+            obs = {k: data[k] for k in obs_keys}
+            logprobs, _, values = evaluate_actions(agent, p, obs, data["actions"])
+            pg = policy_loss(logprobs, data["advantages"], reduction)
+            v = value_loss(values, data["returns"], reduction)
+            return pg + v, (pg, v)
+
+        (_, (pg, v)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        if multi_device:
+            grads = lax.pmean(grads, data_axis)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = jnp.stack([pg, v])
+        if multi_device:
+            metrics = lax.pmean(metrics, data_axis)
+        return params, opt_state, metrics
+
+    if multi_device:
+        train_fn = shard_map(
+            local_train,
+            mesh=fabric.mesh,
+            in_specs=(P(), P(), P(data_axis)),
+            out_specs=(P(), P(), P()),
+            check_rep=False,
+        )
+    else:
+        train_fn = local_train
+    return jax.jit(train_fn, donate_argnums=(0, 1))
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any]):
+    if cfg.checkpoint.resume_from:
+        state = fabric.load(cfg.checkpoint.resume_from)
+
+    if len(cfg.algo.cnn_keys.encoder) > 0:
+        import warnings
+
+        warnings.warn("A2C is vector-only; the CNN keys will be ignored")
+        cfg.algo.cnn_keys.encoder = []
+
+    log_dir = get_log_dir(cfg)
+    logger = get_logger(cfg, log_dir)
+    fabric.logger = logger
+    logger.log_hyperparams(cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg))
+    print(f"Log dir: {log_dir}")
+
+    rank = fabric.process_index
+    num_envs = int(cfg.env.num_envs)
+    world_size = fabric.world_size
+    num_processes = fabric.num_processes
+
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            make_env(
+                cfg,
+                cfg.seed + rank * num_envs + i,
+                rank * num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
+            )
+            for i in range(num_envs)
+        ],
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+    )
+    observation_space = envs.single_observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_keys = mlp_keys
+    if not obs_keys:
+        raise RuntimeError("You should specify at least one MLP key for the encoder: `mlp_keys.encoder=[state]`")
+
+    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        envs.single_action_space.shape
+        if is_continuous
+        else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
+    )
+
+    agent, params = build_agent(
+        fabric,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["agent"] if cfg.checkpoint.resume_from else None,
+    )
+    player = PPOPlayer(agent, params)
+
+    rollout_steps = int(cfg.algo.rollout_steps)
+    policy_steps_per_update = num_envs * rollout_steps * num_processes
+    num_updates = int(cfg.algo.total_steps) // policy_steps_per_update if not cfg.dry_run else 1
+
+    opt_cfg = dict(cfg.algo.optimizer.to_dict() if hasattr(cfg.algo.optimizer, "to_dict") else cfg.algo.optimizer)
+    if cfg.algo.max_grad_norm and float(cfg.algo.max_grad_norm) > 0:
+        opt_cfg["max_grad_norm"] = float(cfg.algo.max_grad_norm)
+    tx = instantiate(opt_cfg)
+    opt_state = fabric.replicate(tx.init(jax.device_get(params)))
+    if cfg.checkpoint.resume_from:
+        opt_state = fabric.replicate(jax.tree.map(jnp.asarray, state["opt_state"]))
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = MetricAggregator(cfg.metric.get("aggregator", {}).get("metrics", {}) or {})
+    for k in AGGREGATOR_KEYS - set(aggregator.metrics):
+        aggregator.add(k, "mean")
+
+    n_global = rollout_steps * num_envs * num_processes
+    if n_global % world_size != 0:
+        raise ValueError(
+            f"rollout_steps*num_envs*processes ({n_global}) must be divisible by the device count ({world_size})"
+        )
+    train_fn = make_train_fn(fabric, agent, tx, cfg, obs_keys)
+    gae_fn = jax.jit(partial(gae, gamma=float(cfg.algo.gamma), gae_lambda=float(cfg.algo.gae_lambda)))
+
+    start_update = (state["update"] + 1) if cfg.checkpoint.resume_from else 1
+    policy_step = state["update"] * policy_steps_per_update if cfg.checkpoint.resume_from else 0
+    last_log = state["last_log"] if cfg.checkpoint.resume_from else 0
+    last_checkpoint = state["last_checkpoint"] if cfg.checkpoint.resume_from else 0
+    train_step = 0
+    last_train = 0
+
+    key = jax.random.PRNGKey(int(cfg.seed))
+    next_obs, _ = envs.reset(seed=cfg.seed)
+    next_obs = prepare_obs(next_obs, num_envs=num_envs)
+
+    for update in range(start_update, num_updates + 1):
+        rollout = {k: [] for k in (*obs_keys, "dones", "values", "actions", "logprobs", "rewards")}
+        with timer("Time/env_interaction_time"):
+            for _ in range(rollout_steps):
+                policy_step += num_envs * num_processes
+                key, action_key = jax.random.split(key)
+                actions, logprobs, values = player.get_actions(next_obs, action_key)
+                actions_np, logprobs_np, values_np = jax.device_get((actions, logprobs, values))
+                if is_continuous:
+                    real_actions = actions_np
+                else:
+                    splits = np.cumsum(actions_dim)[:-1]
+                    real_actions = np.stack(
+                        [p.argmax(-1) for p in np.split(actions_np, splits, axis=-1)], axis=-1
+                    )
+                    if real_actions.shape[-1] == 1 and not is_multidiscrete:
+                        real_actions = real_actions[..., 0]
+
+                obs, rewards, terminated, truncated, info = envs.step(
+                    real_actions.reshape(envs.action_space.shape)
+                )
+                rewards = np.asarray(rewards, np.float32).reshape(num_envs, 1)
+                dones = np.logical_or(terminated, truncated).reshape(num_envs, 1).astype(np.float32)
+                for k in obs_keys:
+                    rollout[k].append(next_obs[k])
+                rollout["dones"].append(dones)
+                rollout["values"].append(values_np)
+                rollout["actions"].append(actions_np)
+                rollout["logprobs"].append(logprobs_np)
+                rollout["rewards"].append(rewards)
+                next_obs = prepare_obs(obs, num_envs=num_envs)
+
+                if cfg.metric.log_level > 0 and "final_info" in info:
+                    ep = info["final_info"].get("episode")
+                    if ep is not None:
+                        for i in np.nonzero(ep.get("_r", []))[0]:
+                            aggregator.update("Rewards/rew_avg", float(ep["r"][i]))
+                            aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
+                            print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep['r'][i]}")
+
+        local_data = {k: np.stack(v, axis=0) for k, v in rollout.items()}
+        next_values = np.asarray(player.get_values(next_obs))
+        returns, advantages = gae_fn(
+            jnp.asarray(local_data["rewards"]),
+            jnp.asarray(local_data["values"]),
+            jnp.asarray(local_data["dones"]),
+            jnp.asarray(next_values),
+        )
+        local_data["returns"] = np.asarray(returns)
+        local_data["advantages"] = np.asarray(advantages)
+        flat = {k: v.reshape(v.shape[0] * v.shape[1], *v.shape[2:]) for k, v in local_data.items()}
+        if num_processes > 1:
+            flat = fabric.make_global(flat, (fabric.data_axis,))
+
+        with timer("Time/train_time"):
+            params, opt_state, metrics = train_fn(params, opt_state, flat)
+            metrics = jax.block_until_ready(metrics)
+        player.params = params
+        train_step += num_processes
+
+        if cfg.metric.log_level > 0:
+            aggregator.update("Loss/policy_loss", float(metrics[0]))
+            aggregator.update("Loss/value_loss", float(metrics[1]))
+            if policy_step - last_log >= cfg.metric.log_every or update == num_updates:
+                logger.log_metrics(aggregator.compute(), policy_step)
+                aggregator.reset()
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if timer_metrics.get("Time/train_time"):
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                            policy_step,
+                        )
+                    if timer_metrics.get("Time/env_interaction_time"):
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) * cfg.env.action_repeat
+                                )
+                                / timer_metrics["Time/env_interaction_time"]
+                            },
+                            policy_step,
+                        )
+                    timer.reset()
+                last_log = policy_step
+                last_train = train_step
+
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            update == num_updates and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": jax.device_get(params),
+                "opt_state": jax.device_get(opt_state),
+                "update": update,
+                "batch_size": int(cfg.algo.per_rank_batch_size) * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
+            fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(player, fabric, cfg, log_dir)
+    logger.finalize()

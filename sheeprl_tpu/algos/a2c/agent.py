@@ -1,0 +1,59 @@
+"""A2C agent (reference: sheeprl/algos/a2c/agent.py:19-230).
+
+Structurally the PPO agent restricted to vector observations; the module,
+sampling and evaluation helpers are shared with
+``sheeprl_tpu.algos.ppo.agent`` (the reference duplicates them)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import gymnasium
+
+from sheeprl_tpu.algos.ppo.agent import (  # noqa: F401  (re-exported API)
+    PPOAgent as A2CAgent,
+    PPOPlayer as A2CPlayer,
+    evaluate_actions,
+    sample_actions,
+)
+
+
+def build_agent(
+    fabric: Any,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    agent_state: Optional[Any] = None,
+) -> Tuple[A2CAgent, Any]:
+    """A2C is MLP-only (reference a2c.py:99-101 drops cnn keys)."""
+
+    algo = cfg["algo"]
+    agent = A2CAgent(
+        actions_dim=tuple(int(d) for d in actions_dim),
+        is_continuous=bool(is_continuous),
+        cnn_keys=(),
+        mlp_keys=tuple(algo["mlp_keys"]["encoder"]),
+        mlp_features_dim=algo["encoder"]["mlp_features_dim"],
+        encoder_units=int(algo["encoder"]["dense_units"]),
+        encoder_layers=int(algo["encoder"]["mlp_layers"]),
+        actor_units=int(algo["actor"]["dense_units"]),
+        actor_layers=int(algo["actor"]["mlp_layers"]),
+        critic_units=int(algo["critic"]["dense_units"]),
+        critic_layers=int(algo["critic"]["mlp_layers"]),
+        dense_act=str(algo["dense_act"]),
+        layer_norm=bool(algo["layer_norm"]),
+        dtype=fabric.precision.compute_dtype,
+    )
+    import jax
+    import jax.numpy as jnp
+
+    if agent_state is not None:
+        params = jax.tree.map(jnp.asarray, agent_state)
+    else:
+        dummy_obs = {
+            k: jnp.zeros((1, *obs_space[k].shape), jnp.float32) for k in agent.mlp_keys
+        }
+        params = agent.init(jax.random.PRNGKey(int(cfg["seed"])), dummy_obs)
+    params = jax.tree.map(lambda x: x.astype(fabric.precision.param_dtype), params)
+    return agent, fabric.replicate(params)

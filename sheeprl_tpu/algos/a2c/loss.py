@@ -1,0 +1,28 @@
+"""A2C losses (reference: sheeprl/algos/a2c/loss.py:5-54)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _reduce(x: Array, reduction: str) -> Array:
+    reduction = reduction.lower()
+    if reduction == "none":
+        return x
+    if reduction == "mean":
+        return x.mean()
+    if reduction == "sum":
+        return x.sum()
+    raise ValueError(f"Unrecognized reduction: {reduction}")
+
+
+def policy_loss(logprobs: Array, advantages: Array, reduction: str = "sum") -> Array:
+    """Vanilla policy gradient: -logpi(a|s) * A."""
+    return _reduce(-logprobs * advantages, reduction)
+
+
+def value_loss(values: Array, returns: Array, reduction: str = "sum") -> Array:
+    return _reduce(jnp.square(values - returns), reduction)

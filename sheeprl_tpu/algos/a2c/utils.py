@@ -1,0 +1,9 @@
+"""A2C helpers (reference: sheeprl/algos/a2c/utils.py)."""
+
+from __future__ import annotations
+
+AGGREGATOR_KEYS = {"Rewards/rew_avg", "Game/ep_len_avg", "Loss/value_loss", "Loss/policy_loss"}
+MODELS_TO_REGISTER = {"agent"}
+
+# vector-only observation prep and greedy test episode are identical to PPO's
+from sheeprl_tpu.algos.ppo.utils import prepare_obs, test  # noqa: E402,F401

@@ -1,0 +1,2 @@
+from sheeprl_tpu.algos.droq import droq  # noqa: F401  (registers the algorithm)
+from sheeprl_tpu.algos.droq import evaluate  # noqa: F401  (registers the evaluation)

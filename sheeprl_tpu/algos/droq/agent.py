@@ -1,0 +1,42 @@
+"""DroQ agent (reference: sheeprl/algos/droq/agent.py:20-266).
+
+The DroQ critic is the SAC critic with Dropout + LayerNorm
+(https://arxiv.org/abs/2110.02034); the ensemble stays a vmapped stack.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium
+
+from sheeprl_tpu.algos.sac.agent import (  # noqa: F401  (re-exported API)
+    SACActor,
+    SACAgent as DROQAgent,
+    SACCritic as DROQCritic,
+    SACPlayer,
+    actor_action_and_log_prob,
+    actor_greedy_action,
+    critic_ensemble_apply,
+)
+from sheeprl_tpu.algos.sac.agent import build_agent as sac_build_agent
+
+
+def build_agent(
+    fabric: Any,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    action_space: gymnasium.spaces.Box,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[DROQAgent, SACPlayer]:
+    return sac_build_agent(
+        fabric,
+        cfg,
+        obs_space,
+        action_space,
+        agent_state,
+        critic_kwargs={
+            "dropout": float(cfg["algo"]["critic"].get("dropout", 0.0)),
+            "layer_norm": True,
+        },
+    )

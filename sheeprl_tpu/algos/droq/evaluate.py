@@ -1,0 +1,31 @@
+"""DroQ evaluation entrypoint (reference: sheeprl/algos/droq/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+
+from sheeprl_tpu.algos.droq.agent import build_agent
+from sheeprl_tpu.algos.sac.utils import test
+from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms="droq")
+def evaluate(fabric, cfg: Dict[str, Any], state: Dict[str, Any]) -> None:
+    log_dir = get_log_dir(cfg)
+    logger = get_logger(cfg, log_dir)
+    fabric.logger = logger
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the DroQ agent")
+    env.close()
+
+    _, player = build_agent(fabric, cfg, observation_space, action_space, state["agent"])
+    test(player, fabric, cfg, log_dir)
+    logger.finalize()

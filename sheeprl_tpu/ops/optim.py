@@ -73,3 +73,30 @@ def rmsprop_tf(
     if max_grad_norm and max_grad_norm > 0:
         return optax.chain(optax.clip_by_global_norm(max_grad_norm), opt)
     return opt
+
+
+def rmsprop(
+    lr: float = 1e-3,
+    alpha: float = 0.99,
+    eps: float = 1e-8,
+    momentum: float = 0.0,
+    centered: bool = False,
+    weight_decay: float = 0.0,
+    max_grad_norm: float = 0.0,
+    schedule: Optional[Any] = None,
+) -> optax.GradientTransformation:
+    """torch.optim.RMSprop-style (epsilon outside the sqrt where supported)."""
+    try:
+        opt = optax.rmsprop(
+            _lr(lr, schedule), decay=alpha, eps=eps, centered=centered, momentum=momentum or None,
+            eps_in_sqrt=False,
+        )
+    except TypeError:  # older optax without eps_in_sqrt
+        opt = optax.rmsprop(
+            _lr(lr, schedule), decay=alpha, eps=eps, centered=centered, momentum=momentum or None
+        )
+    if weight_decay:
+        opt = optax.chain(optax.add_decayed_weights(weight_decay), opt)
+    if max_grad_norm and max_grad_norm > 0:
+        return optax.chain(optax.clip_by_global_norm(max_grad_norm), opt)
+    return opt
