@@ -63,7 +63,8 @@ def get_log_dir(cfg: Mapping[str, Any], root_dir: Optional[str] = None, run_name
 
     root_dir = root_dir or cfg["root_dir"]
     run_name = run_name or cfg["run_name"]
-    base = os.path.join("logs", "runs", root_dir, run_name)
+    base_dir = cfg.get("log_base_dir") or os.path.join("logs", "runs")
+    base = os.path.join(base_dir, root_dir, run_name)
     if jax.process_index() == 0:
         version = 0
         while os.path.isdir(os.path.join(base, f"version_{version}")):
