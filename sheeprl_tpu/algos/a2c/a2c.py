@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax import lax
-from jax.experimental.shard_map import shard_map
+from sheeprl_tpu.parallel.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 from sheeprl_tpu.algos.a2c.agent import build_agent
@@ -65,7 +65,6 @@ def make_train_fn(fabric, agent, tx, cfg, obs_keys):
             mesh=fabric.mesh,
             in_specs=(P(), P(), P(data_axis)),
             out_specs=(P(), P(), P()),
-            check_rep=False,
         )
     else:
         train_fn = local_train

@@ -1,23 +1,20 @@
-"""Dreamer-V3 (reference: sheeprl/algos/dreamer_v3/dreamer_v3.py:48-776) —
+"""Dreamer-V2 (reference: sheeprl/algos/dreamer_v2/dreamer_v2.py:41-776) —
 TPU-native.
 
-The redesign (SURVEY.md §7 hard parts, all addressed here):
+Same fused design as this repo's Dreamer-V3 (``algos/dreamer_v3/dreamer_v3.py``):
+RSSM + imagination as ``lax.scan`` inside ONE jitted train step, DP via
+``shard_map`` over the mesh's data axis with per-step gradient ``pmean``.
+DV2-specific behavior preserved from the reference:
 
-- **RSSM + imagination as ``lax.scan``** inside ONE jitted train step per
-  gradient step — the reference runs two Python loops over GRU cells
-  (dreamer_v3.py:134-145, :235-241).
-- **All three optimizations fused**: world model, actor, critic updates (plus
-  the Moments percentile sync) execute in a single XLA program; the
-  reference dispatches dozens of kernels per phase.
-- **DP via shard_map**: the batch axis of the ``[T, B, ...]`` sequence batch
-  is split across the mesh's data axis; per-minibatch gradient ``pmean`` and
-  the Moments ``all_gather`` (reference ``fabric.all_gather``,
-  utils.py:57) are mesh collectives over ICI.
-- **Variable replay ratio stays on host**: ``Ratio`` yields G gradient steps
-  per policy step; the host loops G times over the jitted step (fixed
-  shapes), exactly the reference's structure (dreamer_v3.py:657-693).
-- Pixels stay uint8 through the buffer and PCIe; normalization happens
-  in-graph (encoder) and in the loss targets.
+- KL balancing with ``kl_balancing_alpha`` + free nats (loss.py),
+- scalar Normal(mean, 1) reward/value heads (no two-hot),
+- optional continue model (``use_continues``) with ``gamma``-scaled targets,
+- lambda-returns with an explicit bootstrap from the *hard-updated* target
+  critic (dreamer_v2.py:273-284, 691-693),
+- actor objective = ``objective_mix`` * reinforce + (1 - mix) * dynamics
+  backprop (dreamer_v2.py:309-320),
+- sequential OR episode replay buffer (``buffer.type``,
+  dreamer_v2.py:485-510) — the EpisodeBuffer path with ``prioritize_ends``.
 """
 
 from __future__ import annotations
@@ -32,36 +29,30 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax import lax
-from sheeprl_tpu.parallel.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
-from sheeprl_tpu.algos.dreamer_v3.agent import (
-    WorldModel,
+from sheeprl_tpu.algos.dreamer_v2.agent import (
+    WorldModelDV2,
     actor_logprob_entropy,
     build_agent,
     rssm_scan,
     sample_actor_actions,
 )
-from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
-from sheeprl_tpu.algos.dreamer_v3.utils import AGGREGATOR_KEYS, prepare_obs, test
+from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
+from sheeprl_tpu.algos.dreamer_v2.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
-from sheeprl_tpu.data import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
 from sheeprl_tpu.envs import make_env
 from sheeprl_tpu.envs.wrappers import RestartOnException
-from sheeprl_tpu.ops.distributions import (
-    Bernoulli,
-    Independent,
-    MSEDistribution,
-    OneHotCategorical,
-    SymlogDistribution,
-    TwoHotEncodingDistribution,
-)
-from sheeprl_tpu.ops.math import MomentsState, compute_lambda_values, init_moments, update_moments
+from sheeprl_tpu.ops.distributions import Bernoulli, Independent, Normal, OneHotCategorical
+from sheeprl_tpu.ops.math import compute_lambda_values_bootstrap
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+from sheeprl_tpu.parallel.shard_map import shard_map
 
 METRIC_ORDER = (
     "Loss/world_model_loss",
@@ -82,7 +73,7 @@ METRIC_ORDER = (
 
 def make_train_fn(
     fabric,
-    wm: WorldModel,
+    wm: WorldModelDV2,
     actor,
     critic,
     world_tx,
@@ -93,7 +84,7 @@ def make_train_fn(
     actions_dim: Sequence[int],
 ):
     """One fused gradient step over a ``[T, B_local]`` sequence batch
-    (replaces reference train(), dreamer_v3.py:48-354)."""
+    (replaces reference train(), dreamer_v2.py:41-377)."""
     algo = cfg.algo
     wmc = algo.world_model
     cnn_keys = tuple(algo.cnn_keys.encoder)
@@ -104,12 +95,15 @@ def make_train_fn(
     gamma = float(algo.gamma)
     lmbda = float(algo.lmbda)
     ent_coef = float(algo.actor.ent_coef)
-    kl_dynamic, kl_representation = float(wmc.kl_dynamic), float(wmc.kl_representation)
-    kl_free_nats, kl_regularizer = float(wmc.kl_free_nats), float(wmc.kl_regularizer)
-    continue_scale = float(wmc.continue_scale_factor)
-    moments_cfg = algo.actor.moments
+    objective_mix = float(algo.actor.objective_mix)
+    kl_balancing_alpha = float(wmc.kl_balancing_alpha)
+    kl_free_nats, kl_free_avg = float(wmc.kl_free_nats), bool(wmc.kl_free_avg)
+    kl_regularizer = float(wmc.kl_regularizer)
+    discount_scale = float(wmc.discount_scale_factor)
+    use_continues = bool(wmc.use_continues)
     data_axis = fabric.data_axis
     multi_device = fabric.world_size > 1
+    n_actions = int(np.sum(actions_dim))
 
     def pmean(x):
         return lax.pmean(x, data_axis) if multi_device else x
@@ -122,7 +116,6 @@ def make_train_fn(
         world_opt,
         actor_opt,
         critic_opt,
-        moments_state,
         data,
         key,
     ):
@@ -134,25 +127,32 @@ def make_train_fn(
         T = data["rewards"].shape[0]
         B = data["rewards"].shape[1]
         is_first = data["is_first"].at[0].set(1.0)
-        # shift actions right: a_t in the RSSM input is the action LEADING to o_t
-        batch_actions = jnp.concatenate(
-            [jnp.zeros_like(data["actions"][:1]), data["actions"][:-1]], axis=0
-        )
         batch_obs = {k: data[k] for k in cnn_keys + mlp_keys}
-        # loss targets (decoder outputs are normalized pixels)
+        # loss targets: normalized pixels / raw vectors (reference
+        # dreamer_v2.py:124-126 normalizes on host; we do it in-graph)
         obs_targets = {k: data[k].astype(jnp.float32) / 255.0 - 0.5 for k in cnn_dec_keys}
         obs_targets.update({k: data[k].astype(jnp.float32) for k in mlp_dec_keys})
 
-        # ---------------- world model step (Eq. 4/5) ---------------- #
+        # ---------------- world model step (Eq. 2) ---------------- #
         def world_loss_fn(p):
-            embedded = wm.apply(p, batch_obs, method=WorldModel.encode)
-            hs, zs, post_logits, prior_logits = rssm_scan(wm, p, embedded, batch_actions, is_first, k_scan)
+            embedded = wm.apply(p, batch_obs, method=WorldModelDV2.encode)
+            hs, zs, post_logits, prior_logits = rssm_scan(
+                wm, p, embedded, data["actions"], is_first, k_scan
+            )
             latents = jnp.concatenate([zs, hs], axis=-1)
-            recon = wm.apply(p, latents, method=WorldModel.decode)
-            po = {k: MSEDistribution(recon[k], dims=3) for k in cnn_dec_keys}
-            po.update({k: SymlogDistribution(recon[k], dims=1) for k in mlp_dec_keys})
-            pr = TwoHotEncodingDistribution(wm.apply(p, latents, method=WorldModel.reward_logits), dims=1)
-            pc = Independent(Bernoulli(logits=wm.apply(p, latents, method=WorldModel.continue_logits)), 1)
+            recon = wm.apply(p, latents, method=WorldModelDV2.decode)
+            po = {
+                k: Independent(Normal(recon[k], jnp.ones_like(recon[k])), 3 if k in cnn_dec_keys else 1)
+                for k in cnn_dec_keys + mlp_dec_keys
+            }
+            pr = Independent(Normal(wm.apply(p, latents, method=WorldModelDV2.reward_mean), 1.0), 1)
+            if use_continues:
+                pc = Independent(
+                    Bernoulli(logits=wm.apply(p, latents, method=WorldModelDV2.continue_logits)), 1
+                )
+                continue_targets = (1 - data["terminated"]) * gamma
+            else:
+                pc = continue_targets = None
             loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
                 po,
                 obs_targets,
@@ -160,13 +160,13 @@ def make_train_fn(
                 data["rewards"],
                 prior_logits,
                 post_logits,
-                kl_dynamic,
-                kl_representation,
+                kl_balancing_alpha,
                 kl_free_nats,
+                kl_free_avg,
                 kl_regularizer,
                 pc,
-                1 - data["terminated"],
-                continue_scale,
+                continue_targets,
+                discount_scale,
             )
             aux = (hs, zs, post_logits, prior_logits, kl, state_loss, reward_loss, observation_loss, continue_loss)
             return loss, aux
@@ -180,67 +180,62 @@ def make_train_fn(
         wm_params = optax.apply_updates(wm_params, wm_updates)
 
         # ---------------- behaviour learning ---------------- #
-        # imagination starts from every (t, b) posterior, flattened
         start_z = sg(zs).reshape(T * B, -1)
         start_h = sg(hs).reshape(T * B, -1)
-        true_continue = (1 - data["terminated"]).reshape(T * B, 1)
 
         def imagine(actor_params, key):
-            """Imagination rollout (reference dreamer_v3.py:203-241):
-            ``lats[i]`` is the i-th latent, ``acts[i]`` the action sampled at
-            it; the scan body advances to ``lats[i+1]`` — H+1 entries."""
+            """Imagination rollout (reference dreamer_v2.py:210-260):
+            ``lats[0]`` is the replayed posterior latent with a zero action;
+            the scan advances H prior steps — H+1 latents, H+1 actions
+            (``acts[0]`` zeros, ``acts[i>=1]`` sampled at ``lats[i-1]``)."""
             lat0 = jnp.concatenate([start_z, start_h], axis=-1)
 
             def step(carry, _):
                 z, h, lat, key = carry
                 key, k_act, k_state = jax.random.split(key, 3)
                 action = sample_actor_actions(actor, actor_params, sg(lat), k_act)
-                z, h = wm.apply(wm_params, z, h, action, k_state, method=WorldModel.imagination)
+                z, h = wm.apply(wm_params, z, h, action, k_state, method=WorldModelDV2.imagination)
                 new_lat = jnp.concatenate([z, h], axis=-1)
-                return (z, h, new_lat, key), (lat, action)
+                return (z, h, new_lat, key), (new_lat, action)
 
-            _, (lats, acts) = lax.scan(step, (start_z, start_h, lat0, key), None, length=horizon + 1)
+            _, (lats, acts) = lax.scan(step, (start_z, start_h, lat0, key), None, length=horizon)
+            lats = jnp.concatenate([lat0[None], lats], axis=0)
+            acts = jnp.concatenate([jnp.zeros((1, T * B, n_actions), acts.dtype), acts], axis=0)
             return lats, acts
 
         def actor_loss_fn(p):
             trajectories, imagined_actions = imagine(p, k_img)  # [H+1, N, L] / [H+1, N, A]
 
-            values = TwoHotEncodingDistribution(critic.apply(critic_params, trajectories), dims=1).mean
-            rewards = TwoHotEncodingDistribution(
-                wm.apply(wm_params, trajectories, method=WorldModel.reward_logits), dims=1
-            ).mean
-            continues = Independent(
-                Bernoulli(logits=wm.apply(wm_params, trajectories, method=WorldModel.continue_logits)), 1
-            ).mode
-            continues = jnp.concatenate([true_continue[None], continues[1:]], axis=0)
-
-            lambda_values = compute_lambda_values(rewards[1:], values[1:], continues[1:] * gamma, lmbda)
-            discount = sg(jnp.cumprod(continues * gamma, axis=0) / gamma)
-
-            new_moments, (offset, invscale) = update_moments(
-                moments_state,
-                lambda_values,
-                decay=float(moments_cfg.decay),
-                max_=float(moments_cfg.max),
-                percentile_low=float(moments_cfg.percentile.low),
-                percentile_high=float(moments_cfg.percentile.high),
-                axis_name=data_axis if multi_device else None,
-            )
-            baseline = values[:-1]
-            normed_lambda = (lambda_values - offset) / invscale
-            normed_baseline = (baseline - offset) / invscale
-            advantage = normed_lambda - normed_baseline
-            logp, entropy = actor_logprob_entropy(actor, p, sg(trajectories), sg(imagined_actions))
-            if is_continuous:
-                objective = advantage
+            target_values = critic.apply(target_params, trajectories)  # Normal mean
+            rewards = wm.apply(wm_params, trajectories, method=WorldModelDV2.reward_mean)
+            if use_continues:
+                continues = jax.nn.sigmoid(
+                    wm.apply(wm_params, trajectories, method=WorldModelDV2.continue_logits)
+                )
+                true_continue = (1 - data["terminated"]).reshape(1, T * B, 1) * gamma
+                continues = jnp.concatenate([true_continue, continues[1:]], axis=0)
             else:
-                objective = logp[..., None][:-1] * sg(advantage)
-            policy_loss = -jnp.mean(
-                sg(discount[:-1]) * (objective + ent_coef * entropy[..., None][:-1])
-            )
-            return policy_loss, (trajectories, lambda_values, discount, new_moments)
+                continues = jnp.ones_like(rewards) * gamma
 
-        (policy_loss, (trajectories, lambda_values, discount, moments_state)), actor_grads = jax.value_and_grad(
+            lambda_values = compute_lambda_values_bootstrap(
+                rewards[:-1], target_values[:-1], continues[:-1], bootstrap=target_values[-1:], lmbda=lmbda
+            )
+            discount = sg(
+                jnp.cumprod(jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-1]], axis=0), axis=0)
+            )
+
+            # reinforce + dynamics mix (reference dreamer_v2.py:299-320)
+            dynamics = lambda_values[1:]
+            advantage = sg(lambda_values[1:] - target_values[:-2])
+            logp, entropy = actor_logprob_entropy(
+                actor, p, sg(trajectories[:-2]), sg(imagined_actions[1:-1])
+            )
+            reinforce = logp[..., None] * advantage
+            objective = objective_mix * reinforce + (1 - objective_mix) * dynamics
+            policy_loss = -jnp.mean(sg(discount[:-2]) * (objective + ent_coef * entropy[..., None]))
+            return policy_loss, (trajectories, lambda_values, discount)
+
+        (policy_loss, (trajectories, lambda_values, discount)), actor_grads = jax.value_and_grad(
             actor_loss_fn, has_aux=True
         )(actor_params)
         actor_grads = pmean(actor_grads)
@@ -248,14 +243,12 @@ def make_train_fn(
         actor_updates, actor_opt = actor_tx.update(actor_grads, actor_opt, actor_params)
         actor_params = optax.apply_updates(actor_params, actor_updates)
 
-        # ---------------- critic step (Eq. 10) ---------------- #
+        # ---------------- critic step (Eq. 5) ---------------- #
         traj_in = sg(trajectories[:-1])
-        target_values = TwoHotEncodingDistribution(critic.apply(target_params, traj_in), dims=1).mean
 
         def critic_loss_fn(p):
-            qv = TwoHotEncodingDistribution(critic.apply(p, traj_in), dims=1)
-            value_loss = -qv.log_prob(sg(lambda_values)) - qv.log_prob(sg(target_values))
-            return jnp.mean(value_loss * sg(discount[:-1]).squeeze(-1))
+            qv = Independent(Normal(critic.apply(p, traj_in), 1.0), 1)
+            return -jnp.mean(sg(discount[:-1])[..., 0] * qv.log_prob(sg(lambda_values)))
 
         value_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(critic_params)
         critic_grads = pmean(critic_grads)
@@ -291,7 +284,6 @@ def make_train_fn(
             world_opt,
             actor_opt,
             critic_opt,
-            moments_state,
             metrics,
         )
 
@@ -299,12 +291,12 @@ def make_train_fn(
         train_fn = shard_map(
             local_train,
             mesh=fabric.mesh,
-            in_specs=(P(), P(), P(), P(), P(), P(), P(), P(), P(None, data_axis), P()),
-            out_specs=(P(), P(), P(), P(), P(), P(), P(), P()),
+            in_specs=(P(), P(), P(), P(), P(), P(), P(), P(None, data_axis), P()),
+            out_specs=(P(), P(), P(), P(), P(), P(), P()),
         )
     else:
         train_fn = local_train
-    return jax.jit(train_fn, donate_argnums=(0, 1, 2, 4, 5, 6, 7))
+    return jax.jit(train_fn, donate_argnums=(0, 1, 2, 4, 5, 6))
 
 
 @register_algorithm()
@@ -312,10 +304,10 @@ def main(fabric, cfg: Dict[str, Any]):
     if cfg.checkpoint.resume_from:
         state = fabric.load(cfg.checkpoint.resume_from)
 
-    # these arguments cannot be changed (reference dreamer_v3.py:366-369)
+    # these arguments cannot be changed (reference dreamer_v2.py:389-391):
+    # the k5,k5,k6,k6 transposed-conv decoder reconstructs exactly 64x64
+    cfg.env.screen_size = 64
     cfg.env.frame_stack = 1
-    if 2 ** int(np.log2(cfg.env.screen_size)) != cfg.env.screen_size:
-        raise ValueError(f"The screen size must be a power of 2, got: {cfg.env.screen_size}")
 
     log_dir = get_log_dir(cfg)
     logger = get_logger(cfg, log_dir)
@@ -325,8 +317,8 @@ def main(fabric, cfg: Dict[str, Any]):
 
     rank = fabric.process_index
     num_envs = int(cfg.env.num_envs)
-    world_size = fabric.world_size  # devices: sets the global batch split
-    num_processes = fabric.num_processes  # hosts: sets the env-step accounting
+    world_size = fabric.world_size
+    num_processes = fabric.num_processes
 
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
     envs = vectorized_env(
@@ -395,14 +387,10 @@ def main(fabric, cfg: Dict[str, Any]):
     world_opt = fabric.replicate(world_tx.init(jax.device_get(wm_params)))
     actor_opt = fabric.replicate(actor_tx.init(jax.device_get(actor_params)))
     critic_opt = fabric.replicate(critic_tx.init(jax.device_get(critic_params)))
-    moments_state: MomentsState = init_moments()
     if cfg.checkpoint.resume_from:
         world_opt = fabric.replicate(jax.tree.map(jnp.asarray, state["world_optimizer"]))
         actor_opt = fabric.replicate(jax.tree.map(jnp.asarray, state["actor_optimizer"]))
         critic_opt = fabric.replicate(jax.tree.map(jnp.asarray, state["critic_optimizer"]))
-        moments_state = MomentsState(
-            low=jnp.asarray(state["moments"]["low"]), high=jnp.asarray(state["moments"]["high"])
-        )
 
     if fabric.is_global_zero:
         save_configs(cfg, log_dir)
@@ -411,29 +399,44 @@ def main(fabric, cfg: Dict[str, Any]):
     for k in AGGREGATOR_KEYS - set(aggregator.metrics):
         aggregator.add(k, "mean")
 
+    # sequential or episode buffer (reference dreamer_v2.py:485-510)
     buffer_size = cfg.buffer.size // int(num_envs * num_processes) if not cfg.dry_run else 2
-    rb = EnvIndependentReplayBuffer(
-        buffer_size,
-        n_envs=num_envs,
-        obs_keys=obs_keys,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
-        buffer_cls=SequentialReplayBuffer,
-        seed=cfg.seed,
-    )
+    buffer_type = str(cfg.buffer.get("type", "sequential")).lower()
+    if buffer_type == "sequential":
+        rb = EnvIndependentReplayBuffer(
+            buffer_size,
+            n_envs=num_envs,
+            obs_keys=obs_keys,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+            buffer_cls=SequentialReplayBuffer,
+            seed=cfg.seed,
+        )
+    elif buffer_type == "episode":
+        rb = EpisodeBuffer(
+            buffer_size,
+            minimum_episode_length=1 if cfg.dry_run else int(cfg.algo.per_rank_sequence_length),
+            n_envs=num_envs,
+            obs_keys=obs_keys,
+            prioritize_ends=bool(cfg.buffer.get("prioritize_ends", False)),
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+            seed=cfg.seed,
+        )
+    else:
+        raise ValueError(f"Unrecognized buffer type: must be one of `sequential` or `episode`, received: {buffer_type}")
     if cfg.checkpoint.resume_from and cfg.buffer.checkpoint:
         rb = state["rb"]
 
-    # EMA update for the target critic (reference dreamer_v3.py:670-675)
+    # hard target-critic copy (reference dreamer_v2.py:691-693)
     @jax.jit
-    def ema(cp, tcp, tau):
-        return jax.tree.map(lambda c, t: tau * c + (1 - tau) * t, cp, tcp)
+    def hard_copy(cp):
+        return jax.tree.map(jnp.copy, cp)
 
     train_fn = make_train_fn(
         fabric, wm, actor, critic, world_tx, actor_tx, critic_tx, cfg, is_continuous, actions_dim
     )
 
-    # counters (reference dreamer_v3.py:491-516)
     train_step = 0
     last_train = 0
     start_step = state["update"] + 1 if cfg.checkpoint.resume_from else 1
@@ -458,16 +461,24 @@ def main(fabric, cfg: Dict[str, Any]):
     if cfg.checkpoint.resume_from and "rng_key" in state:
         key = jnp.asarray(state["rng_key"])
 
-    # first observation (reference dreamer_v3.py:534-543)
+    # first observation: stored immediately with zero action/reward
+    # (reference dreamer_v2.py:560-577)
     step_data: Dict[str, np.ndarray] = {}
     obs, _ = envs.reset(seed=cfg.seed)
     prepared = prepare_obs(obs, cnn_keys=cnn_keys, num_envs=num_envs)
     for k in obs_keys:
         step_data[k] = prepared[k][np.newaxis]
-    step_data["rewards"] = np.zeros((1, num_envs, 1), np.float32)
-    step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
+    step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
+    step_data["actions"] = np.zeros((1, num_envs, int(np.sum(actions_dim))), np.float32)
+    step_data["rewards"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["is_first"] = np.ones_like(step_data["terminated"])
+    if cfg.dry_run:
+        # close a length-1 episode immediately so the episode buffer has
+        # something to sample (reference dreamer_v2.py:570-573)
+        step_data["truncated"] = step_data["truncated"] + 1
+        step_data["terminated"] = step_data["terminated"] + 1
+    rb.add(step_data, validate_args=cfg.buffer.validate_args)
     player.init_states()
 
     cumulative_per_rank_gradient_steps = 0
@@ -488,7 +499,9 @@ def main(fabric, cfg: Dict[str, Any]):
             else:
                 key, action_key = jax.random.split(key)
                 prepared = prepare_obs(obs, cnn_keys=cnn_keys, num_envs=num_envs)
-                actions = player.get_actions(prepared, action_key)
+                actions = player.get_actions(
+                    prepared, action_key, expl_step=policy_step, with_exploration=True
+                )
                 if is_continuous:
                     real_actions = actions
                 else:
@@ -499,25 +512,21 @@ def main(fabric, cfg: Dict[str, Any]):
                     if real_actions.shape[-1] == 1 and not is_multidiscrete:
                         real_actions = real_actions[..., 0]
 
-            step_data["actions"] = np.asarray(actions, np.float32).reshape(1, num_envs, -1)
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
-
+            # is_first of the NEXT stored row = this row ended an episode
+            # (reference dreamer_v2.py:616)
+            step_data["is_first"] = np.logical_or(
+                step_data["terminated"], step_data["truncated"]
+            ).astype(np.float32)
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
             )
             dones = np.logical_or(terminated, truncated).astype(np.uint8)
+            if cfg.dry_run and buffer_type == "episode":
+                dones = np.ones_like(dones)
 
-        step_data["is_first"] = np.zeros_like(step_data["terminated"])
         if "restart_on_exception" in infos:
             for i, roe in enumerate(np.asarray(infos["restart_on_exception"]).reshape(-1)):
                 if roe and not dones[i]:
-                    # patch the last stored step to a truncation and restart the
-                    # episode (reference dreamer_v3.py:591-604)
-                    sub = rb.buffer[i]
-                    last_idx = (sub._pos - 1) % sub.buffer_size
-                    sub["terminated"][last_idx] = 0.0
-                    sub["truncated"][last_idx] = 1.0
-                    sub["is_first"][last_idx] = 0.0
                     step_data["is_first"][0, i] = 1.0
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
@@ -528,7 +537,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
                     print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep['r'][i]}")
 
-        # the final obs of finished episodes (SAME_STEP autoreset provides it)
+        # store the true final obs of finished episodes (reference :641-648)
         real_next_obs = {k: np.asarray(v).copy() for k, v in next_obs.items()}
         if "final_obs" in infos:
             for idx, final_obs in enumerate(infos["final_obs"]):
@@ -536,45 +545,40 @@ def main(fabric, cfg: Dict[str, Any]):
                     for k, v in final_obs.items():
                         real_next_obs[k][idx] = v
 
-        prepared_next = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=num_envs)
+        prepared_next = prepare_obs(real_next_obs, cnn_keys=cnn_keys, num_envs=num_envs)
         for k in obs_keys:
             step_data[k] = prepared_next[k][np.newaxis]
         obs = next_obs
 
-        rewards = np.asarray(rewards, np.float32).reshape(1, num_envs, 1)
         step_data["terminated"] = np.asarray(terminated, np.float32).reshape(1, num_envs, 1)
         step_data["truncated"] = np.asarray(truncated, np.float32).reshape(1, num_envs, 1)
-        step_data["rewards"] = clip_rewards_fn(rewards)
+        step_data["actions"] = np.asarray(actions, np.float32).reshape(1, num_envs, -1)
+        step_data["rewards"] = clip_rewards_fn(np.asarray(rewards, np.float32).reshape(1, num_envs, 1))
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
         dones_idxes = dones.nonzero()[0].tolist()
         if dones_idxes:
-            # store the terminal transition with the true final obs, zero
-            # action, then reset per-env episode state
-            # (reference dreamer_v3.py:635-653)
-            prepared_final = prepare_obs(
-                {k: real_next_obs[k][dones_idxes] for k in obs_keys},
+            # store the post-reset obs row (reference dreamer_v2.py:652-668)
+            prepared_reset = prepare_obs(
+                {k: np.asarray(next_obs[k])[dones_idxes] for k in obs_keys},
                 cnn_keys=cnn_keys,
                 num_envs=len(dones_idxes),
             )
-            reset_data = {k: prepared_final[k][np.newaxis] for k in obs_keys}
-            reset_data["terminated"] = step_data["terminated"][:, dones_idxes]
-            reset_data["truncated"] = step_data["truncated"][:, dones_idxes]
+            reset_data = {k: prepared_reset[k][np.newaxis] for k in obs_keys}
+            reset_data["terminated"] = np.zeros((1, len(dones_idxes), 1), np.float32)
+            reset_data["truncated"] = np.zeros((1, len(dones_idxes), 1), np.float32)
             reset_data["actions"] = np.zeros((1, len(dones_idxes), int(np.sum(actions_dim))), np.float32)
-            reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
-            reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
+            reset_data["rewards"] = np.zeros((1, len(dones_idxes), 1), np.float32)
+            reset_data["is_first"] = np.ones_like(reset_data["terminated"])
             rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
-
-            step_data["rewards"][:, dones_idxes] = 0.0
-            step_data["terminated"][:, dones_idxes] = 0.0
-            step_data["truncated"][:, dones_idxes] = 0.0
-            step_data["is_first"][:, dones_idxes] = 1.0
+            step_data["terminated"][0, dones_idxes] = 0.0
+            step_data["truncated"][0, dones_idxes] = 0.0
             player.init_states(dones_idxes)
 
         # ---------------- training ---------------- #
         if update >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step / num_processes)
             if per_rank_gradient_steps > 0:
-                # each process samples its share of the global batch
                 local_data = rb.sample(
                     per_rank_batch_size * fabric.local_device_count,
                     sequence_length=sequence_length,
@@ -587,8 +591,7 @@ def main(fabric, cfg: Dict[str, Any]):
                             % cfg.algo.critic.per_rank_target_network_update_freq
                             == 0
                         ):
-                            tau = 1.0 if cumulative_per_rank_gradient_steps == 0 else float(cfg.algo.critic.tau)
-                            target_critic_params = ema(critic_params, target_critic_params, tau)
+                            target_critic_params = hard_copy(critic_params)
                         batch = {
                             k: (v[i] if k in cnn_keys else v[i].astype(np.float32))
                             for k, v in local_data.items()
@@ -603,7 +606,6 @@ def main(fabric, cfg: Dict[str, Any]):
                             world_opt,
                             actor_opt,
                             critic_opt,
-                            moments_state,
                             metrics,
                         ) = train_fn(
                             wm_params,
@@ -613,7 +615,6 @@ def main(fabric, cfg: Dict[str, Any]):
                             world_opt,
                             actor_opt,
                             critic_opt,
-                            moments_state,
                             batch,
                             train_key,
                         )
@@ -670,10 +671,6 @@ def main(fabric, cfg: Dict[str, Any]):
                 "world_optimizer": jax.device_get(world_opt),
                 "actor_optimizer": jax.device_get(actor_opt),
                 "critic_optimizer": jax.device_get(critic_opt),
-                "moments": {
-                    "low": np.asarray(jax.device_get(moments_state.low)),
-                    "high": np.asarray(jax.device_get(moments_state.high)),
-                },
                 "ratio": ratio.state_dict(),
                 "update": update,
                 "batch_size": per_rank_batch_size * world_size,

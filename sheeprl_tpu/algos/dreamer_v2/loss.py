@@ -1,0 +1,71 @@
+"""Dreamer-V2 world-model loss with KL balancing
+(reference: sheeprl/algos/dreamer_v2/loss.py:9-89)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.ops.distributions import (
+    Independent,
+    OneHotCategoricalStraightThrough,
+    kl_divergence,
+)
+
+Array = jax.Array
+
+
+def reconstruction_loss(
+    po: Dict[str, object],
+    observations: Dict[str, Array],
+    pr: object,
+    rewards: Array,
+    priors_logits: Array,
+    posteriors_logits: Array,
+    kl_balancing_alpha: float = 0.8,
+    kl_free_nats: float = 0.0,
+    kl_free_avg: bool = True,
+    kl_regularizer: float = 1.0,
+    pc: Optional[object] = None,
+    continue_targets: Optional[Array] = None,
+    discount_scale_factor: float = 1.0,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Eq. 2 of the DV2 paper: observation + reward (+ continue) NLL plus the
+    KL-balanced state term:
+    ``alpha * KL(sg(post) || prior) + (1 - alpha) * KL(post || sg(prior))``
+    with free nats applied per-side (averaged first when ``kl_free_avg``).
+
+    ``priors_logits``/``posteriors_logits`` are ``[T, B, S, D]``.
+    Returns ``(loss, kl, state_loss, reward_loss, observation_loss,
+    continue_loss)`` — same order as the reference.
+    """
+    observation_loss = -sum(po[k].log_prob(observations[k]).mean() for k in po.keys())
+    reward_loss = -pr.log_prob(rewards).mean()
+
+    sg = jax.lax.stop_gradient
+    lhs = kl = kl_divergence(
+        Independent(OneHotCategoricalStraightThrough(logits=sg(posteriors_logits)), 1),
+        Independent(OneHotCategoricalStraightThrough(logits=priors_logits), 1),
+    )
+    rhs = kl_divergence(
+        Independent(OneHotCategoricalStraightThrough(logits=posteriors_logits), 1),
+        Independent(OneHotCategoricalStraightThrough(logits=sg(priors_logits)), 1),
+    )
+    free_nats = jnp.asarray(kl_free_nats, lhs.dtype)
+    if kl_free_avg:
+        loss_lhs = jnp.maximum(lhs.mean(), free_nats)
+        loss_rhs = jnp.maximum(rhs.mean(), free_nats)
+    else:
+        loss_lhs = jnp.maximum(lhs, free_nats).mean()
+        loss_rhs = jnp.maximum(rhs, free_nats).mean()
+    kl_loss = kl_balancing_alpha * loss_lhs + (1 - kl_balancing_alpha) * loss_rhs
+
+    if pc is not None and continue_targets is not None:
+        continue_loss = discount_scale_factor * -pc.log_prob(continue_targets).mean()
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+
+    total = kl_regularizer * kl_loss + observation_loss + reward_loss + continue_loss
+    return total, kl.mean(), kl_loss, reward_loss, observation_loss, continue_loss

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax import lax
-from jax.experimental.shard_map import shard_map
+from sheeprl_tpu.parallel.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 from sheeprl_tpu.algos.droq.agent import (
@@ -139,7 +139,6 @@ def make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg):
             mesh=fabric.mesh,
             in_specs=(P(), P(), P(), P(), P(), P(), P(), P(None, data_axis), P(data_axis), P()),
             out_specs=(P(), P(), P(), P(), P(), P(), P(), P()),
-            check_rep=False,
         )
     else:
         train_fn = local_train

@@ -32,7 +32,7 @@ import numpy as np
 import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from sheeprl_tpu.parallel.shard_map import shard_map
 
 from sheeprl_tpu.algos.ppo.agent import PPOPlayer, build_agent, evaluate_actions
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
@@ -115,7 +115,6 @@ def make_train_fn(fabric, agent, tx, cfg, obs_keys, n_local: int):
         mesh=fabric.mesh,
         in_specs=(P(), P(), P(data_axis), P(), P(), P()),
         out_specs=(P(), P(), P()),
-        check_rep=False,
     )
     return jax.jit(train_fn, donate_argnums=(0, 1))
 

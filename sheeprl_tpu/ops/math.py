@@ -126,6 +126,33 @@ def compute_lambda_values(
     return lambda_values
 
 
+def compute_lambda_values_bootstrap(
+    rewards: Array,
+    values: Array,
+    continues: Array,
+    bootstrap: Optional[Array] = None,
+    lmbda: float = 0.95,
+) -> Array:
+    """TD(lambda) returns with an explicit bootstrap value — the Dreamer-V1/V2
+    recurrence (reference algos/dreamer_v2/utils.py:86-105):
+    ``R_t = r_t + c_t * [(1 - lambda) * v_{t+1} + lambda * R_{t+1}]`` with
+    ``R_T = bootstrap``, as a reverse ``lax.scan``.
+    ``rewards``/``values``/``continues`` are ``[T, ...]`` time-major;
+    ``bootstrap`` is ``[1, ...]`` (defaults to zeros)."""
+    if bootstrap is None:
+        bootstrap = jnp.zeros_like(values[-1:])
+    next_values = jnp.concatenate([values[1:], bootstrap], axis=0)
+    interm = rewards + continues * next_values * (1 - lmbda)
+
+    def step(carry, xs):
+        inte, cont = xs
+        ret = inte + cont * lmbda * carry
+        return ret, ret
+
+    _, lambda_values = lax.scan(step, bootstrap[0], (interm, continues), reverse=True)
+    return lambda_values
+
+
 def normalize(x: Array, eps: float = 1e-8, mask: Optional[Array] = None) -> Array:
     """Standardize ``x`` with optional boolean mask (reference
     utils/utils.py:120-130). Shape-preserving (masked positions are normalized

@@ -1,0 +1,20 @@
+"""Version-stable ``shard_map`` wrapper.
+
+jax >= 0.7 promotes ``shard_map`` to ``jax.shard_map`` and renames
+``check_rep`` to ``check_vma``; older versions only have
+``jax.experimental.shard_map.shard_map``. Every algorithm shards its fused
+train step through this wrapper (replication checking off: train steps mix
+replicated params with data-sharded batches and per-device RNG folding).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
