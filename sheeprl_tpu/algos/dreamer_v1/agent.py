@@ -1,0 +1,402 @@
+"""Dreamer-V1 agent (reference: sheeprl/algos/dreamer_v1/agent.py:32-547).
+
+flax re-design sharing this repo's DV2 layout (``algos/dreamer_v2/agent.py``).
+What makes V1 different from V2, encoded here:
+
+- the stochastic latent is a **continuous diagonal Gaussian** (no discrete
+  codes): the representation/transition heads emit ``2 * stochastic_size``
+  values split into (mean, std) with ``std = softplus(std) + min_std``
+  (reference dreamer_v1/utils.py:81-110),
+- the recurrent model is Dense+act into a **plain GRU** (reference
+  agent.py:32-62 — no LayerNorm variant),
+- no ``is_first`` gating in the RSSM (reference RSSM.dynamic,
+  agent.py:99-137, predates that machinery),
+- the actor/critic are the DV2 modules verbatim (the reference itself
+  aliases ``Actor = DV2Actor``, agent.py:28-29).
+
+All sequence loops are ``lax.scan``; images NHWC uint8 normalized in-graph.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v2.agent import (
+    ActorDV2,
+    CNNDecoderDV2,
+    CNNEncoderDV2,
+    CriticDV2,
+    MLPDecoderDV2,
+    MLPEncoderDV2,
+    PlayerDV2,
+    _dense,
+    _MLPBlock,
+    actor_dists,
+    actor_logprob_entropy,
+    add_exploration_noise,
+    sample_actor_actions,
+)
+
+Array = jax.Array
+
+# V1 reuses the V2 actor/critic/player wholesale (reference agent.py:28-29).
+ActorDV1 = ActorDV2
+CriticDV1 = CriticDV2
+PlayerDV1 = PlayerDV2
+
+__all__ = [
+    "ActorDV1",
+    "CriticDV1",
+    "PlayerDV1",
+    "WorldModelDV1",
+    "actor_dists",
+    "actor_logprob_entropy",
+    "add_exploration_noise",
+    "build_agent",
+    "rssm_scan_dv1",
+    "sample_actor_actions",
+]
+
+
+class RecurrentModelDV1(nn.Module):
+    """Dense+act projection into a standard GRU (reference
+    RecurrentModel, agent.py:32-62; projection width equals the recurrent
+    state size there)."""
+
+    recurrent_state_size: int
+    act: str = "elu"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array, h: Array) -> Array:
+        feat = _MLPBlock(1, self.recurrent_state_size, self.act, False, self.dtype)(x)
+        new_h, _ = nn.GRUCell(self.recurrent_state_size, dtype=self.dtype, param_dtype=jnp.float32)(
+            h.astype(self.dtype), feat
+        )
+        return new_h.astype(jnp.float32)
+
+
+class WorldModelDV1(nn.Module):
+    """Encoder + Gaussian RSSM + decoders + reward (+ optional continue) in
+    one param tree (reference WorldModel container agent.py:199-217 and RSSM
+    agent.py:65-196). Methods are ``apply(..., method=...)`` entry points."""
+
+    cnn_keys: Tuple[str, ...]
+    mlp_keys: Tuple[str, ...]
+    cnn_output_channels: Tuple[int, ...]
+    mlp_output_dims: Tuple[int, ...]
+    image_size: Tuple[int, int]
+    actions_dim: Tuple[int, ...]
+    stochastic_size: int = 30
+    min_std: float = 0.1
+    recurrent_state_size: int = 200
+    encoder_cnn_multiplier: int = 32
+    encoder_mlp_layers: int = 4
+    encoder_dense_units: int = 400
+    decoder_cnn_multiplier: int = 32
+    decoder_mlp_layers: int = 4
+    decoder_dense_units: int = 400
+    representation_hidden_size: int = 200
+    transition_hidden_size: int = 200
+    reward_layers: int = 4
+    reward_dense_units: int = 400
+    use_continues: bool = False
+    continue_layers: int = 4
+    continue_dense_units: int = 400
+    dense_act: str = "elu"
+    cnn_act: str = "relu"
+    dtype: Any = jnp.float32
+
+    @property
+    def stoch_state_size(self) -> int:
+        return self.stochastic_size
+
+    @property
+    def latent_state_size(self) -> int:
+        return self.stochastic_size + self.recurrent_state_size
+
+    @property
+    def cnn_encoder_output_dim(self) -> int:
+        hw = self.image_size[0]
+        for _ in range(4):
+            hw = (hw - 4) // 2 + 1
+        return hw * hw * 8 * self.encoder_cnn_multiplier
+
+    def setup(self) -> None:
+        if self.cnn_keys:
+            self.cnn_encoder = CNNEncoderDV2(
+                self.cnn_keys, self.encoder_cnn_multiplier, self.cnn_act, False, self.dtype
+            )
+            self.cnn_decoder = CNNDecoderDV2(
+                self.cnn_keys,
+                self.cnn_output_channels,
+                self.decoder_cnn_multiplier,
+                self.cnn_encoder_output_dim,
+                self.image_size,
+                self.cnn_act,
+                False,
+                self.dtype,
+            )
+        if self.mlp_keys:
+            self.mlp_encoder = MLPEncoderDV2(
+                self.mlp_keys, self.encoder_mlp_layers, self.encoder_dense_units, self.dense_act, False, self.dtype
+            )
+            self.mlp_decoder = MLPDecoderDV2(
+                self.mlp_keys,
+                self.mlp_output_dims,
+                self.decoder_mlp_layers,
+                self.decoder_dense_units,
+                self.dense_act,
+                False,
+                self.dtype,
+            )
+        self.recurrent_model = RecurrentModelDV1(self.recurrent_state_size, self.dense_act, self.dtype)
+        self.representation_model = nn.Sequential(
+            [
+                _MLPBlock(1, self.representation_hidden_size, self.dense_act, False, self.dtype),
+                _dense(2 * self.stochastic_size, jnp.float32),
+            ]
+        )
+        self.transition_model = nn.Sequential(
+            [
+                _MLPBlock(1, self.transition_hidden_size, self.dense_act, False, self.dtype),
+                _dense(2 * self.stochastic_size, jnp.float32),
+            ]
+        )
+        self.reward_model = nn.Sequential(
+            [
+                _MLPBlock(self.reward_layers, self.reward_dense_units, self.dense_act, False, self.dtype),
+                _dense(1, jnp.float32),
+            ]
+        )
+        if self.use_continues:
+            self.continue_model = nn.Sequential(
+                [
+                    _MLPBlock(self.continue_layers, self.continue_dense_units, self.dense_act, False, self.dtype),
+                    _dense(1, jnp.float32),
+                ]
+            )
+
+    # ------------------------------------------------------------------ #
+    # entry points
+    # ------------------------------------------------------------------ #
+    def encode(self, obs: Dict[str, Array]) -> Array:
+        feats = []
+        if self.cnn_keys:
+            feats.append(self.cnn_encoder(obs))
+        if self.mlp_keys:
+            feats.append(self.mlp_encoder(obs))
+        out = feats[0] if len(feats) == 1 else jnp.concatenate(feats, axis=-1)
+        return out.astype(jnp.float32)
+
+    def decode(self, latent: Array) -> Dict[str, Array]:
+        out: Dict[str, Array] = {}
+        if self.cnn_keys:
+            out.update(self.cnn_decoder(latent.astype(self.dtype)))
+        if self.mlp_keys:
+            out.update(self.mlp_decoder(latent.astype(self.dtype)))
+        return out
+
+    def reward_mean(self, latent: Array) -> Array:
+        return self.reward_model(latent.astype(self.dtype))
+
+    def continue_logits(self, latent: Array) -> Array:
+        return self.continue_model(latent.astype(self.dtype))
+
+    def _stoch(self, out: Array, key: Array) -> Tuple[Array, Array, Array]:
+        """(mean, std, rsample) of the Gaussian state (reference
+        compute_stochastic_state, dreamer_v1/utils.py:81-110)."""
+        mean, std = jnp.split(out, 2, axis=-1)
+        std = jax.nn.softplus(std) + self.min_std
+        z = mean + std * jax.random.normal(key, mean.shape, mean.dtype)
+        return mean, std, z
+
+    def dynamic(
+        self, z: Array, h: Array, action: Array, embedded: Array, key: Array
+    ) -> Tuple[Array, Array, Array, Array, Array, Array]:
+        """One posterior step (reference RSSM.dynamic, agent.py:99-137):
+        returns ``(h', posterior, post_mean, post_std, prior_mean,
+        prior_std)``."""
+        k_prior, k_post = jax.random.split(key)
+        h = self.recurrent_model(jnp.concatenate([z, action], axis=-1).astype(self.dtype), h)
+        prior_mean, prior_std, _ = self._stoch(self.transition_model(h.astype(self.dtype)), k_prior)
+        post_in = jnp.concatenate([h, embedded], axis=-1)
+        post_mean, post_std, z = self._stoch(self.representation_model(post_in.astype(self.dtype)), k_post)
+        return h, z, post_mean, post_std, prior_mean, prior_std
+
+    def imagination(self, z: Array, h: Array, action: Array, key: Array) -> Tuple[Array, Array]:
+        """One prior step in latent space (reference RSSM.imagination,
+        agent.py:174-196)."""
+        h = self.recurrent_model(jnp.concatenate([z, action], axis=-1).astype(self.dtype), h)
+        _, _, z = self._stoch(self.transition_model(h.astype(self.dtype)), key)
+        return z, h
+
+    def observe_step(self, z, h, action, obs, key):
+        """Policy-time posterior update (reference PlayerDV1.get_actions,
+        agent.py:303-330)."""
+        embedded = self.encode(obs)
+        h = self.recurrent_model(jnp.concatenate([z, action], axis=-1).astype(self.dtype), h)
+        post_in = jnp.concatenate([h, embedded], axis=-1)
+        _, _, z = self._stoch(self.representation_model(post_in.astype(self.dtype)), key)
+        return z, h
+
+
+def rssm_scan_dv1(
+    wm: WorldModelDV1,
+    params: Any,
+    embedded: Array,  # [T, B, E]
+    actions: Array,  # [T, B, A] (already shifted)
+    key: Array,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """The DV1 RSSM sequence as one ``lax.scan`` (replaces the reference's
+    Python loop, dreamer_v1.py:144-156). Returns time-major
+    ``(hs, posteriors, post_means, post_stds, prior_means, prior_stds)``."""
+    B = embedded.shape[1]
+    h = jnp.zeros((B, wm.recurrent_state_size), jnp.float32)
+    z = jnp.zeros((B, wm.stochastic_size), jnp.float32)
+
+    def step(carry, xs):
+        h, z, key = carry
+        emb_t, act_t = xs
+        key, sub = jax.random.split(key)
+        h, z, post_mean, post_std, prior_mean, prior_std = wm.apply(
+            params, z, h, act_t, emb_t, sub, method=WorldModelDV1.dynamic
+        )
+        return (h, z, key), (h, z, post_mean, post_std, prior_mean, prior_std)
+
+    _, outs = jax.lax.scan(step, (h, z, key), (embedded, actions))
+    return outs
+
+
+def build_agent(
+    fabric: Any,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Any] = None,
+    actor_state: Optional[Any] = None,
+    critic_state: Optional[Any] = None,
+) -> Tuple[WorldModelDV1, Any, ActorDV2, Any, CriticDV2, Any, PlayerDV2]:
+    """Construct modules + init/replicate params (reference build_agent,
+    agent.py:333-547). Returns ``(wm, wm_params, actor, actor_params,
+    critic, critic_params, player)`` — no target critic in V1."""
+    wm_cfg = cfg["algo"]["world_model"]
+    actor_cfg = cfg["algo"]["actor"]
+    cnn_keys = tuple(cfg["algo"]["cnn_keys"]["encoder"])
+    mlp_keys = tuple(cfg["algo"]["mlp_keys"]["encoder"])
+    compute_dtype = fabric.precision.compute_dtype
+    screen = int(cfg["env"]["screen_size"])
+
+    def _channels(k):
+        shape = obs_space[k].shape
+        return int(np.prod(shape[:-3]) * shape[-1]) if len(shape) >= 3 else 1
+
+    wm = WorldModelDV1(
+        cnn_keys=cnn_keys,
+        mlp_keys=mlp_keys,
+        cnn_output_channels=tuple(_channels(k) for k in cfg["algo"]["cnn_keys"]["decoder"]),
+        mlp_output_dims=tuple(int(obs_space[k].shape[0]) for k in cfg["algo"]["mlp_keys"]["decoder"]),
+        image_size=(screen, screen),
+        actions_dim=tuple(actions_dim),
+        stochastic_size=int(wm_cfg["stochastic_size"]),
+        min_std=float(wm_cfg["min_std"]),
+        recurrent_state_size=int(wm_cfg["recurrent_model"]["recurrent_state_size"]),
+        encoder_cnn_multiplier=int(wm_cfg["encoder"]["cnn_channels_multiplier"]),
+        encoder_mlp_layers=int(wm_cfg["encoder"]["mlp_layers"]),
+        encoder_dense_units=int(wm_cfg["encoder"]["dense_units"]),
+        decoder_cnn_multiplier=int(wm_cfg["observation_model"]["cnn_channels_multiplier"]),
+        decoder_mlp_layers=int(wm_cfg["observation_model"]["mlp_layers"]),
+        decoder_dense_units=int(wm_cfg["observation_model"]["dense_units"]),
+        representation_hidden_size=int(wm_cfg["representation_model"]["hidden_size"]),
+        transition_hidden_size=int(wm_cfg["transition_model"]["hidden_size"]),
+        reward_layers=int(wm_cfg["reward_model"]["mlp_layers"]),
+        reward_dense_units=int(wm_cfg["reward_model"]["dense_units"]),
+        use_continues=bool(wm_cfg["use_continues"]),
+        continue_layers=int(wm_cfg["discount_model"]["mlp_layers"]),
+        continue_dense_units=int(wm_cfg["discount_model"]["dense_units"]),
+        dense_act=str(cfg["algo"]["dense_act"]),
+        cnn_act=str(cfg["algo"]["cnn_act"]),
+        dtype=compute_dtype,
+    )
+
+    actor = ActorDV2(
+        latent_state_size=wm.latent_state_size,
+        actions_dim=tuple(actions_dim),
+        is_continuous=bool(is_continuous),
+        distribution=str(cfg.get("distribution", {}).get("type", "auto")),
+        init_std=float(actor_cfg["init_std"]),
+        min_std=float(actor_cfg["min_std"]),
+        dense_units=int(actor_cfg["dense_units"]),
+        mlp_layers=int(actor_cfg["mlp_layers"]),
+        act=str(actor_cfg["dense_act"]),
+        use_layer_norm=False,
+        expl_amount=float(actor_cfg.get("expl_amount", 0.0) or 0.0),
+        expl_decay=float(actor_cfg.get("expl_decay", 0.0) or 0.0),
+        expl_min=float(actor_cfg.get("expl_min", 0.0) or 0.0),
+        dtype=compute_dtype,
+    )
+    critic_cfg = cfg["algo"]["critic"]
+    critic = CriticDV2(
+        mlp_layers=int(critic_cfg["mlp_layers"]),
+        dense_units=int(critic_cfg["dense_units"]),
+        act=str(critic_cfg["dense_act"]),
+        use_layer_norm=False,
+        dtype=compute_dtype,
+    )
+
+    key = jax.random.PRNGKey(int(cfg["seed"]))
+    k_wm, k_actor, k_critic, k_dyn = jax.random.split(key, 4)
+
+    B = 1
+    dummy_obs = {}
+    for k in cnn_keys:
+        shape = obs_space[k].shape
+        if len(shape) == 4:
+            s, hh, ww, c = shape
+            shape = (hh, ww, s * c)
+        dummy_obs[k] = jnp.zeros((B, *shape), jnp.uint8)
+    for k in mlp_keys:
+        dummy_obs[k] = jnp.zeros((B, *obs_space[k].shape), jnp.float32)
+
+    if world_model_state is not None:
+        wm_params = jax.tree.map(jnp.asarray, world_model_state)
+    else:
+
+        def wm_init(mod: WorldModelDV1):
+            emb = mod.encode(dummy_obs)
+            h = jnp.zeros((B, wm.recurrent_state_size), jnp.float32)
+            z = jnp.zeros((B, wm.stochastic_size), jnp.float32)
+            a = jnp.zeros((B, int(np.sum(actions_dim))), jnp.float32)
+            h, z, *_ = mod.dynamic(z, h, a, emb, k_dyn)
+            latent = jnp.concatenate([z, h], axis=-1)
+            mod.decode(latent)
+            mod.reward_mean(latent)
+            if mod.use_continues:
+                mod.continue_logits(latent)
+            return ()
+
+        wm_params = nn.init(wm_init, wm)(k_wm)
+
+    latent = jnp.zeros((B, wm.latent_state_size), jnp.float32)
+    actor_params = (
+        jax.tree.map(jnp.asarray, actor_state) if actor_state is not None else actor.init(k_actor, latent)
+    )
+    critic_params = (
+        jax.tree.map(jnp.asarray, critic_state) if critic_state is not None else critic.init(k_critic, latent)
+    )
+
+    wm_params = fabric.replicate(wm_params)
+    actor_params = fabric.replicate(actor_params)
+    critic_params = fabric.replicate(critic_params)
+
+    player = PlayerDV2(
+        wm, wm_params, actor, actor_params, actions_dim, int(cfg["env"]["num_envs"]), int(cfg["seed"])
+    )
+    return wm, wm_params, actor, actor_params, critic, critic_params, player

@@ -1,0 +1,52 @@
+"""Dreamer-V1 world-model loss (reference: sheeprl/algos/dreamer_v1/loss.py:42-95).
+
+KL(Normal(post) || Normal(prior)) with a free-nats floor — no KL balancing
+(that arrives in V2). The continue term uses the standard negative log
+likelihood (the reference adds ``+log_prob`` at loss.py:92-94, which only
+matters when ``use_continues=True`` — off by default in its configs)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.ops.distributions import Independent, Normal, kl_divergence
+
+Array = jax.Array
+
+
+def reconstruction_loss(
+    qo: Dict[str, object],
+    observations: Dict[str, Array],
+    qr: object,
+    rewards: Array,
+    post_mean: Array,
+    post_std: Array,
+    prior_mean: Array,
+    prior_std: Array,
+    kl_free_nats: float = 3.0,
+    kl_regularizer: float = 1.0,
+    qc: Optional[object] = None,
+    continue_targets: Optional[Array] = None,
+    continue_scale_factor: float = 10.0,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Eq. 10 of the Dreamer paper: observation + reward (+ continue) NLL
+    plus ``max(KL(post || prior), free_nats)``.
+
+    Returns ``(loss, kl, state_loss, reward_loss, observation_loss,
+    continue_loss)`` — same order as the reference."""
+    observation_loss = -sum(qo[k].log_prob(observations[k]).mean() for k in qo.keys())
+    reward_loss = -qr.log_prob(rewards).mean()
+    kl = kl_divergence(
+        Independent(Normal(post_mean, post_std), 1),
+        Independent(Normal(prior_mean, prior_std), 1),
+    ).mean()
+    state_loss = jnp.maximum(kl, jnp.asarray(kl_free_nats, kl.dtype))
+    if qc is not None and continue_targets is not None:
+        continue_loss = continue_scale_factor * -qc.log_prob(continue_targets).mean()
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    total = kl_regularizer * state_loss + observation_loss + reward_loss + continue_loss
+    return total, kl, state_loss, reward_loss, observation_loss, continue_loss

@@ -1,0 +1,40 @@
+"""Dreamer-V1 helpers (reference: sheeprl/algos/dreamer_v1/utils.py).
+
+``compute_lambda_values`` (the V1 recurrence, H-1 targets from an H-step
+rollout) lives in ``sheeprl_tpu.ops.math.compute_lambda_values_dv1``; the
+Gaussian stochastic-state helper is ``WorldModelDV1._stoch``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test as _dv3_test
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "State/kl",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+    "Params/exploration_amount",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic"}
+
+__all__ = ["AGGREGATOR_KEYS", "MODELS_TO_REGISTER", "prepare_obs", "test"]
+
+
+def test(player: Any, fabric: Any, cfg: Dict[str, Any], log_dir: str, test_name: str = "", greedy: bool = True) -> None:
+    """Frozen-policy evaluation episode (reference dv2/utils.py:122-168 is
+    shared by V1 too) — the player API matches Dreamer-V3's."""
+    _dv3_test(player, fabric, cfg, log_dir, test_name=test_name, greedy=greedy)

@@ -619,7 +619,10 @@ class PlayerDV2:
 
         def _step(wm_params, actor_params, obs, h, z, prev_action, key, greedy):
             k1, k2 = jax.random.split(key)
-            z, h = wm.apply(wm_params, z, h, prev_action, obs, k1, method=WorldModelDV2.observe_step)
+            # method-by-name so the same player drives any world model with an
+            # ``observe_step`` entry point (DV1 reuses this class, mirroring
+            # the reference's Actor aliasing in dreamer_v1/agent.py:28-29)
+            z, h = wm.apply(wm_params, z, h, prev_action, obs, k1, method="observe_step")
             latent = jnp.concatenate([z, h], axis=-1)
             action = sample_actor_actions(actor, actor_params, latent, k2, greedy)
             return action, h, z

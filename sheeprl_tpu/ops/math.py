@@ -153,6 +153,33 @@ def compute_lambda_values_bootstrap(
     return lambda_values
 
 
+def compute_lambda_values_dv1(
+    rewards: Array,
+    values: Array,
+    continues: Array,
+    lmbda: float = 0.95,
+) -> Array:
+    """Dreamer-V1 lambda targets (reference algos/dreamer_v1/utils.py:42-78):
+    over an ``H``-step imagined rollout, produce ``H - 1`` targets
+    ``R_t = r_t + c_t * (1 - lambda) * v_{t+1} + lambda * c_t * R_{t+1}``
+    where the final step bootstraps with the *full* (un-discounted-by-lambda)
+    last value ``R_{H-2} = r_{H-2} + c_{H-2} * v_{H-1}``, as a reverse
+    ``lax.scan``. Inputs are ``[H, ...]`` time-major; output is ``[H-1, ...]``."""
+    next_values = values[1:] * (1 - lmbda)
+    next_values = next_values.at[-1].set(values[-1])
+    interm = rewards[:-1] + continues[:-1] * next_values
+
+    def step(carry, xs):
+        inte, cont = xs
+        ret = inte + cont * lmbda * carry
+        return ret, ret
+
+    _, lambda_values = lax.scan(
+        step, jnp.zeros_like(values[-1]), (interm, continues[:-1]), reverse=True
+    )
+    return lambda_values
+
+
 def normalize(x: Array, eps: float = 1e-8, mask: Optional[Array] = None) -> Array:
     """Standardize ``x`` with optional boolean mask (reference
     utils/utils.py:120-130). Shape-preserving (masked positions are normalized

@@ -1,0 +1,84 @@
+"""Dreamer-V1 smoke tests (reference: tests/test_algos/test_algos.py::test_dreamer_v1).
+
+One CLI-driven update with tiny nets on dummy envs, covering the continue
+model, the MLP-only path, and the checkpoint -> resume -> evaluate round
+trip.
+"""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.cli import run
+
+
+def dv1_args(tmp_path, env_id="dummy_discrete"):
+    return [
+        "exp=dreamer_v1",
+        "env=dummy",
+        f"env.id={env_id}",
+        "dry_run=True",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "algo.per_rank_batch_size=1",
+        "algo.per_rank_sequence_length=2",
+        "buffer.size=10",
+        "algo.learning_starts=0",
+        "algo.replay_ratio=1",
+        "algo.per_rank_pretrain_steps=1",
+        "algo.horizon=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.stochastic_size=4",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.mlp_keys.encoder=[state]",
+        "env.num_envs=2",
+        "algo.run_test=True",
+        "checkpoint.save_last=True",
+        "metric.log_level=1",
+        f"log_base_dir={tmp_path}/logs",
+    ]
+
+
+def find_checkpoints(tmp_path):
+    ckpts = []
+    for root, _, files in os.walk(tmp_path):
+        ckpts += [os.path.join(root, f) for f in files if f.endswith(".ckpt")]
+    return ckpts
+
+
+@pytest.mark.parametrize("env_id", ["dummy_discrete", "dummy_multidiscrete", "dummy_continuous"])
+def test_dreamer_v1_dummy(tmp_path, monkeypatch, env_id):
+    monkeypatch.chdir(tmp_path)
+    run(dv1_args(tmp_path, env_id))
+    assert find_checkpoints(tmp_path)
+
+
+def test_dreamer_v1_use_continues(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(dv1_args(tmp_path) + ["algo.world_model.use_continues=True"])
+
+
+def test_dreamer_v1_mlp_only(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(dv1_args(tmp_path) + ["algo.cnn_keys.encoder=[]", "algo.mlp_keys.encoder=[state]"])
+
+
+def test_dreamer_v1_resume(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(dv1_args(tmp_path))
+    (ckpt,) = find_checkpoints(tmp_path)
+    run(dv1_args(tmp_path) + [f"checkpoint.resume_from={ckpt}"])
+
+
+def test_dreamer_v1_evaluate_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(dv1_args(tmp_path))
+    (ckpt,) = find_checkpoints(tmp_path)
+    from sheeprl_tpu.cli import evaluation
+
+    evaluation([f"checkpoint_path={ckpt}"])

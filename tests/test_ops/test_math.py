@@ -140,6 +140,30 @@ def test_lambda_values_match_reference():
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
 
 
+def test_lambda_values_dv1_match_reference():
+    """Against the reference python loop (dreamer_v1/utils.py:42-78)."""
+    from sheeprl_tpu.ops.math import compute_lambda_values_dv1
+
+    rng = np.random.default_rng(4)
+    H, N = 15, 6
+    lmbda = 0.95
+    rewards = rng.normal(size=(H, N, 1)).astype(np.float32)
+    values = rng.normal(size=(H, N, 1)).astype(np.float32)
+    continues = (rng.random((H, N, 1)) < 0.9).astype(np.float32) * 0.99
+
+    last_lambda = 0.0
+    out = []
+    for step in reversed(range(H - 1)):
+        next_values = values[-1] if step == H - 2 else values[step + 1] * (1 - lmbda)
+        delta = rewards[step] + next_values * continues[step]
+        last_lambda = delta + lmbda * continues[step] * last_lambda
+        out.append(last_lambda)
+    expected = np.stack(list(reversed(out)))
+
+    got = jax.jit(compute_lambda_values_dv1)(rewards, values, continues, lmbda)
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
 # ---- normalize ----
 
 
