@@ -1,0 +1,1 @@
+from sheeprl_tpu.algos.p2e_dv1 import p2e_dv1_exploration, p2e_dv1_finetuning, evaluate  # noqa: F401

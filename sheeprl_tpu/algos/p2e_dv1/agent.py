@@ -1,0 +1,143 @@
+"""Plan2Explore on Dreamer-V1 — agent builders (reference:
+sheeprl/algos/p2e_dv1/agent.py:27-155).
+
+The ensemble is ONE vmapped param tree (N stacked member MLP trees) predicting
+the next *embedded observation* from (z, h, action) (reference
+agent.py:125-140 — V1 measures disagreement in embedding space, unlike
+V2/V3's posterior space). One exploration critic (Normal head, no target)
+plus an exploration actor sharing the DV2-style Actor module.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v1.agent import (
+    ActorDV1,
+    CriticDV1,
+    PlayerDV1,
+    WorldModelDV1,
+    build_agent as dv1_build_agent,
+)
+from sheeprl_tpu.algos.dreamer_v2.agent import _dense, _MLPBlock
+
+Array = jax.Array
+
+
+class EnsembleDV1(nn.Module):
+    """One ensemble member: MLP from (z, h, action) to the embedding size
+    (reference agent.py:125-140)."""
+
+    output_dim: int
+    mlp_layers: int = 4
+    dense_units: int = 400
+    act: str = "elu"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        x = _MLPBlock(self.mlp_layers, self.dense_units, self.act, False, self.dtype)(x.astype(self.dtype))
+        return _dense(self.output_dim, jnp.float32)(x)
+
+
+def ensemble_apply(ens: nn.Module, stacked_params: Any, x: Array) -> Array:
+    return jax.vmap(lambda p: ens.apply(p, x))(stacked_params)
+
+
+def init_ensembles(ens: nn.Module, n: int, key: Array, dummy_in: Array) -> Any:
+    keys = jax.random.split(key, n)
+    return jax.vmap(lambda k: ens.init(k, dummy_in))(keys)
+
+
+def embedding_dim(wm: WorldModelDV1) -> int:
+    """Encoder output width (reference world_model.encoder.cnn_output_dim +
+    mlp_output_dim, agent.py:136)."""
+    dim = 0
+    if wm.cnn_keys:
+        dim += wm.cnn_encoder_output_dim
+    if wm.mlp_keys:
+        dim += wm.encoder_dense_units
+    return dim
+
+
+def build_agent(
+    fabric: Any,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Any] = None,
+    ensembles_state: Optional[Any] = None,
+    actor_task_state: Optional[Any] = None,
+    critic_task_state: Optional[Any] = None,
+    actor_exploration_state: Optional[Any] = None,
+    critic_exploration_state: Optional[Any] = None,
+) -> Tuple[WorldModelDV1, Any, ActorDV1, Any, CriticDV1, Any, Any, Any, Any, Any, PlayerDV1]:
+    """Returns ``(wm, wm_params, actor, actor_task_params, critic,
+    critic_task_params, actor_exploration_params, critic_exploration_params,
+    ensemble, ensembles_params, player)``."""
+    wm, wm_params, actor, actor_task_params, critic, critic_task_params, player = dv1_build_agent(
+        fabric,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        world_model_state,
+        actor_task_state,
+        critic_task_state,
+    )
+
+    key = jax.random.PRNGKey(int(cfg["seed"]) + 1)
+    k_actor, k_ens, k_crit = jax.random.split(key, 3)
+    latent = jnp.zeros((1, wm.latent_state_size), jnp.float32)
+
+    actor_exploration_params = (
+        jax.tree.map(jnp.asarray, actor_exploration_state)
+        if actor_exploration_state is not None
+        else actor.init(k_actor, latent)
+    )
+    critic_exploration_params = (
+        jax.tree.map(jnp.asarray, critic_exploration_state)
+        if critic_exploration_state is not None
+        else critic.init(k_crit, latent)
+    )
+    actor_exploration_params = fabric.replicate(actor_exploration_params)
+    critic_exploration_params = fabric.replicate(critic_exploration_params)
+
+    ens_cfg = cfg["algo"]["ensembles"]
+    ensemble = EnsembleDV1(
+        output_dim=embedding_dim(wm),
+        mlp_layers=int(ens_cfg["mlp_layers"]),
+        dense_units=int(ens_cfg["dense_units"]),
+        act=str(ens_cfg.get("dense_act", "elu")),
+        dtype=fabric.precision.compute_dtype,
+    )
+    dummy_in = jnp.zeros((1, wm.latent_state_size + int(np.sum(actions_dim))), jnp.float32)
+    if ensembles_state is not None:
+        ensembles_params = jax.tree.map(jnp.asarray, ensembles_state)
+    else:
+        ensembles_params = init_ensembles(ensemble, int(ens_cfg["n"]), k_ens, dummy_in)
+    ensembles_params = fabric.replicate(ensembles_params)
+
+    if str(cfg["algo"]["player"].get("actor_type", "task")) == "exploration":
+        player.actor_params = actor_exploration_params
+
+    return (
+        wm,
+        wm_params,
+        actor,
+        actor_task_params,
+        critic,
+        critic_task_params,
+        actor_exploration_params,
+        critic_exploration_params,
+        ensemble,
+        ensembles_params,
+        player,
+    )

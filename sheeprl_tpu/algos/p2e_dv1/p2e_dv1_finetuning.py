@@ -1,0 +1,427 @@
+"""Plan2Explore on Dreamer-V1 — finetuning phase (reference:
+sheeprl/algos/p2e_dv1/p2e_dv1_finetuning.py:28-439) — TPU-native.
+
+Loads the exploration checkpoint and runs the plain fused Dreamer-V1 train
+step on the task models; the player acts with the EXPLORATION actor (with
+exploration noise) until the first gradient step, then switches to the task
+actor (reference :260, :328-331)."""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v1.dreamer_v1 import METRIC_ORDER, make_train_fn
+from sheeprl_tpu.algos.p2e_dv1.agent import build_agent
+from sheeprl_tpu.algos.p2e_dv1.utils import prepare_obs, test
+from sheeprl_tpu.config.compose import instantiate
+from sheeprl_tpu.data import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.envs.wrappers import RestartOnException
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+FINETUNING_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Params/exploration_amount",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
+    resume_from_checkpoint = bool(cfg.checkpoint.resume_from)
+    if resume_from_checkpoint:
+        state = fabric.load(cfg.checkpoint.resume_from)
+    else:
+        state = fabric.load(cfg.checkpoint.exploration_ckpt_path)
+
+    # model hyperparameters must match the exploration phase (reference :50-71)
+    for k in (
+        "gamma",
+        "lmbda",
+        "horizon",
+        "dense_units",
+        "mlp_layers",
+        "dense_act",
+        "cnn_act",
+        "world_model",
+        "actor",
+        "critic",
+        "cnn_keys",
+        "mlp_keys",
+    ):
+        if k in exploration_cfg.algo:
+            cfg.algo[k] = exploration_cfg.algo[k]
+    cfg.env.clip_rewards = exploration_cfg.env.clip_rewards
+    if cfg.buffer.get("load_from_exploration") and exploration_cfg.buffer.checkpoint:
+        cfg.env.num_envs = exploration_cfg.env.num_envs
+    cfg.env.screen_size = 64
+    cfg.env.frame_stack = 1
+
+    log_dir = get_log_dir(cfg)
+    logger = get_logger(cfg, log_dir)
+    fabric.logger = logger
+    logger.log_hyperparams(cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg))
+    print(f"Log dir: {log_dir}")
+
+    rank = fabric.process_index
+    num_envs = int(cfg.env.num_envs)
+    world_size = fabric.world_size
+    num_processes = fabric.num_processes
+
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            partial(
+                RestartOnException,
+                make_env(
+                    cfg,
+                    cfg.seed + rank * num_envs + i,
+                    rank * num_envs,
+                    log_dir if rank == 0 else None,
+                    "train",
+                    vector_env_idx=i,
+                ),
+            )
+            for i in range(num_envs)
+        ],
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape if is_continuous else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+
+    (
+        wm,
+        wm_params,
+        actor,
+        actor_task_params,
+        critic,
+        critic_task_params,
+        actor_expl_params,
+        _critic_expl_params,
+        _ensemble,
+        _ensembles_params,
+        player,
+    ) = build_agent(
+        fabric,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["world_model"],
+        None,
+        state["actor_task"],
+        state["critic_task"],
+        state["actor_exploration"],
+        None,
+    )
+
+    def build_tx(opt_cfg, clip):
+        opt_cfg = dict(opt_cfg.to_dict() if hasattr(opt_cfg, "to_dict") else opt_cfg)
+        if clip and float(clip) > 0:
+            opt_cfg["max_grad_norm"] = float(clip)
+        return instantiate(opt_cfg)
+
+    world_tx = build_tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
+    actor_tx = build_tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
+    critic_tx = build_tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    world_opt = fabric.replicate(jax.tree.map(jnp.asarray, state["world_optimizer"]))
+    actor_opt = fabric.replicate(jax.tree.map(jnp.asarray, state["actor_task_optimizer"]))
+    critic_opt = fabric.replicate(jax.tree.map(jnp.asarray, state["critic_task_optimizer"]))
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = MetricAggregator(cfg.metric.get("aggregator", {}).get("metrics", {}) or {})
+    for k in FINETUNING_KEYS - set(aggregator.metrics):
+        aggregator.add(k, "mean")
+
+    buffer_size = cfg.buffer.size // int(num_envs * num_processes) if not cfg.dry_run else 4
+    rb = EnvIndependentReplayBuffer(
+        buffer_size,
+        n_envs=num_envs,
+        obs_keys=obs_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        buffer_cls=SequentialReplayBuffer,
+        seed=cfg.seed,
+    )
+    if (resume_from_checkpoint and cfg.buffer.checkpoint) or (
+        cfg.buffer.get("load_from_exploration") and exploration_cfg.buffer.checkpoint
+    ):
+        rb = state["rb"]
+
+    train_fn = make_train_fn(
+        fabric, wm, actor, critic, world_tx, actor_tx, critic_tx, cfg, is_continuous, actions_dim
+    )
+
+    train_step = 0
+    last_train = 0
+    start_step = state["update"] + 1 if resume_from_checkpoint else 1
+    policy_step = state["update"] * num_envs * num_processes if resume_from_checkpoint else 0
+    last_log = state["last_log"] if resume_from_checkpoint else 0
+    last_checkpoint = state["last_checkpoint"] if resume_from_checkpoint else 0
+    policy_steps_per_update = int(num_envs * num_processes)
+    num_updates = int(cfg.algo.total_steps // policy_steps_per_update) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_update if not cfg.dry_run else 0
+    per_rank_batch_size = int(cfg.algo.per_rank_batch_size)
+    sequence_length = int(cfg.algo.per_rank_sequence_length)
+    if resume_from_checkpoint:
+        per_rank_batch_size = state["batch_size"] // world_size
+        if not cfg.buffer.checkpoint:
+            learning_starts += start_step
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if resume_from_checkpoint:
+        ratio.load_state_dict(state["ratio"])
+
+    key = jax.random.PRNGKey(int(cfg.seed))
+    if resume_from_checkpoint and "rng_key" in state:
+        key = jnp.asarray(state["rng_key"])
+
+    step_data: Dict[str, np.ndarray] = {}
+    obs, _ = envs.reset(seed=cfg.seed)
+    prepared = prepare_obs(obs, cnn_keys=cnn_keys, num_envs=num_envs)
+    for k in obs_keys:
+        step_data[k] = prepared[k][np.newaxis]
+    step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
+    step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
+    step_data["actions"] = np.zeros((1, num_envs, int(np.sum(actions_dim))), np.float32)
+    step_data["rewards"] = np.zeros((1, num_envs, 1), np.float32)
+    step_data["is_first"] = np.ones_like(step_data["terminated"])
+    rb.add(step_data, validate_args=cfg.buffer.validate_args)
+    player.init_states()
+
+    # explore with the exploration actor (+noise) until the first gradient
+    # step (reference :260, :328-331)
+    player_actor_type = "exploration"
+    player.actor_params = actor_expl_params
+
+    cumulative_per_rank_gradient_steps = 0
+    for update in range(start_step, num_updates + 1):
+        policy_step += num_envs * num_processes
+
+        with timer("Time/env_interaction_time"):
+            key, action_key = jax.random.split(key)
+            prepared = prepare_obs(obs, cnn_keys=cnn_keys, num_envs=num_envs)
+            actions = player.get_actions(
+                prepared, action_key, expl_step=policy_step, with_exploration=True
+            )
+            if is_continuous:
+                real_actions = actions
+            else:
+                splits = np.cumsum(actions_dim)[:-1]
+                real_actions = np.stack(
+                    [p.argmax(-1) for p in np.split(actions, splits, axis=-1)], axis=-1
+                )
+                if real_actions.shape[-1] == 1 and not is_multidiscrete:
+                    real_actions = real_actions[..., 0]
+
+            step_data["is_first"] = np.logical_or(
+                step_data["terminated"], step_data["truncated"]
+            ).astype(np.float32)
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                real_actions.reshape(envs.action_space.shape)
+            )
+            dones = np.logical_or(terminated, truncated).astype(np.uint8)
+
+        if "restart_on_exception" in infos:
+            for i, roe in enumerate(np.asarray(infos["restart_on_exception"]).reshape(-1)):
+                if roe and not dones[i]:
+                    step_data["is_first"][0, i] = 1.0
+
+        if cfg.metric.log_level > 0 and "final_info" in infos:
+            ep = infos["final_info"].get("episode")
+            if ep is not None:
+                for i in np.nonzero(ep.get("_r", []))[0]:
+                    aggregator.update("Rewards/rew_avg", float(ep["r"][i]))
+                    aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
+                    print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep['r'][i]}")
+
+        real_next_obs = {k: np.asarray(v).copy() for k, v in next_obs.items()}
+        if "final_obs" in infos:
+            for idx, final_obs in enumerate(infos["final_obs"]):
+                if final_obs is not None:
+                    for k, v in final_obs.items():
+                        real_next_obs[k][idx] = v
+
+        prepared_next = prepare_obs(real_next_obs, cnn_keys=cnn_keys, num_envs=num_envs)
+        for k in obs_keys:
+            step_data[k] = prepared_next[k][np.newaxis]
+        obs = next_obs
+
+        step_data["terminated"] = np.asarray(terminated, np.float32).reshape(1, num_envs, 1)
+        step_data["truncated"] = np.asarray(truncated, np.float32).reshape(1, num_envs, 1)
+        step_data["actions"] = np.asarray(actions, np.float32).reshape(1, num_envs, -1)
+        step_data["rewards"] = clip_rewards_fn(np.asarray(rewards, np.float32).reshape(1, num_envs, 1))
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+        dones_idxes = dones.nonzero()[0].tolist()
+        if dones_idxes:
+            prepared_reset = prepare_obs(
+                {k: np.asarray(next_obs[k])[dones_idxes] for k in obs_keys},
+                cnn_keys=cnn_keys,
+                num_envs=len(dones_idxes),
+            )
+            reset_data = {k: prepared_reset[k][np.newaxis] for k in obs_keys}
+            reset_data["terminated"] = np.zeros((1, len(dones_idxes), 1), np.float32)
+            reset_data["truncated"] = np.zeros((1, len(dones_idxes), 1), np.float32)
+            reset_data["actions"] = np.zeros((1, len(dones_idxes), int(np.sum(actions_dim))), np.float32)
+            reset_data["rewards"] = np.zeros((1, len(dones_idxes), 1), np.float32)
+            reset_data["is_first"] = np.ones_like(reset_data["terminated"])
+            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            step_data["terminated"][0, dones_idxes] = 0.0
+            step_data["truncated"][0, dones_idxes] = 0.0
+            player.init_states(dones_idxes)
+
+        # ---------------- training ---------------- #
+        if update >= learning_starts:
+            per_rank_gradient_steps = ratio(policy_step / num_processes)
+            if per_rank_gradient_steps > 0:
+                if player_actor_type != "task":
+                    player_actor_type = "task"
+                    player.actor_params = actor_task_params
+                local_data = rb.sample(
+                    per_rank_batch_size * fabric.local_device_count,
+                    sequence_length=sequence_length,
+                    n_samples=per_rank_gradient_steps,
+                )
+                with timer("Time/train_time"):
+                    for i in range(per_rank_gradient_steps):
+                        batch = {
+                            k: (v[i] if k in cnn_keys else v[i].astype(np.float32))
+                            for k, v in local_data.items()
+                        }
+                        if num_processes > 1:
+                            batch = fabric.make_global(batch, (None, fabric.data_axis))
+                        key, train_key = jax.random.split(key)
+                        (
+                            wm_params,
+                            actor_task_params,
+                            critic_task_params,
+                            world_opt,
+                            actor_opt,
+                            critic_opt,
+                            metrics,
+                        ) = train_fn(
+                            wm_params,
+                            actor_task_params,
+                            critic_task_params,
+                            world_opt,
+                            actor_opt,
+                            critic_opt,
+                            batch,
+                            train_key,
+                        )
+                        cumulative_per_rank_gradient_steps += 1
+                    metrics = np.asarray(jax.device_get(metrics))
+                    train_step += num_processes
+                player.wm_params = wm_params
+                player.actor_params = actor_task_params
+                if cfg.metric.log_level > 0:
+                    for name, value in zip(METRIC_ORDER, metrics):
+                        aggregator.update(name, float(value))
+                    aggregator.update(
+                        "Params/exploration_amount", float(actor.get_expl_amount(policy_step))
+                    )
+
+        # ---------------- logging ---------------- #
+        if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or update == num_updates):
+            metrics_dict = aggregator.compute()
+            logger.log_metrics(metrics_dict, policy_step)
+            aggregator.reset()
+            if policy_step > 0:
+                logger.log_metrics(
+                    {"Params/replay_ratio": cumulative_per_rank_gradient_steps * num_processes / policy_step},
+                    policy_step,
+                )
+            if not timer.disabled:
+                timer_metrics = timer.compute()
+                if timer_metrics.get("Time/train_time"):
+                    logger.log_metrics(
+                        {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                        policy_step,
+                    )
+                if timer_metrics.get("Time/env_interaction_time"):
+                    logger.log_metrics(
+                        {
+                            "Time/sps_env_interaction": (
+                                (policy_step - last_log) / num_processes * cfg.env.action_repeat
+                            )
+                            / timer_metrics["Time/env_interaction_time"]
+                        },
+                        policy_step,
+                    )
+                timer.reset()
+            last_log = policy_step
+            last_train = train_step
+
+        # ---------------- checkpoint ---------------- #
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            update == num_updates and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "world_model": jax.device_get(wm_params),
+                "actor_task": jax.device_get(actor_task_params),
+                "critic_task": jax.device_get(critic_task_params),
+                "actor_exploration": jax.device_get(actor_expl_params),
+                "world_optimizer": jax.device_get(world_opt),
+                "actor_task_optimizer": jax.device_get(actor_opt),
+                "critic_task_optimizer": jax.device_get(critic_opt),
+                "ratio": ratio.state_dict(),
+                "update": update,
+                "batch_size": per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+                "rng_key": jax.device_get(key),
+            }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
+            fabric.call(
+                "on_checkpoint_coupled",
+                ckpt_path=ckpt_path,
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        player.actor_params = actor_task_params
+        test(player, fabric, cfg, log_dir, "few-shot", greedy=False)
+    logger.finalize()

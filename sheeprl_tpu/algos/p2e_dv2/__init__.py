@@ -1,0 +1,1 @@
+from sheeprl_tpu.algos.p2e_dv2 import p2e_dv2_exploration, p2e_dv2_finetuning, evaluate  # noqa: F401

@@ -1,0 +1,158 @@
+"""Plan2Explore on Dreamer-V2 — agent builders (reference:
+sheeprl/algos/p2e_dv2/agent.py:27-230).
+
+The ensemble is ONE vmapped param tree predicting the next flattened
+discrete posterior from (z, h, action) (reference agent.py:155-170). One
+exploration critic WITH an EMA/hard-copy target (reference agent.py:120-150)
+plus an exploration actor sharing the DV2 Actor module."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v2.agent import (
+    ActorDV2,
+    CriticDV2,
+    PlayerDV2,
+    WorldModelDV2,
+    _dense,
+    _MLPBlock,
+    build_agent as dv2_build_agent,
+)
+
+Array = jax.Array
+
+
+class EnsembleDV2(nn.Module):
+    """One ensemble member: MLP from (z, h, action) to the flattened
+    stochastic state (reference agent.py:155-170)."""
+
+    output_dim: int
+    mlp_layers: int = 4
+    dense_units: int = 400
+    act: str = "elu"
+    use_layer_norm: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        x = _MLPBlock(self.mlp_layers, self.dense_units, self.act, self.use_layer_norm, self.dtype)(
+            x.astype(self.dtype)
+        )
+        return _dense(self.output_dim, jnp.float32)(x)
+
+
+def ensemble_apply(ens: nn.Module, stacked_params: Any, x: Array) -> Array:
+    return jax.vmap(lambda p: ens.apply(p, x))(stacked_params)
+
+
+def init_ensembles(ens: nn.Module, n: int, key: Array, dummy_in: Array) -> Any:
+    keys = jax.random.split(key, n)
+    return jax.vmap(lambda k: ens.init(k, dummy_in))(keys)
+
+
+def build_agent(
+    fabric: Any,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Any] = None,
+    ensembles_state: Optional[Any] = None,
+    actor_task_state: Optional[Any] = None,
+    critic_task_state: Optional[Any] = None,
+    target_critic_task_state: Optional[Any] = None,
+    actor_exploration_state: Optional[Any] = None,
+    critic_exploration_state: Optional[Any] = None,
+    target_critic_exploration_state: Optional[Any] = None,
+) -> Tuple[
+    WorldModelDV2, Any, ActorDV2, Any, CriticDV2, Any, Any, Any, Any, Any, Any, Any, PlayerDV2
+]:
+    """Returns ``(wm, wm_params, actor, actor_task_params, critic,
+    critic_task_params, target_critic_task_params, actor_exploration_params,
+    critic_exploration_params, target_critic_exploration_params, ensemble,
+    ensembles_params, player)``."""
+    (
+        wm,
+        wm_params,
+        actor,
+        actor_task_params,
+        critic,
+        critic_task_params,
+        target_critic_task_params,
+        player,
+    ) = dv2_build_agent(
+        fabric,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        world_model_state,
+        actor_task_state,
+        critic_task_state,
+        target_critic_task_state,
+    )
+
+    key = jax.random.PRNGKey(int(cfg["seed"]) + 1)
+    k_actor, k_ens, k_crit = jax.random.split(key, 3)
+    latent = jnp.zeros((1, wm.latent_state_size), jnp.float32)
+
+    actor_exploration_params = (
+        jax.tree.map(jnp.asarray, actor_exploration_state)
+        if actor_exploration_state is not None
+        else actor.init(k_actor, latent)
+    )
+    critic_exploration_params = (
+        jax.tree.map(jnp.asarray, critic_exploration_state)
+        if critic_exploration_state is not None
+        else critic.init(k_crit, latent)
+    )
+    target_critic_exploration_params = (
+        jax.tree.map(jnp.asarray, target_critic_exploration_state)
+        if target_critic_exploration_state is not None
+        else jax.tree.map(jnp.copy, critic_exploration_params)
+    )
+    actor_exploration_params = fabric.replicate(actor_exploration_params)
+    critic_exploration_params = fabric.replicate(critic_exploration_params)
+    target_critic_exploration_params = fabric.replicate(target_critic_exploration_params)
+
+    ens_cfg = cfg["algo"]["ensembles"]
+    ensemble = EnsembleDV2(
+        output_dim=wm.stoch_state_size,
+        mlp_layers=int(ens_cfg["mlp_layers"]),
+        dense_units=int(ens_cfg["dense_units"]),
+        act=str(ens_cfg.get("dense_act", "elu")),
+        use_layer_norm=bool(ens_cfg.get("layer_norm", False)),
+        dtype=fabric.precision.compute_dtype,
+    )
+    dummy_in = jnp.zeros((1, wm.latent_state_size + int(np.sum(actions_dim))), jnp.float32)
+    if ensembles_state is not None:
+        ensembles_params = jax.tree.map(jnp.asarray, ensembles_state)
+    else:
+        ensembles_params = init_ensembles(ensemble, int(ens_cfg["n"]), k_ens, dummy_in)
+    ensembles_params = fabric.replicate(ensembles_params)
+
+    if str(cfg["algo"]["player"].get("actor_type", "task")) == "exploration":
+        player.actor_params = actor_exploration_params
+
+    return (
+        wm,
+        wm_params,
+        actor,
+        actor_task_params,
+        critic,
+        critic_task_params,
+        target_critic_task_params,
+        actor_exploration_params,
+        critic_exploration_params,
+        target_critic_exploration_params,
+        ensemble,
+        ensembles_params,
+        player,
+    )

@@ -1,0 +1,1 @@
+from sheeprl_tpu.algos.p2e_dv3 import p2e_dv3_exploration, p2e_dv3_finetuning, evaluate  # noqa: F401

@@ -1,0 +1,188 @@
+"""Plan2Explore on Dreamer-V3 — agent builders (reference:
+sheeprl/algos/p2e_dv3/agent.py:27-223).
+
+TPU-first redesign of the exploration machinery:
+
+- the **ensemble is ONE vmapped param tree** (N stacked member trees) applied
+  with ``jax.vmap`` — replacing the reference's ``nn.ModuleList`` Python loop
+  (agent.py:175-204), the same pattern this repo uses for SAC critic
+  ensembles;
+- the exploration critics are a dict ``name -> {weight, reward_type, params,
+  target_params}`` sharing the task critic's two-hot module (reference
+  agent.py:118-153);
+- the exploration actor shares the task Actor module definition with its own
+  params; the player binds whichever actor ``cfg.algo.player.actor_type``
+  selects (reference agent.py:207-211).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v3.agent import (
+    Actor,
+    PlayerDV3,
+    WorldModel,
+    _dense,
+    _LNMLP,
+    hafner_init,
+    make_critic,
+)
+from sheeprl_tpu.algos.dreamer_v3.agent import build_agent as dv3_build_agent
+
+Array = jax.Array
+
+
+class Ensemble(nn.Module):
+    """One ensemble member: MLP from (latent, action) to the flattened
+    stochastic state (reference agent.py:181-198)."""
+
+    output_dim: int
+    mlp_layers: int = 5
+    dense_units: int = 1024
+    use_layer_norm: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        x = _LNMLP(self.mlp_layers, self.dense_units, self.dtype, use_layer_norm=self.use_layer_norm)(
+            x.astype(self.dtype)
+        )
+        return _dense(self.output_dim, jnp.float32, kernel_init=hafner_init)(x)
+
+
+def ensemble_apply(ens: Ensemble, stacked_params: Any, x: Array) -> Array:
+    """Apply all N members to the same input: ``[N, ..., output_dim]``."""
+    return jax.vmap(lambda p: ens.apply(p, x))(stacked_params)
+
+
+def init_ensembles(ens: Ensemble, n: int, key: Array, dummy_in: Array) -> Any:
+    """N independently-seeded member trees stacked on a leading axis
+    (reference agent.py:174-200 seeds each member with ``seed + i``)."""
+    keys = jax.random.split(key, n)
+    return jax.vmap(lambda k: ens.init(k, dummy_in))(keys)
+
+
+def build_agent(
+    fabric: Any,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Any] = None,
+    ensembles_state: Optional[Any] = None,
+    actor_task_state: Optional[Any] = None,
+    critic_task_state: Optional[Any] = None,
+    target_critic_task_state: Optional[Any] = None,
+    actor_exploration_state: Optional[Any] = None,
+    critics_exploration_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[
+    WorldModel,
+    Any,
+    Actor,
+    Any,
+    Any,
+    Any,
+    Any,
+    Any,
+    Dict[str, Dict[str, Any]],
+    Ensemble,
+    Any,
+    PlayerDV3,
+]:
+    """Build task models (via the DV3 builder) + exploration actor/critics +
+    vmapped ensembles (reference build_agent, agent.py:27-223). Returns
+    ``(wm, wm_params, actor, actor_task_params, critic, critic_task_params,
+    target_critic_task_params, actor_exploration_params,
+    critics_exploration, ensemble, ensembles_params, player)``."""
+    wm, wm_params, actor, actor_task_params, critic, critic_task_params, target_critic_task_params, player = (
+        dv3_build_agent(
+            fabric,
+            actions_dim,
+            is_continuous,
+            cfg,
+            obs_space,
+            world_model_state,
+            actor_task_state,
+            critic_task_state,
+            target_critic_task_state,
+        )
+    )
+
+    key = jax.random.PRNGKey(int(cfg["seed"]) + 1)
+    k_actor, k_ens, k_crit = jax.random.split(key, 3)
+    latent = jnp.zeros((1, wm.latent_state_size), jnp.float32)
+
+    actor_exploration_params = (
+        jax.tree.map(jnp.asarray, actor_exploration_state)
+        if actor_exploration_state is not None
+        else actor.init(k_actor, latent)
+    )
+    actor_exploration_params = fabric.replicate(actor_exploration_params)
+
+    # exploration critics: {name: {weight, reward_type, params, target_params}}
+    critics_exploration: Dict[str, Dict[str, Any]] = {}
+    intrinsic_critics = 0
+    crit_keys = jax.random.split(k_crit, max(1, len(cfg["algo"]["critics_exploration"])))
+    for i, (k, v) in enumerate(cfg["algo"]["critics_exploration"].items()):
+        if float(v["weight"]) <= 0:
+            continue
+        if str(v["reward_type"]) == "intrinsic":
+            intrinsic_critics += 1
+        if critics_exploration_state is not None:
+            params = jax.tree.map(jnp.asarray, critics_exploration_state[k]["module"])
+            target = jax.tree.map(jnp.asarray, critics_exploration_state[k]["target_module"])
+        else:
+            params = critic.init(crit_keys[i], latent)
+            target = jax.tree.map(jnp.copy, params)
+        critics_exploration[k] = {
+            "weight": float(v["weight"]),
+            "reward_type": str(v["reward_type"]),
+            "params": fabric.replicate(params),
+            "target_params": fabric.replicate(target),
+        }
+    if intrinsic_critics == 0:
+        raise RuntimeError("You must specify at least one intrinsic critic (`reward_type='intrinsic'`)")
+
+    # vmapped ensemble: predicts the next flattened stochastic state from
+    # (z, h, action)
+    ens_cfg = cfg["algo"]["ensembles"]
+    ensemble = Ensemble(
+        output_dim=wm.stoch_state_size,
+        mlp_layers=int(ens_cfg["mlp_layers"]),
+        dense_units=int(ens_cfg["dense_units"]),
+        use_layer_norm=bool(ens_cfg.get("layer_norm", True)),
+        dtype=fabric.precision.compute_dtype,
+    )
+    dummy_in = jnp.zeros((1, wm.latent_state_size + int(np.sum(actions_dim))), jnp.float32)
+    if ensembles_state is not None:
+        ensembles_params = jax.tree.map(jnp.asarray, ensembles_state)
+    else:
+        ensembles_params = init_ensembles(ensemble, int(ens_cfg["n"]), k_ens, dummy_in)
+    ensembles_params = fabric.replicate(ensembles_params)
+
+    # the player explores with the exploration actor during the exploration
+    # phase (reference agent.py:207-211)
+    if str(cfg["algo"]["player"].get("actor_type", "task")) == "exploration":
+        player.actor_params = actor_exploration_params
+
+    return (
+        wm,
+        wm_params,
+        actor,
+        actor_task_params,
+        critic,
+        critic_task_params,
+        target_critic_task_params,
+        actor_exploration_params,
+        critics_exploration,
+        ensemble,
+        ensembles_params,
+        player,
+    )

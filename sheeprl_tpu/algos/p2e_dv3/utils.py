@@ -1,0 +1,70 @@
+"""P2E DV3 helpers (reference: sheeprl/algos/p2e_dv3/utils.py)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test  # noqa: F401
+
+# Generic exploration-metric names; the exploration entrypoint expands them to
+# one per exploration critic (reference p2e_dv3_exploration.py:680-707).
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss_task",
+    "Loss/policy_loss_task",
+    "Loss/value_loss_exploration",
+    "Loss/policy_loss_exploration",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "Loss/ensemble_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Params/exploration_amount",
+    "Rewards/intrinsic",
+    "Values_exploration/predicted_values",
+    "Values_exploration/lambda_values",
+    "Grads/world_model",
+    "Grads/actor_task",
+    "Grads/critic_task",
+    "Grads/actor_exploration",
+    "Grads/critic_exploration",
+    "Grads/ensemble",
+}
+MODELS_TO_REGISTER = {
+    "world_model",
+    "ensembles",
+    "actor_exploration",
+    "actor_task",
+    "critic_task",
+    "target_critic_task",
+    "moments_task",
+}
+
+__all__ = ["AGGREGATOR_KEYS", "MODELS_TO_REGISTER", "prepare_obs", "test"]
+
+
+# The finetuning entrypoint logs the plain Dreamer-V3 metric set.
+AGGREGATOR_KEYS_FINETUNING = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+
+# Both entrypoints share this module's AGGREGATOR_KEYS for the CLI's metric
+# whitelist, so the union must cover the finetuning names too.
+AGGREGATOR_KEYS |= AGGREGATOR_KEYS_FINETUNING

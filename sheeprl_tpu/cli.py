@@ -86,6 +86,38 @@ def run_algorithm(cfg: dotdict) -> None:
     module = importlib.import_module(entry["module"])
     entrypoint = getattr(module, entry["entrypoint"])
 
+    # P2E finetuning: load the exploration run's config and force the env
+    # settings to match it (reference cli.py:108-139)
+    kwargs: Dict[str, Any] = {}
+    if "finetuning" in cfg.algo.name and "p2e" in entry["module"]:
+        import yaml
+
+        ckpt_path = cfg.checkpoint.exploration_ckpt_path
+        if not ckpt_path:
+            raise ValueError("checkpoint.exploration_ckpt_path must be set for P2E finetuning")
+        expl_cfg_path = os.path.join(os.path.dirname(os.path.dirname(ckpt_path)), "config.yaml")
+        with open(expl_cfg_path) as f:
+            exploration_cfg = dotdict(yaml.safe_load(f))
+        if exploration_cfg.env.id != cfg.env.id:
+            raise ValueError(
+                "This experiment is run with a different environment from the one of the "
+                f"exploration you want to finetune. Got '{cfg.env.id}', but the environment "
+                f"used during exploration was {exploration_cfg.env.id}."
+            )
+        for k in (
+            "frame_stack",
+            "screen_size",
+            "action_repeat",
+            "grayscale",
+            "clip_rewards",
+            "frame_stack_dilation",
+            "max_episode_steps",
+            "reward_as_observation",
+        ):
+            if k in exploration_cfg.env:
+                cfg.env[k] = exploration_cfg.env[k]
+        kwargs["exploration_cfg"] = exploration_cfg
+
     fabric_cfg = dict(cfg.fabric.to_dict() if isinstance(cfg.fabric, dotdict) else cfg.fabric)
     callbacks = [instantiate(cb) for cb in fabric_cfg.pop("callbacks", None) or []]
     fabric = instantiate({**fabric_cfg, "callbacks": callbacks})
@@ -104,7 +136,7 @@ def run_algorithm(cfg: dotdict) -> None:
     except ModuleNotFoundError:
         pass
 
-    entrypoint(fabric, cfg)
+    entrypoint(fabric, cfg, **kwargs)
 
 
 def run(args: Optional[List[str]] = None) -> None:

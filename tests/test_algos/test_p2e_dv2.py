@@ -1,0 +1,75 @@
+"""P2E DV2 smoke tests (reference: tests/test_algos/test_algos.py::test_p2e_dv2)."""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.cli import run
+
+TINY = [
+    "env=dummy",
+    "dry_run=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "algo.per_rank_batch_size=1",
+    "algo.per_rank_sequence_length=2",
+    "buffer.size=10",
+    "algo.learning_starts=0",
+    "algo.replay_ratio=1",
+    "algo.per_rank_pretrain_steps=1",
+    "algo.horizon=4",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.ensembles.n=3",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=8",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "env.num_envs=2",
+    "algo.run_test=True",
+    "checkpoint.save_last=True",
+    "metric.log_level=1",
+]
+
+
+def expl_args(tmp_path, env_id="dummy_discrete"):
+    return ["exp=p2e_dv2_exploration", f"env.id={env_id}", f"log_base_dir={tmp_path}/logs"] + TINY
+
+
+def find_checkpoints(path):
+    ckpts = []
+    for root, _, files in os.walk(path):
+        ckpts += [os.path.join(root, f) for f in files if f.endswith(".ckpt")]
+    return ckpts
+
+
+@pytest.mark.parametrize("env_id", ["dummy_discrete", "dummy_continuous"])
+def test_p2e_dv2_exploration(tmp_path, monkeypatch, env_id):
+    monkeypatch.chdir(tmp_path)
+    run(expl_args(tmp_path, env_id))
+    assert find_checkpoints(tmp_path)
+
+
+def test_p2e_dv2_exploration_to_finetuning_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(expl_args(tmp_path))
+    (ckpt,) = find_checkpoints(tmp_path)
+    run(
+        ["exp=p2e_dv2_finetuning", "env.id=dummy_discrete", f"log_base_dir={tmp_path}/logs_ft"]
+        + TINY
+        + [f"checkpoint.exploration_ckpt_path={ckpt}"]
+    )
+    assert find_checkpoints(f"{tmp_path}/logs_ft")
+
+
+def test_p2e_dv2_evaluate_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(expl_args(tmp_path))
+    (ckpt,) = find_checkpoints(tmp_path)
+    from sheeprl_tpu.cli import evaluation
+
+    evaluation([f"checkpoint_path={ckpt}"])
