@@ -1,0 +1,1 @@
+from sheeprl_tpu.algos.ppo_recurrent import ppo_recurrent, evaluate  # noqa: F401

@@ -1,0 +1,275 @@
+"""Recurrent PPO agent (reference: sheeprl/algos/ppo_recurrent/agent.py:18-262).
+
+flax re-design: the LSTM time loop is a ``nn.scan``-lifted
+``OptimizedLSTMCell`` — one fused XLA while-loop over the sequence instead of
+cuDNN packed sequences. Padded positions are handled by masking the LOSSES
+(the reference's ``pack_padded_sequence`` only skips compute; sequences are
+independent, so states at padded tails are never consumed).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.agent import CNNEncoder, MLPEncoder
+from sheeprl_tpu.models import MLP
+from sheeprl_tpu.ops.distributions import Categorical, Independent, Normal
+
+Array = jax.Array
+
+
+class RecurrentPPOAgent(nn.Module):
+    """Encoder -> (pre-MLP) -> LSTM -> (post-MLP) -> actor heads + critic
+    (reference RecurrentPPOAgent, agent.py:85-262). ``__call__`` consumes a
+    time-major ``[T, B]`` batch plus the initial LSTM state and returns raw
+    actor head outputs, values, and the final state."""
+
+    actions_dim: Tuple[int, ...]
+    is_continuous: bool
+    cnn_keys: Tuple[str, ...]
+    mlp_keys: Tuple[str, ...]
+    cnn_features_dim: int = 512
+    mlp_features_dim: Optional[int] = 64
+    encoder_units: int = 64
+    encoder_layers: int = 1
+    lstm_hidden_size: int = 64
+    pre_rnn_apply: bool = False
+    pre_rnn_units: int = 64
+    pre_rnn_layer_norm: bool = True
+    post_rnn_apply: bool = False
+    post_rnn_units: int = 64
+    post_rnn_layer_norm: bool = True
+    actor_units: int = 64
+    actor_layers: int = 1
+    critic_units: int = 64
+    critic_layers: int = 1
+    dense_act: str = "relu"
+    layer_norm: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        obs: Dict[str, Array],  # [T, B, ...]
+        prev_actions: Array,  # [T, B, A]
+        hx: Array,  # [B, H]
+        cx: Array,  # [B, H]
+    ) -> Tuple[List[Array], Array, Tuple[Array, Array]]:
+        T, B = prev_actions.shape[:2]
+        feats = []
+        if self.cnn_keys:
+            flat = {k: obs[k].reshape(T * B, *obs[k].shape[2:]) for k in self.cnn_keys}
+            cnn_feat = CNNEncoder(self.cnn_keys, self.cnn_features_dim, dtype=self.dtype)(flat)
+            feats.append(cnn_feat.reshape(T, B, -1))
+        if self.mlp_keys:
+            feats.append(
+                MLPEncoder(
+                    self.mlp_keys,
+                    self.mlp_features_dim,
+                    self.encoder_units,
+                    self.encoder_layers,
+                    self.dense_act,
+                    self.layer_norm,
+                    dtype=self.dtype,
+                )(obs)
+            )
+        feat = feats[0] if len(feats) == 1 else jnp.concatenate(feats, axis=-1)
+        x = jnp.concatenate([feat, prev_actions.astype(feat.dtype)], axis=-1)
+
+        if self.pre_rnn_apply:
+            x = MLP(
+                hidden_sizes=(self.pre_rnn_units,),
+                output_dim=None,
+                activation=self.dense_act,
+                norm_layer="layer_norm" if self.pre_rnn_layer_norm else None,
+                dtype=self.dtype,
+                name="pre_rnn_mlp",
+            )(x)
+
+        # LSTM over time as one fused scan (reference RecurrentModel._lstm)
+        ScanLSTM = nn.scan(
+            nn.OptimizedLSTMCell,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=0,
+            out_axes=0,
+        )
+        carry = (cx.astype(self.dtype), hx.astype(self.dtype))
+        carry, out = ScanLSTM(self.lstm_hidden_size, dtype=self.dtype, param_dtype=jnp.float32)(
+            carry, x.astype(self.dtype)
+        )
+        new_cx, new_hx = carry
+
+        if self.post_rnn_apply:
+            out = MLP(
+                hidden_sizes=(self.post_rnn_units,),
+                output_dim=None,
+                activation=self.dense_act,
+                norm_layer="layer_norm" if self.post_rnn_layer_norm else None,
+                dtype=self.dtype,
+                name="post_rnn_mlp",
+            )(out)
+
+        values = MLP(
+            hidden_sizes=(self.critic_units,) * self.critic_layers,
+            output_dim=1,
+            activation=self.dense_act,
+            norm_layer="layer_norm" if self.layer_norm else None,
+            dtype=self.dtype,
+            name="critic",
+        )(out)
+
+        a = MLP(
+            hidden_sizes=(self.actor_units,) * self.actor_layers,
+            output_dim=None,
+            activation=self.dense_act,
+            norm_layer="layer_norm" if self.layer_norm else None,
+            dtype=self.dtype,
+            name="actor_backbone",
+        )(out)
+        if self.is_continuous:
+            heads = [nn.Dense(sum(self.actions_dim) * 2, dtype=self.dtype, name="actor_head_0")(a)]
+        else:
+            heads = [nn.Dense(d, dtype=self.dtype, name=f"actor_head_{i}")(a) for i, d in enumerate(self.actions_dim)]
+        return heads, values.astype(jnp.float32), (new_hx.astype(jnp.float32), new_cx.astype(jnp.float32))
+
+
+def _dists(agent: RecurrentPPOAgent, actor_out: List[Array]):
+    if agent.is_continuous:
+        mean, log_std = jnp.split(actor_out[0].astype(jnp.float32), 2, axis=-1)
+        return [Independent(Normal(mean, jnp.exp(log_std)), 1)]
+    return [Categorical(logits=h.astype(jnp.float32)) for h in actor_out]
+
+
+def sample_actions(
+    agent: RecurrentPPOAgent,
+    params: Any,
+    obs: Dict[str, Array],  # [1, B, ...]
+    prev_actions: Array,  # [1, B, A]
+    hx: Array,
+    cx: Array,
+    key: Array,
+    greedy: bool = False,
+) -> Tuple[Array, Array, Array, Array, Array]:
+    """Rollout-time policy (reference agent.py forward at play). Returns
+    ``(actions, logprobs, values, hx', cx')`` with the concatenated
+    one-hot/raw action layout of the buffer."""
+    actor_out, values, (new_hx, new_cx) = agent.apply(params, obs, prev_actions, hx, cx)
+    dists = _dists(agent, actor_out)
+    keys = jax.random.split(key, len(dists))
+    if agent.is_continuous:
+        d = dists[0]
+        act = d.mode if greedy else d.sample(seed=keys[0])
+        logprob = d.log_prob(act)[..., None]
+        return act, logprob, values, new_hx, new_cx
+    samples = [(d.mode if greedy else d.sample(seed=k)) for d, k in zip(dists, keys)]
+    logprob = sum(d.log_prob(s) for d, s in zip(dists, samples))[..., None]
+    onehots = [jax.nn.one_hot(s, dim, dtype=jnp.float32) for s, dim in zip(samples, agent.actions_dim)]
+    return jnp.concatenate(onehots, axis=-1), logprob, values, new_hx, new_cx
+
+
+def evaluate_actions(
+    agent: RecurrentPPOAgent,
+    params: Any,
+    obs: Dict[str, Array],  # [L, N, ...]
+    prev_actions: Array,  # [L, N, A]
+    hx0: Array,  # [N, H]
+    cx0: Array,  # [N, H]
+    actions: Array,  # [L, N, A]
+) -> Tuple[Array, Array, Array]:
+    """Train-time re-evaluation of stored sequences (reference train(),
+    ppo_recurrent.py:69-75). Returns ``(logprobs, entropy, values)``, each
+    ``[L, N, 1]`` — the caller masks the padded tail."""
+    actor_out, values, _ = agent.apply(params, obs, prev_actions, hx0, cx0)
+    dists = _dists(agent, actor_out)
+    if agent.is_continuous:
+        d = dists[0]
+        return d.log_prob(actions)[..., None], d.entropy()[..., None], values
+    splits = np.cumsum(agent.actions_dim)[:-1]
+    onehot_parts = jnp.split(actions, splits, axis=-1)
+    idx_parts = [jnp.argmax(p, axis=-1) for p in onehot_parts]
+    logprob = sum(d.log_prob(i) for d, i in zip(dists, idx_parts))[..., None]
+    entropy = sum(d.entropy() for d in dists)[..., None]
+    return logprob, entropy, values
+
+
+class RecurrentPPOPlayer:
+    """Host-side rollout handle: params + jitted single-step functions; the
+    caller owns the recurrent state (reference player usage,
+    ppo_recurrent.py:283-371)."""
+
+    def __init__(self, agent: RecurrentPPOAgent, params: Any) -> None:
+        self.agent = agent
+        self.params = params
+        self._sample = jax.jit(
+            lambda p, o, pa, hx, cx, k, greedy: sample_actions(agent, p, o, pa, hx, cx, k, greedy),
+            static_argnames="greedy",
+        )
+        self._values = jax.jit(lambda p, o, pa, hx, cx: agent.apply(p, o, pa, hx, cx)[1])
+
+    def get_actions(self, obs, prev_actions, hx, cx, key, greedy: bool = False):
+        return self._sample(self.params, obs, prev_actions, hx, cx, key, greedy)
+
+    def get_values(self, obs, prev_actions, hx, cx) -> Array:
+        return self._values(self.params, obs, prev_actions, hx, cx)
+
+
+def build_agent(
+    fabric: Any,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    agent_state: Optional[Any] = None,
+) -> Tuple[RecurrentPPOAgent, Any]:
+    """Construct the module and init/replicate its params
+    (reference build_agent, agent.py:265-300)."""
+    algo = cfg["algo"]
+    rnn = algo["rnn"]
+    agent = RecurrentPPOAgent(
+        actions_dim=tuple(int(d) for d in actions_dim),
+        is_continuous=bool(is_continuous),
+        cnn_keys=tuple(algo["cnn_keys"]["encoder"]),
+        mlp_keys=tuple(algo["mlp_keys"]["encoder"]),
+        cnn_features_dim=int(algo["encoder"]["cnn_features_dim"]),
+        mlp_features_dim=algo["encoder"]["mlp_features_dim"],
+        encoder_units=int(algo["encoder"]["dense_units"]),
+        encoder_layers=int(algo["encoder"]["mlp_layers"]),
+        lstm_hidden_size=int(rnn["lstm"]["hidden_size"]),
+        pre_rnn_apply=bool(rnn["pre_rnn_mlp"]["apply"]),
+        pre_rnn_units=int(rnn["pre_rnn_mlp"]["dense_units"]),
+        pre_rnn_layer_norm=bool(rnn["pre_rnn_mlp"]["layer_norm"]),
+        post_rnn_apply=bool(rnn["post_rnn_mlp"]["apply"]),
+        post_rnn_units=int(rnn["post_rnn_mlp"]["dense_units"]),
+        post_rnn_layer_norm=bool(rnn["post_rnn_mlp"]["layer_norm"]),
+        actor_units=int(algo["actor"]["dense_units"]),
+        actor_layers=int(algo["actor"]["mlp_layers"]),
+        critic_units=int(algo["critic"]["dense_units"]),
+        critic_layers=int(algo["critic"]["mlp_layers"]),
+        dense_act=str(algo["dense_act"]),
+        layer_norm=bool(algo["layer_norm"]),
+        dtype=fabric.precision.compute_dtype,
+    )
+    if agent_state is not None:
+        params = jax.tree.map(jnp.asarray, agent_state)
+    else:
+        dummy_obs = {}
+        for k in agent.cnn_keys:
+            shape = obs_space[k].shape
+            if len(shape) == 4:
+                s, h, w, c = shape
+                shape = (h, w, s * c)
+            dummy_obs[k] = jnp.zeros((1, 1, *shape), dtype=jnp.uint8)
+        for k in agent.mlp_keys:
+            dummy_obs[k] = jnp.zeros((1, 1, *obs_space[k].shape), dtype=jnp.float32)
+        prev_actions = jnp.zeros((1, 1, int(np.sum(actions_dim))), jnp.float32)
+        h0 = jnp.zeros((1, agent.lstm_hidden_size), jnp.float32)
+        params = agent.init(jax.random.PRNGKey(int(cfg["seed"])), dummy_obs, prev_actions, h0, h0)
+    params = jax.tree.map(lambda x: x.astype(fabric.precision.param_dtype), params)
+    return agent, fabric.replicate(params)

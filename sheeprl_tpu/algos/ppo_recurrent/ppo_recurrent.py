@@ -1,0 +1,508 @@
+"""Recurrent PPO (reference: sheeprl/algos/ppo_recurrent/ppo_recurrent.py:31-531)
+— TPU-native.
+
+The redesign:
+
+- **Sequence-chunked rollouts with static shapes.** The reference splits the
+  rollout into variable-length episodes, chunks them, and pads to the max
+  length. Here every chunk is padded to exactly ``per_rank_sequence_length``
+  and the sequence COUNT is padded to a multiple of
+  ``devices * per_rank_num_batches`` with fully-masked dummies — the jitted
+  update only recompiles when that padded count changes, not every update.
+- **Whole-update fusion**: epochs x shuffled sequence-minibatches run as two
+  nested ``lax.scan``s inside one ``shard_map``-ped XLA program; sequences
+  are sharded across the mesh's data axis and gradients ``pmean``-reduced
+  over ICI (the reference's DDP+Join, :45-56).
+- **Masked losses** replace ``pack_padded_sequence``: padded steps contribute
+  zero to every loss term (reference masks via boolean indexing, :77-101).
+- Hidden states are reset on done during the rollout
+  (``reset_recurrent_state_on_done``, reference :367-371), and sequences
+  restart the LSTM from the STORED per-step states (``prev_hx/prev_cx``,
+  reference :72).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Any, Dict, List, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
+from sheeprl_tpu.algos.ppo_recurrent.agent import (
+    RecurrentPPOPlayer,
+    build_agent,
+    evaluate_actions,
+)
+from sheeprl_tpu.algos.ppo_recurrent.utils import AGGREGATOR_KEYS, prepare_obs, test
+from sheeprl_tpu.config.compose import instantiate
+from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.ops.math import gae
+from sheeprl_tpu.parallel.shard_map import shard_map
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
+
+
+def build_sequences(
+    local_data: Dict[str, np.ndarray],
+    train_keys: List[str],
+    seq_len: int,
+    num_envs: int,
+    pad_multiple: int,
+) -> Dict[str, np.ndarray]:
+    """Split the ``[T, E, ...]`` rollout into per-episode chunks of at most
+    ``seq_len`` steps (reference :406-444), pad each chunk to ``seq_len`` and
+    the chunk count to a multiple of ``pad_multiple``. Only ``train_keys``
+    are shipped as ``[seq_len, N_pad, ...]`` arrays; the chunk-initial LSTM
+    states are emitted once per sequence as ``hx0``/``cx0`` ``[N_pad, H]``
+    (the update reads nothing else from them), plus a ``mask`` of valid
+    steps."""
+    T = next(iter(local_data.values())).shape[0]
+    chunks: List[Dict[str, np.ndarray]] = []
+    starts: List[Tuple[int, int]] = []  # (env, t) of each chunk's first step
+    for e in range(num_envs):
+        env_data = {k: local_data[k][:, e] for k in train_keys}
+        ends = np.nonzero(local_data["dones"][:, e, 0])[0].tolist()
+        ends.append(T - 1)
+        start = 0
+        for end in ends:
+            stop = min(end + 1, T)  # include the done step
+            if stop <= start:
+                continue
+            for i in range(start, stop, seq_len):
+                chunks.append({k: v[i : min(i + seq_len, stop)] for k, v in env_data.items()})
+                starts.append((e, i))
+            start = stop
+    n = len(chunks)
+    n_pad = ((n + pad_multiple - 1) // pad_multiple) * pad_multiple
+    out: Dict[str, np.ndarray] = {}
+    for k in train_keys:
+        proto = chunks[0][k]
+        arr = np.zeros((seq_len, n_pad, *proto.shape[1:]), proto.dtype)
+        for j, ch in enumerate(chunks):
+            arr[: ch[k].shape[0], j] = ch[k]
+        out[k] = arr
+    mask = np.zeros((seq_len, n_pad, 1), np.float32)
+    lengths = [ch[train_keys[0]].shape[0] for ch in chunks]
+    for j, ln in enumerate(lengths):
+        mask[:ln, j] = 1.0
+    out["mask"] = mask
+    hidden = local_data["prev_hx"].shape[-1]
+    hx0 = np.zeros((n_pad, hidden), np.float32)
+    cx0 = np.zeros((n_pad, hidden), np.float32)
+    for j, (e, t) in enumerate(starts):
+        hx0[j] = local_data["prev_hx"][t, e]
+        cx0[j] = local_data["prev_cx"][t, e]
+    out["hx0"] = hx0
+    out["cx0"] = cx0
+    return out
+
+
+def make_train_fn(fabric, agent, tx, cfg, obs_keys):
+    """Fused masked-sequence update (replaces reference train(), :31-116)."""
+    update_epochs = int(cfg.algo.update_epochs)
+    num_batches = max(1, int(cfg.algo.per_rank_num_batches))
+    vf_coef = float(cfg.algo.vf_coef)
+    clip_vloss = bool(cfg.algo.clip_vloss)
+    normalize_adv = bool(cfg.algo.normalize_advantages)
+    reduction = str(cfg.algo.loss_reduction)
+    data_axis = fabric.data_axis
+
+    def local_train(params, opt_state, data, hx0, cx0, key, clip_coef, ent_coef):
+        key = jax.random.fold_in(key, lax.axis_index(data_axis))
+        n_local = data["mask"].shape[1]
+        bs = n_local // num_batches
+
+        def minibatch_step(carry, xs):
+            params, opt_state = carry
+            batch, h0, c0 = xs
+
+            def loss_fn(p):
+                obs = {k: batch[k] for k in obs_keys}
+                logprobs, entropy, values = evaluate_actions(
+                    agent,
+                    p,
+                    obs,
+                    batch["prev_actions"],
+                    h0,
+                    c0,
+                    batch["actions"],
+                )
+                mask = batch["mask"]
+                msum = mask.sum() + 1e-8
+                adv = batch["advantages"]
+                if normalize_adv:
+                    mean = (adv * mask).sum() / msum
+                    var = (jnp.square(adv - mean) * mask).sum() / jnp.maximum(msum - 1, 1.0)
+                    adv = (adv - mean) / (jnp.sqrt(var) + 1e-8)
+                # the reference hardcodes 'mean' for the policy/value terms;
+                # cfg.algo.loss_reduction only affects the entropy term
+                # (reference train(), :82-101)
+                pg = (policy_loss(logprobs, batch["logprobs"], adv, clip_coef, "none") * mask).sum() / msum
+                v = (
+                    value_loss(values, batch["values"], batch["returns"], clip_coef, clip_vloss, "none") * mask
+                ).sum() / msum
+                ent = (entropy_loss(entropy, "none") * mask).sum()
+                if reduction == "mean":
+                    ent = ent / msum
+                return pg + vf_coef * v + ent_coef * ent, (pg, v, ent)
+
+            (_, (pg, v, ent)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads = lax.pmean(grads, data_axis)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), jnp.stack([pg, v, ent])
+
+        def epoch_step(carry, _):
+            params, opt_state, key = carry
+            key, perm_key = jax.random.split(key)
+            perm = jax.random.permutation(perm_key, n_local)[: num_batches * bs]
+            minibatches = jax.tree.map(
+                lambda x: jnp.moveaxis(
+                    x[:, perm].reshape(x.shape[0], num_batches, bs, *x.shape[2:]), 1, 0
+                ),
+                data,
+            )
+            mb_h0 = hx0[perm].reshape(num_batches, bs, -1)
+            mb_c0 = cx0[perm].reshape(num_batches, bs, -1)
+            (params, opt_state), metrics = lax.scan(
+                minibatch_step, (params, opt_state), (minibatches, mb_h0, mb_c0)
+            )
+            return (params, opt_state, key), metrics
+
+        (params, opt_state, _), metrics = lax.scan(
+            epoch_step, (params, opt_state, key), None, length=update_epochs
+        )
+        return params, opt_state, lax.pmean(metrics.mean(axis=(0, 1)), data_axis)
+
+    train_fn = shard_map(
+        local_train,
+        mesh=fabric.mesh,
+        in_specs=(P(), P(), P(None, data_axis), P(data_axis), P(data_axis), P(), P(), P()),
+        out_specs=(P(), P(), P()),
+    )
+    return jax.jit(train_fn, donate_argnums=(0, 1))
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any]):
+    if cfg.checkpoint.resume_from:
+        state = fabric.load(cfg.checkpoint.resume_from)
+
+    if "minedojo" in str(cfg.env.wrapper.get("_target_", "")).lower():
+        raise ValueError(
+            "MineDojo is not currently supported by PPO Recurrent agent, since it does not take "
+            "into consideration the action masks provided by the environment."
+        )
+
+    log_dir = get_log_dir(cfg)
+    logger = get_logger(cfg, log_dir)
+    fabric.logger = logger
+    logger.log_hyperparams(cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg))
+    print(f"Log dir: {log_dir}")
+
+    initial_clip_coef = float(cfg.algo.clip_coef)
+    initial_ent_coef = float(cfg.algo.ent_coef)
+
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    rank = fabric.process_index
+    num_envs = int(cfg.env.num_envs)
+    envs = vectorized_env(
+        [
+            make_env(
+                cfg,
+                cfg.seed + rank * num_envs + i,
+                rank * num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
+            )
+            for i in range(num_envs)
+        ],
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+    )
+    observation_space = envs.single_observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+    if not obs_keys:
+        raise RuntimeError(
+            "You should specify at least one CNN key or MLP key from the cli: "
+            "`algo.cnn_keys.encoder=[rgb]` or `algo.mlp_keys.encoder=[state]`"
+        )
+
+    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        envs.single_action_space.shape
+        if is_continuous
+        else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
+    )
+    n_actions = int(np.sum(actions_dim))
+
+    agent, params = build_agent(
+        fabric,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["agent"] if cfg.checkpoint.resume_from else None,
+    )
+    player = RecurrentPPOPlayer(agent, params)
+
+    rollout_steps = int(cfg.algo.rollout_steps)
+    seq_len = int(cfg.algo.per_rank_sequence_length)
+    world_size = fabric.world_size
+    policy_steps_per_update = num_envs * rollout_steps * fabric.num_processes
+    num_updates = int(cfg.algo.total_steps) // policy_steps_per_update if not cfg.dry_run else 1
+    pad_multiple = world_size * max(1, int(cfg.algo.per_rank_num_batches))
+
+    opt_cfg = dict(cfg.algo.optimizer.to_dict() if hasattr(cfg.algo.optimizer, "to_dict") else cfg.algo.optimizer)
+    if cfg.algo.max_grad_norm and float(cfg.algo.max_grad_norm) > 0:
+        opt_cfg["max_grad_norm"] = float(cfg.algo.max_grad_norm)
+    if cfg.algo.anneal_lr:
+        steps_per_update = int(cfg.algo.update_epochs) * max(1, int(cfg.algo.per_rank_num_batches))
+        opt_cfg["schedule"] = optax.linear_schedule(
+            float(opt_cfg.get("lr", 1e-3)), 0.0, num_updates * steps_per_update
+        )
+    tx = instantiate(opt_cfg)
+    opt_state = fabric.replicate(tx.init(jax.device_get(params)))
+    if cfg.checkpoint.resume_from:
+        opt_state = fabric.replicate(
+            jax.tree.map(jnp.asarray, state["opt_state"], is_leaf=lambda x: isinstance(x, np.ndarray))
+        )
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = MetricAggregator(cfg.metric.get("aggregator", {}).get("metrics", {}) or {})
+    for k in AGGREGATOR_KEYS - set(aggregator.metrics):
+        aggregator.add(k, "mean")
+
+    train_fn = make_train_fn(fabric, agent, tx, cfg, obs_keys)
+    gae_fn = jax.jit(partial(gae, gamma=float(cfg.algo.gamma), gae_lambda=float(cfg.algo.gae_lambda)))
+
+    start_update = (state["update"] + 1) if cfg.checkpoint.resume_from else 1
+    policy_step = state["update"] * policy_steps_per_update if cfg.checkpoint.resume_from else 0
+    last_log = state["last_log"] if cfg.checkpoint.resume_from else 0
+    last_checkpoint = state["last_checkpoint"] if cfg.checkpoint.resume_from else 0
+    train_step = 0
+    last_train = 0
+
+    key = jax.random.PRNGKey(int(cfg.seed))
+    if cfg.checkpoint.resume_from and "rng_key" in state:
+        key = jnp.asarray(state["rng_key"])
+
+    clip_coef = float(cfg.algo.clip_coef)
+    ent_coef = float(cfg.algo.ent_coef)
+    reset_on_done = bool(cfg.algo.reset_recurrent_state_on_done)
+
+    next_obs, _ = envs.reset(seed=cfg.seed)
+    next_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=num_envs)
+    hx = np.zeros((num_envs, agent.lstm_hidden_size), np.float32)
+    cx = np.zeros((num_envs, agent.lstm_hidden_size), np.float32)
+    prev_actions = np.zeros((num_envs, n_actions), np.float32)
+
+    for update in range(start_update, num_updates + 1):
+        rollout = {
+            k: []
+            for k in (
+                *obs_keys,
+                "dones",
+                "values",
+                "actions",
+                "logprobs",
+                "rewards",
+                "prev_hx",
+                "prev_cx",
+                "prev_actions",
+            )
+        }
+        with timer("Time/env_interaction_time"):
+            for _ in range(rollout_steps):
+                policy_step += num_envs * fabric.num_processes
+                key, action_key = jax.random.split(key)
+                obs_t = {k: v[None] for k, v in next_obs.items()}
+                actions, logprobs, values, new_hx, new_cx = player.get_actions(
+                    obs_t, prev_actions[None], hx, cx, action_key
+                )
+                actions_np, logprobs_np, values_np, new_hx, new_cx = jax.device_get(
+                    (actions, logprobs, values, new_hx, new_cx)
+                )
+                actions_np = actions_np[0]
+                logprobs_np = logprobs_np[0]
+                values_np = values_np[0]
+                if is_continuous:
+                    real_actions = actions_np
+                else:
+                    splits = np.cumsum(actions_dim)[:-1]
+                    real_actions = np.stack(
+                        [p.argmax(-1) for p in np.split(actions_np, splits, axis=-1)], axis=-1
+                    )
+                    if real_actions.shape[-1] == 1 and not is_multidiscrete:
+                        real_actions = real_actions[..., 0]
+
+                obs, rewards, terminated, truncated, info = envs.step(
+                    real_actions.reshape(envs.action_space.shape)
+                )
+                rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, 1)
+
+                # truncation bootstrap with the POST-step recurrent state
+                # (reference :312-336)
+                truncated_envs = np.nonzero(truncated)[0]
+                if len(truncated_envs) > 0 and "final_obs" in info:
+                    final_obs = {
+                        k: np.stack([np.asarray(info["final_obs"][e][k]) for e in truncated_envs])
+                        for k in obs_keys
+                    }
+                    final_obs = prepare_obs(final_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
+                    vals = np.asarray(
+                        player.get_values(
+                            {k: v[None] for k, v in final_obs.items()},
+                            actions_np[truncated_envs][None],
+                            new_hx[truncated_envs],
+                            new_cx[truncated_envs],
+                        )
+                    ).reshape(len(truncated_envs))
+                    rewards[truncated_envs, 0] += float(cfg.algo.gamma) * vals
+
+                dones = np.logical_or(terminated, truncated).reshape(num_envs, 1).astype(np.float32)
+                for k in obs_keys:
+                    rollout[k].append(next_obs[k])
+                rollout["dones"].append(dones)
+                rollout["values"].append(values_np)
+                rollout["actions"].append(actions_np)
+                rollout["logprobs"].append(logprobs_np)
+                rollout["rewards"].append(rewards)
+                rollout["prev_hx"].append(hx.copy())
+                rollout["prev_cx"].append(cx.copy())
+                rollout["prev_actions"].append(prev_actions.copy())
+
+                prev_actions = (1 - dones) * actions_np
+                if reset_on_done:
+                    hx = (1 - dones) * new_hx
+                    cx = (1 - dones) * new_cx
+                else:
+                    hx, cx = new_hx, new_cx
+                next_obs = prepare_obs(obs, cnn_keys=cnn_keys, num_envs=num_envs)
+
+                if cfg.metric.log_level > 0 and "final_info" in info:
+                    ep = info["final_info"].get("episode")
+                    if ep is not None:
+                        for i in np.nonzero(ep.get("_r", []))[0]:
+                            aggregator.update("Rewards/rew_avg", float(ep["r"][i]))
+                            aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
+                            print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep['r'][i]}")
+
+        local_data = {k: np.stack(v, axis=0) for k, v in rollout.items()}  # [T, E, ...]
+
+        # GAE on device (reference :386-398)
+        next_values = np.asarray(
+            player.get_values({k: v[None] for k, v in next_obs.items()}, prev_actions[None], hx, cx)
+        )[0]
+        returns, advantages = gae_fn(
+            jnp.asarray(local_data["rewards"]),
+            jnp.asarray(local_data["values"]),
+            jnp.asarray(local_data["dones"]),
+            jnp.asarray(next_values),
+        )
+        local_data["returns"] = np.asarray(returns)
+        local_data["advantages"] = np.asarray(advantages)
+
+        # episode split + fixed-length chunking + padding (reference :406-444)
+        train_keys = [*obs_keys, "actions", "logprobs", "values", "returns", "advantages", "prev_actions"]
+        sequences = build_sequences(local_data, train_keys, seq_len, num_envs, pad_multiple)
+        hx0 = sequences.pop("hx0")
+        cx0 = sequences.pop("cx0")
+        if fabric.num_processes > 1:
+            sequences = fabric.make_global(sequences, (None, fabric.data_axis))
+            hx0 = fabric.make_global(hx0, (fabric.data_axis,))
+            cx0 = fabric.make_global(cx0, (fabric.data_axis,))
+
+        with timer("Time/train_time"):
+            key, train_key = jax.random.split(key)
+            params, opt_state, metrics = train_fn(
+                params,
+                opt_state,
+                sequences,
+                hx0,
+                cx0,
+                train_key,
+                jnp.float32(clip_coef),
+                jnp.float32(ent_coef),
+            )
+            metrics = jax.block_until_ready(metrics)
+        player.params = params
+        train_step += world_size
+
+        if cfg.metric.log_level > 0:
+            aggregator.update("Loss/policy_loss", float(metrics[0]))
+            aggregator.update("Loss/value_loss", float(metrics[1]))
+            aggregator.update("Loss/entropy_loss", float(metrics[2]))
+
+            if policy_step - last_log >= cfg.metric.log_every or update == num_updates:
+                metrics_dict = aggregator.compute()
+                logger.log_metrics(metrics_dict, policy_step)
+                aggregator.reset()
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if timer_metrics.get("Time/train_time"):
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                            policy_step,
+                        )
+                    if timer_metrics.get("Time/env_interaction_time"):
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) * cfg.env.action_repeat
+                                )
+                                / timer_metrics["Time/env_interaction_time"]
+                            },
+                            policy_step,
+                        )
+                    timer.reset()
+                last_log = policy_step
+                last_train = train_step
+
+        if cfg.algo.anneal_clip_coef:
+            clip_coef = polynomial_decay(
+                update, initial=initial_clip_coef, final=0.0, max_decay_steps=num_updates, power=1.0
+            )
+        if cfg.algo.anneal_ent_coef:
+            ent_coef = polynomial_decay(
+                update, initial=initial_ent_coef, final=0.0, max_decay_steps=num_updates, power=1.0
+            )
+
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            update == num_updates and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": jax.device_get(params),
+                "opt_state": jax.device_get(opt_state),
+                "update": update,
+                "batch_size": int(cfg.algo.per_rank_batch_size) * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+                "rng_key": jax.device_get(key),
+            }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
+            fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(player, fabric, cfg, log_dir)
+    logger.finalize()

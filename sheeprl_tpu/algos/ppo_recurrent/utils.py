@@ -1,0 +1,58 @@
+"""Recurrent PPO helpers (reference: sheeprl/algos/ppo_recurrent/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.utils import prepare_obs  # noqa: F401
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/entropy_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def test(player: Any, fabric: Any, cfg: Dict[str, Any], log_dir: str) -> None:
+    """Greedy evaluation episode threading the recurrent state
+    (reference ppo_recurrent/utils.py test)."""
+    from sheeprl_tpu.envs import make_env
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    agent = player.agent
+    done = False
+    cumulative_rew = 0.0
+    key = jax.random.PRNGKey(cfg.seed)
+    obs, _ = env.reset(seed=cfg.seed)
+    hx = np.zeros((1, agent.lstm_hidden_size), np.float32)
+    cx = np.zeros((1, agent.lstm_hidden_size), np.float32)
+    prev_actions = np.zeros((1, int(np.sum(agent.actions_dim))), np.float32)
+    while not done:
+        key, sub = jax.random.split(key)
+        torch_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder)
+        obs_t = {k: v[None] for k, v in torch_obs.items()}
+        actions, _, _, hx, cx = player.get_actions(obs_t, prev_actions[None], hx, cx, sub, greedy=True)
+        actions, hx, cx = jax.device_get((actions, hx, cx))
+        actions = np.asarray(actions)[0]
+        prev_actions = actions
+        if agent.is_continuous:
+            real_actions = actions[0]
+        else:
+            splits = np.cumsum(agent.actions_dim)[:-1]
+            real_actions = np.array([p.argmax(-1) for p in np.split(actions[0], splits, axis=-1)])
+            if len(real_actions) == 1:
+                real_actions = real_actions[0]
+        obs, reward, terminated, truncated, _ = env.step(real_actions)
+        done = terminated or truncated or cfg.dry_run
+        cumulative_rew += float(reward)
+    fabric_print = getattr(fabric, "print", print)
+    fabric_print(f"Test - Reward: {cumulative_rew}")
+    if cfg.metric.log_level > 0 and getattr(fabric, "logger", None) is not None:
+        fabric.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()

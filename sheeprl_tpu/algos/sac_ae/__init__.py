@@ -1,0 +1,1 @@
+from sheeprl_tpu.algos.sac_ae import sac_ae, evaluate  # noqa: F401

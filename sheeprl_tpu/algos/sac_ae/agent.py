@@ -1,0 +1,421 @@
+"""SAC-AE agent (reference: sheeprl/algos/sac_ae/agent.py:26-640).
+
+flax re-design of the pixel-SAC autoencoder (https://arxiv.org/abs/1910.01741):
+
+- the encoder/decoder/actor/Q-functions are separate param trees matched to
+  the reference's five optimizers; the Q ensemble is vmapped stacked params
+  over a shared encoder feature (reference SACAECritic loop, agent.py:235-238),
+- ``detach_encoder_features`` becomes a ``stop_gradient`` on the conv trunk
+  output (CNN) / the full MLP output (reference agent.py:77-121) — combined
+  with per-tree ``jax.grad`` it reproduces the reference's careful gradient
+  routing (actor never trains the encoder, agent.py:74-110 in sac_ae.py),
+- the decoder's final transposed conv reproduces torch's ``output_padding=1``
+  by right-padding the input one pixel and cropping (NHWC).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.models import MLP
+
+Array = jax.Array
+
+LOG_STD_MAX = 2.0
+LOG_STD_MIN = -10.0
+
+
+class SACAEEncoder(nn.Module):
+    """Multi-encoder: conv trunk (k3 s2 + 3x k3 s1, VALID) -> Dense+LN+tanh
+    feature head for pixels (reference CNNEncoder, agent.py:26-87), plus an
+    MLP for vector keys (reference MLPEncoder, agent.py:89-120)."""
+
+    cnn_keys: Tuple[str, ...]
+    mlp_keys: Tuple[str, ...]
+    features_dim: int = 64
+    cnn_channels_multiplier: int = 1
+    dense_units: int = 64
+    mlp_layers: int = 2
+    dense_act: str = "relu"
+    layer_norm: bool = False
+    screen_size: int = 64
+    dtype: Any = jnp.float32
+
+    @property
+    def conv_hw(self) -> int:
+        hw = (self.screen_size - 3) // 2 + 1  # k3 s2
+        for _ in range(3):  # 3x k3 s1
+            hw = hw - 2
+        return hw
+
+    @property
+    def conv_channels(self) -> int:
+        return 32 * self.cnn_channels_multiplier
+
+    @property
+    def output_dim(self) -> int:
+        dim = self.features_dim if self.cnn_keys else 0
+        dim += self.dense_units if self.mlp_keys else 0
+        return dim
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, Array], detach_encoder_features: bool = False) -> Array:
+        feats = []
+        if self.cnn_keys:
+            x = jnp.concatenate([obs[k].astype(self.dtype) for k in self.cnn_keys], axis=-1)
+            strides = [2, 1, 1, 1]
+            for s in strides:
+                x = nn.Conv(
+                    self.conv_channels,
+                    kernel_size=(3, 3),
+                    strides=(s, s),
+                    padding="VALID",
+                    dtype=self.dtype,
+                    param_dtype=jnp.float32,
+                )(x)
+                x = nn.relu(x)
+            x = x.reshape(*x.shape[:-3], -1)
+            if detach_encoder_features:
+                x = jax.lax.stop_gradient(x)
+            x = nn.Dense(self.features_dim, dtype=self.dtype, param_dtype=jnp.float32, name="fc")(x)
+            x = nn.LayerNorm(dtype=jnp.float32)(x.astype(jnp.float32))
+            feats.append(jnp.tanh(x))
+        if self.mlp_keys:
+            v = jnp.concatenate([obs[k].astype(self.dtype) for k in self.mlp_keys], axis=-1)
+            v = MLP(
+                hidden_sizes=(self.dense_units,) * self.mlp_layers,
+                output_dim=None,
+                activation=self.dense_act,
+                norm_layer="layer_norm" if self.layer_norm else None,
+                dtype=self.dtype,
+                name="mlp_encoder",
+            )(v).astype(jnp.float32)
+            if detach_encoder_features:
+                v = jax.lax.stop_gradient(v)
+            feats.append(v)
+        return feats[0] if len(feats) == 1 else jnp.concatenate(feats, axis=-1)
+
+
+class SACAEDecoder(nn.Module):
+    """Multi-decoder: Dense to the conv seed then 3x ConvTranspose k3 s1 and
+    a final k3 s2 (+output-padding) back to pixels (reference CNNDecoder,
+    agent.py:153-201), plus an MLP trunk with per-key heads for vectors
+    (reference MLPDecoder, agent.py:122-150)."""
+
+    cnn_keys: Tuple[str, ...]
+    mlp_keys: Tuple[str, ...]
+    cnn_output_channels: Tuple[int, ...]
+    mlp_output_dims: Tuple[int, ...]
+    conv_hw: int
+    conv_channels: int
+    features_dim: int = 64
+    dense_units: int = 64
+    mlp_layers: int = 2
+    dense_act: str = "relu"
+    layer_norm: bool = False
+    screen_size: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, features: Array) -> Dict[str, Array]:
+        out: Dict[str, Array] = {}
+        if self.cnn_keys:
+            x = nn.Dense(
+                self.conv_hw * self.conv_hw * self.conv_channels,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                name="fc",
+            )(features.astype(self.dtype))
+            x = x.reshape(*x.shape[:-1], self.conv_hw, self.conv_hw, self.conv_channels)
+            for _ in range(3):
+                x = nn.ConvTranspose(
+                    self.conv_channels,
+                    kernel_size=(3, 3),
+                    strides=(1, 1),
+                    padding="VALID",
+                    dtype=self.dtype,
+                    param_dtype=jnp.float32,
+                )(x)
+                x = nn.relu(x)
+            # torch's output_padding=1: right-pad the input and crop
+            x = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))
+            x = nn.ConvTranspose(
+                sum(self.cnn_output_channels),
+                kernel_size=(3, 3),
+                strides=(2, 2),
+                padding="VALID",
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                name="to_obs",
+            )(x)
+            x = x[..., : self.screen_size, : self.screen_size, :].astype(jnp.float32)
+            splits = np.cumsum(self.cnn_output_channels)[:-1]
+            out.update({k: p for k, p in zip(self.cnn_keys, jnp.split(x, splits, axis=-1))})
+        if self.mlp_keys:
+            v = MLP(
+                hidden_sizes=(self.dense_units,) * self.mlp_layers,
+                output_dim=None,
+                activation=self.dense_act,
+                norm_layer="layer_norm" if self.layer_norm else None,
+                dtype=self.dtype,
+                name="mlp_decoder",
+            )(features.astype(self.dtype))
+            for k, d in zip(self.mlp_keys, self.mlp_output_dims):
+                out[k] = nn.Dense(d, dtype=jnp.float32, param_dtype=jnp.float32, name=f"head_{k}")(v)
+        return out
+
+
+class SACAEQFunction(nn.Module):
+    """Q(features, a) MLP (reference agent.py:204-223); ensemble via vmap."""
+
+    hidden_size: int = 1024
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, features: Array, action: Array) -> Array:
+        x = jnp.concatenate([features, action], axis=-1).astype(self.dtype)
+        for _ in range(2):
+            x = nn.Dense(self.hidden_size, dtype=self.dtype, param_dtype=jnp.float32)(x)
+            x = nn.relu(x)
+        return nn.Dense(1, dtype=jnp.float32, param_dtype=jnp.float32)(x)
+
+
+class SACAEActorTrunk(nn.Module):
+    """Actor head on top of encoder features (reference SACAEContinuousActor,
+    agent.py:240-318; the tanh-rescaled log-std clamp is :281-284)."""
+
+    action_dim: int
+    hidden_size: int = 1024
+    action_low: Tuple[float, ...] = (-1.0,)
+    action_high: Tuple[float, ...] = (1.0,)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, features: Array) -> Tuple[Array, Array]:
+        x = features.astype(self.dtype)
+        for _ in range(2):
+            x = nn.Dense(self.hidden_size, dtype=self.dtype, param_dtype=jnp.float32)(x)
+            x = nn.relu(x)
+        mean = nn.Dense(self.action_dim, dtype=jnp.float32, param_dtype=jnp.float32, name="fc_mean")(x)
+        log_std = nn.Dense(self.action_dim, dtype=jnp.float32, param_dtype=jnp.float32, name="fc_logstd")(x)
+        log_std = jnp.tanh(log_std)
+        log_std = LOG_STD_MIN + 0.5 * (LOG_STD_MAX - LOG_STD_MIN) * (log_std + 1)
+        return mean, log_std
+
+    @property
+    def action_scale(self) -> Array:
+        return (jnp.asarray(self.action_high) - jnp.asarray(self.action_low)) / 2.0
+
+    @property
+    def action_bias(self) -> Array:
+        return (jnp.asarray(self.action_high) + jnp.asarray(self.action_low)) / 2.0
+
+
+def actor_action_and_log_prob(
+    actor: SACAEActorTrunk, params: Any, features: Array, key: Array
+) -> Tuple[Array, Array]:
+    """rsample a squashed action + log-prob from encoder features
+    (reference agent.py:286-318)."""
+    mean, log_std = actor.apply(params, features)
+    std = jnp.exp(log_std)
+    x_t = mean + std * jax.random.normal(key, mean.shape)
+    y_t = jnp.tanh(x_t)
+    scale, bias = actor.action_scale, actor.action_bias
+    action = y_t * scale + bias
+    log_prob = -0.5 * (jnp.square((x_t - mean) / std) + 2 * jnp.log(std) + jnp.log(2 * jnp.pi))
+    log_prob = log_prob - jnp.log(scale * (1 - jnp.square(y_t)) + 1e-6)
+    return action, log_prob.sum(-1, keepdims=True)
+
+
+def actor_greedy_action(actor: SACAEActorTrunk, params: Any, features: Array) -> Array:
+    mean, _ = actor.apply(params, features)
+    return jnp.tanh(mean) * actor.action_scale + actor.action_bias
+
+
+def qf_ensemble_apply(qf: SACAEQFunction, stacked_params: Any, features: Array, action: Array) -> Array:
+    """[B, n_critics] Q-values in one vmapped call (reference agent.py:235-238)."""
+    qs = jax.vmap(lambda p: qf.apply(p, features, action))(stacked_params)
+    return jnp.moveaxis(qs[..., 0], 0, -1)
+
+
+class SACAEAgent:
+    """Host handle holding the five param trees + targets (reference
+    SACAEAgent, agent.py:321-520)."""
+
+    def __init__(
+        self,
+        encoder: SACAEEncoder,
+        decoder: SACAEDecoder,
+        actor: SACAEActorTrunk,
+        qf: SACAEQFunction,
+        encoder_params: Any,
+        decoder_params: Any,
+        actor_params: Any,
+        qfs_params: Any,  # stacked [n_critics, ...]
+        target_entropy: float,
+        alpha: float = 0.1,
+        tau: float = 0.01,
+        encoder_tau: float = 0.05,
+        num_critics: int = 2,
+    ) -> None:
+        self.encoder = encoder
+        self.decoder = decoder
+        self.actor = actor
+        self.qf = qf
+        self.encoder_params = encoder_params
+        self.decoder_params = decoder_params
+        self.actor_params = actor_params
+        self.qfs_params = qfs_params
+        self.target_encoder_params = jax.tree.map(jnp.copy, encoder_params)
+        self.target_qfs_params = jax.tree.map(jnp.copy, qfs_params)
+        self.log_alpha = jnp.log(jnp.asarray([alpha], jnp.float32))
+        self.target_entropy = float(target_entropy)
+        self.tau = float(tau)
+        self.encoder_tau = float(encoder_tau)
+        self.num_critics = num_critics
+
+
+class SACAEPlayer:
+    """Rollout/eval policy handle (reference SACAEPlayer, agent.py:523-560)."""
+
+    def __init__(self, encoder: SACAEEncoder, actor: SACAEActorTrunk, encoder_params: Any, actor_params: Any) -> None:
+        self.encoder = encoder
+        self.actor = actor
+        self.encoder_params = encoder_params
+        self.actor_params = actor_params
+
+        def _sample(ep, ap, obs, key):
+            feat = encoder.apply(ep, obs)
+            return actor_action_and_log_prob(actor, ap, feat, key)[0]
+
+        def _greedy(ep, ap, obs):
+            feat = encoder.apply(ep, obs)
+            return actor_greedy_action(actor, ap, feat)
+
+        self._sample = jax.jit(_sample)
+        self._greedy = jax.jit(_greedy)
+
+    def get_actions(self, obs: Dict[str, Array], key: Optional[Array] = None, greedy: bool = False) -> np.ndarray:
+        if greedy:
+            return np.asarray(self._greedy(self.encoder_params, self.actor_params, obs))
+        return np.asarray(self._sample(self.encoder_params, self.actor_params, obs, key))
+
+
+def build_agent(
+    fabric: Any,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    action_space: gymnasium.spaces.Box,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[SACAEAgent, SACAEPlayer]:
+    """Construct modules + init/replicate params (reference build_agent,
+    agent.py:563-640)."""
+    if not is_continuous:
+        raise ValueError("Only continuous action space is supported for the SAC-AE agent")
+    algo = cfg["algo"]
+    cnn_keys = tuple(algo["cnn_keys"]["encoder"])
+    mlp_keys = tuple(algo["mlp_keys"]["encoder"])
+    act_dim = int(np.sum(actions_dim))
+    screen = int(cfg["env"]["screen_size"])
+    dtype = fabric.precision.compute_dtype
+
+    def _channels(k):
+        shape = obs_space[k].shape
+        return int(np.prod(shape[:-3]) * shape[-1]) if len(shape) >= 3 else 1
+
+    encoder = SACAEEncoder(
+        cnn_keys=cnn_keys,
+        mlp_keys=mlp_keys,
+        features_dim=int(algo["encoder"]["features_dim"]),
+        cnn_channels_multiplier=int(algo["encoder"]["cnn_channels_multiplier"]),
+        dense_units=int(algo["encoder"]["dense_units"]),
+        mlp_layers=int(algo["encoder"]["mlp_layers"]),
+        dense_act=str(algo["encoder"]["dense_act"]),
+        layer_norm=bool(algo["encoder"]["layer_norm"]),
+        screen_size=screen,
+        dtype=dtype,
+    )
+    decoder = SACAEDecoder(
+        cnn_keys=tuple(algo["cnn_keys"]["decoder"]),
+        mlp_keys=tuple(algo["mlp_keys"]["decoder"]),
+        cnn_output_channels=tuple(_channels(k) for k in algo["cnn_keys"]["decoder"]),
+        mlp_output_dims=tuple(int(obs_space[k].shape[0]) for k in algo["mlp_keys"]["decoder"]),
+        conv_hw=encoder.conv_hw,
+        conv_channels=encoder.conv_channels,
+        features_dim=int(algo["encoder"]["features_dim"]),
+        dense_units=int(algo["decoder"]["dense_units"]),
+        mlp_layers=int(algo["decoder"]["mlp_layers"]),
+        dense_act=str(algo["decoder"]["dense_act"]),
+        layer_norm=bool(algo["decoder"]["layer_norm"]),
+        screen_size=screen,
+        dtype=dtype,
+    )
+    actor = SACAEActorTrunk(
+        action_dim=act_dim,
+        hidden_size=int(algo["hidden_size"]),
+        action_low=tuple(np.asarray(action_space.low, np.float32).ravel().tolist()),
+        action_high=tuple(np.asarray(action_space.high, np.float32).ravel().tolist()),
+        dtype=dtype,
+    )
+    n_critics = int(algo["critic"]["n"])
+    qf = SACAEQFunction(hidden_size=int(algo["hidden_size"]), dtype=dtype)
+
+    key = jax.random.PRNGKey(int(cfg["seed"]))
+    k_enc, k_dec, k_actor, *k_qfs = jax.random.split(key, n_critics + 3)
+
+    dummy_obs = {}
+    for k in cnn_keys:
+        shape = obs_space[k].shape
+        if len(shape) == 4:
+            s, hh, ww, c = shape
+            shape = (hh, ww, s * c)
+        dummy_obs[k] = jnp.zeros((1, *shape), jnp.float32)
+    for k in mlp_keys:
+        dummy_obs[k] = jnp.zeros((1, int(np.prod(obs_space[k].shape))), jnp.float32)
+
+    if agent_state is not None:
+        encoder_params = jax.tree.map(jnp.asarray, agent_state["encoder"])
+        decoder_params = jax.tree.map(jnp.asarray, agent_state["decoder"])
+        actor_params = jax.tree.map(jnp.asarray, agent_state["actor"])
+        qfs_params = jax.tree.map(jnp.asarray, agent_state["qfs"])
+    else:
+        encoder_params = encoder.init(k_enc, dummy_obs)
+        feat = encoder.apply(encoder_params, dummy_obs)
+        decoder_params = decoder.init(k_dec, feat)
+        actor_params = actor.init(k_actor, feat)
+        dummy_act = jnp.zeros((1, act_dim), jnp.float32)
+        qfs_params = jax.vmap(lambda kk: qf.init(kk, feat, dummy_act))(jnp.stack(k_qfs))
+
+    agent = SACAEAgent(
+        encoder,
+        decoder,
+        actor,
+        qf,
+        fabric.replicate(encoder_params),
+        fabric.replicate(decoder_params),
+        fabric.replicate(actor_params),
+        fabric.replicate(qfs_params),
+        target_entropy=-act_dim,
+        alpha=float(algo["alpha"]["alpha"]),
+        tau=float(algo["tau"]),
+        encoder_tau=float(algo["encoder"]["tau"]),
+        num_critics=n_critics,
+    )
+    if agent_state is not None:
+        agent.target_encoder_params = fabric.replicate(jax.tree.map(jnp.asarray, agent_state["target_encoder"]))
+        agent.target_qfs_params = fabric.replicate(jax.tree.map(jnp.asarray, agent_state["target_qfs"]))
+        agent.log_alpha = jnp.asarray(agent_state["log_alpha"])
+    else:
+        agent.target_encoder_params = fabric.replicate(agent.target_encoder_params)
+        agent.target_qfs_params = fabric.replicate(agent.target_qfs_params)
+
+    player = SACAEPlayer(encoder, actor, agent.encoder_params, agent.actor_params)
+    return agent, player

@@ -1,0 +1,34 @@
+"""SAC-AE evaluation entrypoint (reference: sheeprl/algos/sac_ae/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+
+from sheeprl_tpu.algos.sac_ae.agent import build_agent
+from sheeprl_tpu.algos.sac_ae.utils import test
+from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms="sac_ae")
+def evaluate(fabric, cfg: Dict[str, Any], state: Dict[str, Any]) -> None:
+    log_dir = get_log_dir(cfg)
+    logger = get_logger(cfg, log_dir)
+    fabric.logger = logger
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    actions_dim = tuple(action_space.shape)
+    env.close()
+
+    _, player = build_agent(
+        fabric, actions_dim, True, cfg, observation_space, action_space, state["agent"]
+    )
+    test(player, fabric, cfg, log_dir)
+    logger.finalize()

@@ -1,0 +1,61 @@
+"""SAC-AE helpers (reference: sheeprl/algos/sac_ae/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import numpy as np
+
+from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS as _SAC_KEYS
+
+AGGREGATOR_KEYS = _SAC_KEYS | {"Loss/reconstruction_loss"}
+MODELS_TO_REGISTER = {"agent", "encoder", "decoder"}
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], cnn_keys: Sequence[str] = (), num_envs: int = 1
+) -> Dict[str, np.ndarray]:
+    """Shape env observations for the agent (reference utils.py:28-40):
+    pixels fold a frame-stack axis into channels and are normalized to
+    [0, 1]; vectors flatten and stay float32."""
+    out: Dict[str, np.ndarray] = {}
+    for k, v in obs.items():
+        v = np.asarray(v)
+        if k in cnn_keys:
+            if v.ndim == 3:
+                v = v[None]
+            if v.ndim == 4 and v.shape[0] != num_envs:
+                v = v[None]
+            if v.ndim == 5:
+                e, s, h, w, c = v.shape
+                v = np.moveaxis(v, 1, 3).reshape(e, h, w, s * c)
+            out[k] = v.astype(np.float32) / 255.0
+        else:
+            out[k] = v.reshape(num_envs, -1).astype(np.float32)
+    return out
+
+
+def test(player: Any, fabric: Any, cfg: Dict[str, Any], log_dir: str) -> None:
+    """Greedy evaluation episode (reference utils.py:43-66)."""
+    from sheeprl_tpu.envs import make_env
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    key = jax.random.PRNGKey(cfg.seed)
+    obs, _ = env.reset(seed=cfg.seed)
+    while not done:
+        key, sub = jax.random.split(key)
+        np_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder)
+        action = player.get_actions(np_obs, sub, greedy=True)
+        obs, reward, terminated, truncated, _ = env.step(
+            np.asarray(action).reshape(env.action_space.shape)
+        )
+        done = terminated or truncated or cfg.dry_run
+        cumulative_rew += float(reward)
+    fabric_print = getattr(fabric, "print", print)
+    fabric_print(f"Test - Reward: {cumulative_rew}")
+    if cfg.metric.log_level > 0 and getattr(fabric, "logger", None) is not None:
+        fabric.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()

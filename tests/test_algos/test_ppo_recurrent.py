@@ -1,0 +1,64 @@
+"""Recurrent PPO smoke tests (reference: tests/test_algos/test_algos.py::test_ppo_recurrent)."""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.cli import run
+
+
+def rppo_args(tmp_path, env_id="dummy_discrete"):
+    return [
+        "exp=ppo_recurrent",
+        "env=dummy",
+        f"env.id={env_id}",
+        "dry_run=True",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "algo.rollout_steps=8",
+        "algo.per_rank_sequence_length=4",
+        "algo.per_rank_num_batches=2",
+        "algo.update_epochs=2",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.rnn.lstm.hidden_size=8",
+        "algo.encoder.cnn_features_dim=16",
+        "algo.encoder.mlp_features_dim=8",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.mlp_keys.encoder=[state]",
+        "env.num_envs=2",
+        "env.screen_size=64",
+        "algo.run_test=True",
+        "checkpoint.save_last=True",
+        "metric.log_level=1",
+        f"log_base_dir={tmp_path}/logs",
+    ]
+
+
+def find_checkpoints(tmp_path):
+    ckpts = []
+    for root, _, files in os.walk(tmp_path):
+        ckpts += [os.path.join(root, f) for f in files if f.endswith(".ckpt")]
+    return ckpts
+
+
+@pytest.mark.parametrize("env_id", ["dummy_discrete", "dummy_multidiscrete", "dummy_continuous"])
+def test_ppo_recurrent_dummy(tmp_path, monkeypatch, env_id):
+    monkeypatch.chdir(tmp_path)
+    run(rppo_args(tmp_path, env_id))
+    assert find_checkpoints(tmp_path)
+
+
+def test_ppo_recurrent_mlp_only(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(rppo_args(tmp_path) + ["algo.cnn_keys.encoder=[]", "algo.mlp_keys.encoder=[state]"])
+
+
+def test_ppo_recurrent_resume_and_evaluate(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(rppo_args(tmp_path))
+    (ckpt,) = find_checkpoints(tmp_path)
+    run(rppo_args(tmp_path) + [f"checkpoint.resume_from={ckpt}"])
+    from sheeprl_tpu.cli import evaluation
+
+    evaluation([f"checkpoint_path={ckpt}"])

@@ -1,0 +1,70 @@
+"""SAC-AE smoke tests (reference: tests/test_algos/test_algos.py::test_sac_ae).
+
+Pixel + vector continuous control with the autoencoder path on the dummy
+continuous env."""
+
+import os
+
+from sheeprl_tpu.cli import run
+
+
+def sac_ae_args(tmp_path):
+    return [
+        "exp=sac_ae",
+        "env=dummy",
+        "env.id=dummy_continuous",
+        "dry_run=True",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "algo.per_rank_batch_size=2",
+        "buffer.size=10",
+        "algo.learning_starts=0",
+        "algo.replay_ratio=1",
+        "algo.per_rank_pretrain_steps=1",
+        "algo.hidden_size=8",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.cnn_channels_multiplier=1",
+        "algo.encoder.features_dim=8",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.mlp_keys.encoder=[state]",
+        "env.num_envs=2",
+        "env.frame_stack=1",
+        "algo.run_test=True",
+        "checkpoint.save_last=True",
+        "metric.log_level=1",
+        f"log_base_dir={tmp_path}/logs",
+    ]
+
+
+def find_checkpoints(tmp_path):
+    ckpts = []
+    for root, _, files in os.walk(tmp_path):
+        ckpts += [os.path.join(root, f) for f in files if f.endswith(".ckpt")]
+    return ckpts
+
+
+def test_sac_ae_pixel_and_vector(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(sac_ae_args(tmp_path))
+    assert find_checkpoints(tmp_path)
+
+
+def test_sac_ae_pixel_only(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(sac_ae_args(tmp_path) + ["algo.mlp_keys.encoder=[]"])
+
+
+def test_sac_ae_frame_stack(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(sac_ae_args(tmp_path) + ["env.frame_stack=3"])
+
+
+def test_sac_ae_resume_and_evaluate(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(sac_ae_args(tmp_path))
+    (ckpt,) = find_checkpoints(tmp_path)
+    run(sac_ae_args(tmp_path) + [f"checkpoint.resume_from={ckpt}"])
+    from sheeprl_tpu.cli import evaluation
+
+    evaluation([f"checkpoint_path={ckpt}"])
