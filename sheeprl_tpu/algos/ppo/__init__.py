@@ -1,2 +1,3 @@
 from sheeprl_tpu.algos.ppo import ppo  # noqa: F401  (registers the algorithm)
+from sheeprl_tpu.algos.ppo import ppo_decoupled  # noqa: F401
 from sheeprl_tpu.algos.ppo import evaluate  # noqa: F401  (registers the evaluation)

@@ -13,7 +13,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.registry import register_evaluation
 
 
-@register_evaluation(algorithms="ppo")
+@register_evaluation(algorithms=["ppo", "ppo_decoupled"])
 def evaluate(fabric, cfg: Dict[str, Any], state: Dict[str, Any]) -> None:
     log_dir = get_log_dir(cfg)
     logger = get_logger(cfg, log_dir)

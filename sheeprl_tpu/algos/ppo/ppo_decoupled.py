@@ -1,0 +1,343 @@
+"""PPO, decoupled player/trainer topology (reference:
+sheeprl/algos/ppo/ppo_decoupled.py:33-669) — TPU-native.
+
+Role split (reference :645-669): process 0 is the PLAYER — it owns the
+environments, rolls out, computes GAE, and ships the rollout; processes
+1..N-1 are TRAINERS — they form their own ``jax.sharding.Mesh``
+(``parallel.submesh``) and run the same fused epochs x minibatches update as
+coupled PPO with gradient ``pmean`` over the trainer mesh (the reference's
+DDP over ``optimization_pg``, :581-584).
+
+Exchanges ride the host-object plane (``parallel.collectives``), replacing
+the reference's TorchCollective scatter/broadcast (:297-308):
+
+- rollout:  ``broadcast_object(flat_data, src=0)`` — each trainer slices its
+  device-share (the reference's chunk scatter, :297-302),
+- params:   ``broadcast_object((params, metrics[, opt_state]), src=1)`` —
+  the flat-vector broadcast of :304-308, plus trainer metrics and, on
+  checkpoint updates, the optimizer state for the player-side save
+  (reference on_checkpoint_player, callback.py:58-78).
+
+Both roles derive the number of updates and the checkpoint schedule from the
+same config, so no stop sentinel is needed (the reference scatters ``-1``,
+:463-484). Initial params are identical by construction — every process
+seeds the same ``PRNGKey`` — replacing the startup broadcast (:126-130).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.ppo.agent import PPOPlayer, build_agent
+from sheeprl_tpu.algos.ppo.ppo import make_train_fn
+from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, prepare_obs, test
+from sheeprl_tpu.config.compose import instantiate
+from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.ops.math import gae
+from sheeprl_tpu.parallel.collectives import broadcast_object
+from sheeprl_tpu.parallel.submesh import LocalFabric, SubMeshFabric, probe_spaces
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
+
+
+def _trainer_devices():
+    devs = [d for d in jax.devices() if d.process_index >= 1]
+    if not devs:
+        raise RuntimeError(
+            "ppo_decoupled needs at least 2 processes (player + trainers); "
+            "launch with jax.distributed (SHEEPRL_TPU_COORDINATOR/_NUM_PROCESSES/_PROCESS_ID)"
+        )
+    return devs
+
+
+def _ckpt_schedule(cfg, num_updates, policy_steps_per_update):
+    """The (deterministic) set of updates that checkpoint — shared by both
+    roles so the opt-state shipping lines up."""
+    do = set()
+    last = 0
+    step = 0
+    for update in range(1, num_updates + 1):
+        step += policy_steps_per_update
+        if (cfg.checkpoint.every > 0 and step - last >= cfg.checkpoint.every) or (
+            update == num_updates and cfg.checkpoint.save_last
+        ):
+            last = step
+            do.add(update)
+    return do
+
+
+@register_algorithm(decoupled=True)
+def main(fabric, cfg: Dict[str, Any]):
+    if jax.process_count() < 2:
+        raise RuntimeError(
+            "ppo_decoupled requires at least 2 processes: one player and one or more trainers "
+            "(reference ppo_decoupled.py:627-631)"
+        )
+    if cfg.checkpoint.resume_from:
+        raise ValueError("resume is not supported by the decoupled PPO (reference parity)")
+    if jax.process_index() == 0:
+        _player(fabric, cfg)
+    else:
+        _trainer(fabric, cfg)
+
+
+def _common_setup(fabric, cfg):
+    num_envs = int(cfg.env.num_envs)
+    rollout_steps = int(cfg.algo.rollout_steps)
+    trainer_devs = _trainer_devices()
+    n_global = rollout_steps * num_envs
+    if n_global % len(trainer_devs) != 0:
+        raise ValueError(
+            f"rollout_steps*num_envs ({n_global}) must be divisible by the trainer device count "
+            f"({len(trainer_devs)})"
+        )
+    policy_steps_per_update = num_envs * rollout_steps
+    num_updates = int(cfg.algo.total_steps) // policy_steps_per_update if not cfg.dry_run else 1
+    return num_envs, rollout_steps, trainer_devs, n_global, policy_steps_per_update, num_updates
+
+
+def _player(fabric, cfg):
+    log_dir = get_log_dir(cfg)
+    logger = get_logger(cfg, log_dir)
+    fabric.logger = logger
+    logger.log_hyperparams(cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg))
+    print(f"Log dir: {log_dir}")
+
+    num_envs, rollout_steps, trainer_devs, n_global, policy_steps_per_update, num_updates = _common_setup(
+        fabric, cfg
+    )
+    ckpt_updates = _ckpt_schedule(cfg, num_updates, policy_steps_per_update)
+
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i)
+            for i in range(num_envs)
+        ],
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+    )
+    observation_space = envs.single_observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        envs.single_action_space.shape
+        if is_continuous
+        else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
+    )
+
+    # identical deterministic init on every process replaces the reference's
+    # startup param broadcast (:126-130)
+    agent, params = build_agent(LocalFabric(fabric), actions_dim, is_continuous, cfg, observation_space, None)
+    player = PPOPlayer(agent, params)
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = MetricAggregator(cfg.metric.get("aggregator", {}).get("metrics", {}) or {})
+    for k in AGGREGATOR_KEYS - set(aggregator.metrics):
+        aggregator.add(k, "mean")
+
+    gae_fn = jax.jit(partial(gae, gamma=float(cfg.algo.gamma), gae_lambda=float(cfg.algo.gae_lambda)))
+
+    policy_step = 0
+    last_log = 0
+    key = jax.random.PRNGKey(int(cfg.seed))
+    next_obs, _ = envs.reset(seed=cfg.seed)
+    next_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=num_envs)
+
+    for update in range(1, num_updates + 1):
+        rollout = {k: [] for k in (*obs_keys, "dones", "values", "actions", "logprobs", "rewards")}
+        with timer("Time/env_interaction_time"):
+            for _ in range(rollout_steps):
+                policy_step += num_envs
+                key, action_key = jax.random.split(key)
+                actions, logprobs, values = player.get_actions(next_obs, action_key)
+                actions_np, logprobs_np, values_np = jax.device_get((actions, logprobs, values))
+                if is_continuous:
+                    real_actions = actions_np
+                else:
+                    splits = np.cumsum(actions_dim)[:-1]
+                    real_actions = np.stack(
+                        [p.argmax(-1) for p in np.split(actions_np, splits, axis=-1)], axis=-1
+                    )
+                    if real_actions.shape[-1] == 1 and not is_multidiscrete:
+                        real_actions = real_actions[..., 0]
+
+                obs, rewards, terminated, truncated, info = envs.step(
+                    real_actions.reshape(envs.action_space.shape)
+                )
+                rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, 1)
+                truncated_envs = np.nonzero(truncated)[0]
+                if len(truncated_envs) > 0 and "final_obs" in info:
+                    final_obs = {
+                        k: np.stack([np.asarray(info["final_obs"][e][k]) for e in truncated_envs])
+                        for k in obs_keys
+                    }
+                    final_obs = prepare_obs(final_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
+                    vals = np.asarray(player.get_values(final_obs)).reshape(len(truncated_envs))
+                    rewards[truncated_envs, 0] += float(cfg.algo.gamma) * vals
+                dones = np.logical_or(terminated, truncated).reshape(num_envs, 1).astype(np.float32)
+
+                for k in obs_keys:
+                    rollout[k].append(next_obs[k])
+                rollout["dones"].append(dones)
+                rollout["values"].append(values_np)
+                rollout["actions"].append(actions_np)
+                rollout["logprobs"].append(logprobs_np)
+                rollout["rewards"].append(rewards)
+                next_obs = prepare_obs(obs, cnn_keys=cnn_keys, num_envs=num_envs)
+
+                if cfg.metric.log_level > 0 and "final_info" in info:
+                    ep = info["final_info"].get("episode")
+                    if ep is not None:
+                        for i in np.nonzero(ep.get("_r", []))[0]:
+                            aggregator.update("Rewards/rew_avg", float(ep["r"][i]))
+                            aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
+                            print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep['r'][i]}")
+
+        local_data = {k: np.stack(v, axis=0) for k, v in rollout.items()}
+        next_values = np.asarray(player.get_values(next_obs))
+        returns, advantages = gae_fn(
+            jnp.asarray(local_data["rewards"]),
+            jnp.asarray(local_data["values"]),
+            jnp.asarray(local_data["dones"]),
+            jnp.asarray(next_values),
+        )
+        local_data["returns"] = np.asarray(returns)
+        local_data["advantages"] = np.asarray(advantages)
+        flat = {k: v.reshape(v.shape[0] * v.shape[1], *v.shape[2:]) for k, v in local_data.items()}
+
+        # ship the rollout to the trainers (reference scatter, :297-302)
+        broadcast_object(flat, src=0)
+        # receive the updated params (+ metrics, + opt state when
+        # checkpointing) back from trainer rank 1 (reference :304-308)
+        payload = broadcast_object(None, src=1)
+        player.params = jax.device_put(payload["params"])
+
+        if cfg.metric.log_level > 0:
+            aggregator.update("Loss/policy_loss", float(payload["metrics"][0]))
+            aggregator.update("Loss/value_loss", float(payload["metrics"][1]))
+            aggregator.update("Loss/entropy_loss", float(payload["metrics"][2]))
+            if policy_step - last_log >= cfg.metric.log_every or update == num_updates:
+                logger.log_metrics(aggregator.compute(), policy_step)
+                aggregator.reset()
+                timer.reset()
+                last_log = policy_step
+
+        if update in ckpt_updates:
+            ckpt_state = {
+                "agent": payload["params"],
+                "opt_state": payload["opt_state"],
+                "update": update,
+                "batch_size": int(cfg.algo.per_rank_batch_size) * len(trainer_devs),
+                "last_log": last_log,
+                "last_checkpoint": policy_step,
+                "rng_key": jax.device_get(key),
+            }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_0.ckpt")
+            fabric.call("on_checkpoint_player", ckpt_path=ckpt_path, state=ckpt_state)
+
+    envs.close()
+    if cfg.algo.run_test:
+        test(player, fabric, cfg, log_dir)
+    logger.finalize()
+
+
+def _trainer(fabric, cfg):
+    # join the player's log-dir broadcast (utils/logger.py get_log_dir is a
+    # collective over every process — the reference's rank-wide log-dir
+    # broadcast, logger.py:83-88)
+    get_log_dir(cfg)
+    num_envs, rollout_steps, trainer_devs, n_global, policy_steps_per_update, num_updates = _common_setup(
+        fabric, cfg
+    )
+    ckpt_updates = _ckpt_schedule(cfg, num_updates, policy_steps_per_update)
+    tfabric = SubMeshFabric(fabric, trainer_devs)
+    n_local = n_global // tfabric.world_size
+
+    observation_space, action_space = probe_spaces(cfg)
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+
+    agent, params = build_agent(tfabric, actions_dim, is_continuous, cfg, observation_space, None)
+
+    num_minibatches = max(1, n_local // int(cfg.algo.per_rank_batch_size))
+    opt_cfg = dict(cfg.algo.optimizer.to_dict() if hasattr(cfg.algo.optimizer, "to_dict") else cfg.algo.optimizer)
+    if cfg.algo.max_grad_norm and float(cfg.algo.max_grad_norm) > 0:
+        opt_cfg["max_grad_norm"] = float(cfg.algo.max_grad_norm)
+    if cfg.algo.anneal_lr:
+        steps_per_update = int(cfg.algo.update_epochs) * num_minibatches
+        opt_cfg["schedule"] = optax.linear_schedule(
+            float(opt_cfg.get("lr", 1e-3)), 0.0, num_updates * steps_per_update
+        )
+    tx = instantiate(opt_cfg)
+    opt_state = tfabric.replicate(tx.init(jax.device_get(params)))
+
+    train_fn = make_train_fn(tfabric, agent, tx, cfg, obs_keys, n_local)
+
+    clip_coef = float(cfg.algo.clip_coef)
+    ent_coef = float(cfg.algo.ent_coef)
+    initial_clip_coef, initial_ent_coef = clip_coef, ent_coef
+    key = jax.random.PRNGKey(int(cfg.seed) + jax.process_index())
+
+    # this trainer process's slice of the global rollout: the blocks of the
+    # devices it hosts (reference chunk scatter, :297-302)
+    my_dev_idx = [i for i, d in enumerate(trainer_devs) if d.process_index == jax.process_index()]
+
+    for update in range(1, num_updates + 1):
+        flat = broadcast_object(None, src=0)
+        local_rows = np.concatenate([np.arange(i * n_local, (i + 1) * n_local) for i in my_dev_idx])
+        local_flat = {k: v[local_rows] for k, v in flat.items()}
+        data = tfabric.make_global(local_flat, (tfabric.data_axis,))
+
+        with timer("Time/train_time"):
+            key, train_key = jax.random.split(key)
+            params, opt_state, metrics = train_fn(
+                params,
+                opt_state,
+                data,
+                train_key,
+                jnp.float32(clip_coef),
+                jnp.float32(ent_coef),
+            )
+            metrics = np.asarray(jax.device_get(metrics))
+
+        payload = None
+        if jax.process_index() == 1:
+            payload = {"params": jax.device_get(params), "metrics": metrics, "opt_state": None}
+            if update in ckpt_updates:
+                payload["opt_state"] = jax.device_get(opt_state)
+        broadcast_object(payload, src=1)
+
+        if cfg.algo.anneal_clip_coef:
+            clip_coef = polynomial_decay(
+                update, initial=initial_clip_coef, final=0.0, max_decay_steps=num_updates, power=1.0
+            )
+        if cfg.algo.anneal_ent_coef:
+            ent_coef = polynomial_decay(
+                update, initial=initial_ent_coef, final=0.0, max_decay_steps=num_updates, power=1.0
+            )

@@ -1,2 +1,3 @@
 from sheeprl_tpu.algos.sac import sac  # noqa: F401  (registers the algorithm)
+from sheeprl_tpu.algos.sac import sac_decoupled  # noqa: F401
 from sheeprl_tpu.algos.sac import evaluate  # noqa: F401  (registers the evaluation)

@@ -193,7 +193,7 @@ def build_agent(
             num_critics=n_critics,
         )
         agent.target_critic_params = fabric.replicate(jax.tree.map(jnp.asarray, agent_state["target_critics"]))
-        agent.log_alpha = jnp.asarray(agent_state["log_alpha"])
+        agent.log_alpha = fabric.replicate(jnp.asarray(agent_state["log_alpha"]))
     else:
         actor_params = actor.init(k_actor, dummy_obs)
         critic_params = jax.vmap(lambda k: critic.init(k, dummy_obs, dummy_act))(jnp.stack(k_critics))

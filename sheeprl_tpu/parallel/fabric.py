@@ -159,11 +159,30 @@ class Fabric:
                 "distributed coordinator is set but num_processes/process_id are not — set "
                 "SHEEPRL_TPU_NUM_PROCESSES (> 1) and SHEEPRL_TPU_PROCESS_ID on every host"
             )
-        if jax.process_count() == 1:
+        # NOTE: do not probe jax.process_count() here — it initializes the
+        # backend, after which distributed init is impossible; initialize
+        # eagerly and tolerate an already-connected process group
+        try:
             jax.distributed.initialize(
                 coordinator_address=coordinator,
                 num_processes=num_processes,
                 process_id=process_id,
+            )
+        except RuntimeError as e:
+            # jax raises "distributed.initialize should only be called once"
+            # on re-init and "must be called before any JAX computations" when
+            # the caller initialized the backend first (e.g. an external
+            # launcher already connected the process group)
+            msg = str(e).lower()
+            if not any(s in msg for s in ("already", "only be called once", "must be called before")):
+                raise
+        # tolerating the error is only safe when a process group actually
+        # exists: otherwise every host would silently train alone as rank 0
+        if jax.process_count() != num_processes:
+            raise RuntimeError(
+                f"distributed init requested {num_processes} processes but the JAX backend sees "
+                f"{jax.process_count()} — initialize jax.distributed before any JAX computation "
+                "(or let Fabric do it by constructing it first)"
             )
 
     # ------------------------------------------------------------------ #

@@ -1,0 +1,93 @@
+"""Sub-mesh fabric for heterogeneous process roles (reference:
+the decoupled player/trainer topology, sheeprl/algos/ppo/ppo_decoupled.py:645-669).
+
+The reference splits ranks into a player (rank 0) and a trainer DDP group
+(ranks 1..N-1, ``optimization_pg``). The TPU-native counterpart: the trainer
+processes form their OWN ``jax.sharding.Mesh`` over their devices — XLA
+collectives among trainers ride ICI/DCN exactly like the reference's
+process-group NCCL — while the player never enters that mesh and exchanges
+rollouts/params over the host-object plane (``parallel.collectives``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class SubMeshFabric:
+    """A Fabric-like handle over an explicit device subset. Exposes the
+    surface the fused train-step builders consume (``mesh``, ``data_axis``,
+    ``world_size``, ``precision``, ``replicate``, ``make_global``,
+    ``local_device_count``) so e.g. ``ppo.make_train_fn`` runs unchanged on a
+    trainer-only mesh."""
+
+    def __init__(self, base: Any, devices: Sequence[jax.Device], data_axis: str = "data") -> None:
+        self.base = base
+        self.devices = list(devices)
+        self.mesh = Mesh(np.asarray(self.devices), (data_axis,))
+        self.data_axis = data_axis
+        self.precision = base.precision
+        self._process_ids = sorted({d.process_index for d in self.devices})
+
+    @property
+    def world_size(self) -> int:
+        return len(self.devices)
+
+    @property
+    def num_processes(self) -> int:
+        return len(self._process_ids)
+
+    @property
+    def local_device_count(self) -> int:
+        pid = jax.process_index()
+        return len([d for d in self.devices if d.process_index == pid])
+
+    @property
+    def is_participant(self) -> bool:
+        return jax.process_index() in self._process_ids
+
+    def sharding(self, *spec: Any) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicate(self, tree: Any) -> Any:
+        return self.make_global(tree, P())
+
+    def make_global(self, tree: Any, spec: Any) -> Any:
+        """Assemble per-process local blocks into a global array over THIS
+        mesh (the trainer group's DistributedSampler equivalent)."""
+        sharding = NamedSharding(self.mesh, spec if isinstance(spec, P) else P(*spec))
+        if self.num_processes == 1:
+            return jax.device_put(tree, sharding)
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)), tree
+        )
+
+
+class LocalFabric:
+    """Single-process fabric shim for a role that never enters a mesh (the
+    decoupled PLAYER): precision from the base fabric, plain device_put
+    replication onto the local default device."""
+
+    def __init__(self, base: Any) -> None:
+        self.precision = base.precision
+
+    @staticmethod
+    def replicate(tree: Any) -> Any:
+        return jax.device_put(tree)
+
+
+def probe_spaces(cfg: Any):
+    """Read the observation/action spaces without keeping an env (the
+    decoupled TRAINER owns no environments; the reference ships agent args
+    from the player instead, ppo_decoupled.py:121-125)."""
+    from sheeprl_tpu.envs import make_env
+
+    probe = make_env(cfg, cfg.seed, 0, None, "train", vector_env_idx=0)()
+    observation_space = probe.observation_space
+    action_space = probe.action_space
+    probe.close()
+    return observation_space, action_space

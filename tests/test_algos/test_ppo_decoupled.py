@@ -1,0 +1,95 @@
+"""Decoupled PPO 2-process test (reference: tests/test_algos/test_algos.py::
+test_ppo_decoupled, which launches 2 gloo ranks).
+
+Spawns two real processes connected via ``jax.distributed`` on the CPU
+backend: process 0 plays (owns the envs, ships the rollout), process 1
+trains (fused PPO update on its own trainer mesh) and ships the params
+back. Also exercises the host-object collectives cross-process — the
+multi-process path that the in-process 8-device mesh tests cannot reach.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+RUNNER = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=os.environ["TEST_COORD"],
+    num_processes=int(os.environ["TEST_NPROC"]),
+    process_id=int(os.environ["TEST_PID"]),
+)
+from sheeprl_tpu.cli import run
+run(sys.argv[1:])
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_ppo_decoupled_two_process(tmp_path):
+    port = _free_port()
+    args = [
+        "exp=ppo_decoupled",
+        "env=dummy",
+        "env.id=dummy_discrete",
+        # forked AsyncVectorEnv workers inherit the jax.distributed client and
+        # wedge its shutdown barrier; the decoupled topology drives sync envs
+        "env.sync_env=True",
+        "dry_run=True",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "algo.rollout_steps=8",
+        "algo.per_rank_batch_size=4",
+        "algo.update_epochs=2",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.encoder.cnn_features_dim=16",
+        "algo.encoder.mlp_features_dim=8",
+        "algo.mlp_keys.encoder=[state]",
+        "env.num_envs=2",
+        "algo.run_test=True",
+        "checkpoint.save_last=True",
+        "metric.log_level=1",
+        f"log_base_dir={tmp_path}/logs",
+    ]
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("SHEEPRL_TPU_COORDINATOR", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["TEST_COORD"] = f"127.0.0.1:{port}"
+        env["TEST_NPROC"] = "2"
+        env["TEST_PID"] = str(pid)
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (os.path.dirname(os.path.dirname(os.path.dirname(__file__))), env.get("PYTHONPATH")) if p
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", RUNNER, *args],
+                env=env,
+                cwd=str(tmp_path),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=540)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
+
+    ckpts = []
+    for root, _, files in os.walk(tmp_path):
+        ckpts += [os.path.join(root, f) for f in files if f.endswith(".ckpt")]
+    assert ckpts, "player did not write a checkpoint from the trainer state"
