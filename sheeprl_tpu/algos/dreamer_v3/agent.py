@@ -502,6 +502,58 @@ def _actor_unimix(logits: Array, unimix: float) -> Array:
     return logits
 
 
+class MinedojoActor(Actor):
+    """Actor whose discrete heads honor MineDojo's action masks at play time
+    (reference MinedojoActor, agent.py:848-932): the action-type head is
+    masked directly; the craft head only when the sampled action type is
+    CRAFT (15); the item head by the equip/place mask for action types 16-17
+    and the destroy mask for 18. Selected via ``algo.actor.cls``."""
+
+
+def sample_minedojo_actions(
+    actor: Actor,
+    params: Any,
+    state: Array,
+    key: Array,
+    mask: Optional[Dict[str, Array]],
+    greedy: bool = False,
+) -> Array:
+    """Masked sequential sampling of the three MineDojo heads — the
+    reference's per-(t, b) Python loops (agent.py:903-929) become vectorized
+    ``jnp.where`` masking."""
+    heads = actor.apply(params, state)
+    neg_inf = jnp.asarray(-jnp.inf, jnp.float32)
+    keys = jax.random.split(key, len(heads))
+
+    logits0 = _actor_unimix(heads[0], actor.unimix)
+    if mask is not None:
+        logits0 = jnp.where(mask["mask_action_type"].astype(bool), logits0, neg_inf)
+    d0 = OneHotCategoricalStraightThrough(logits=logits0)
+    a0 = d0.mode if greedy else d0.rsample(seed=keys[0])
+    func = jnp.argmax(a0, axis=-1)  # composite action type
+
+    logits1 = _actor_unimix(heads[1], actor.unimix)
+    if mask is not None:
+        is_craft = (func == 15)[..., None]
+        logits1 = jnp.where(jnp.logical_and(is_craft, ~mask["mask_craft_smelt"].astype(bool)), neg_inf, logits1)
+    d1 = OneHotCategoricalStraightThrough(logits=logits1)
+    a1 = d1.mode if greedy else d1.rsample(seed=keys[1])
+
+    logits2 = _actor_unimix(heads[2], actor.unimix)
+    if mask is not None:
+        is_equip_place = jnp.logical_or(func == 16, func == 17)[..., None]
+        is_destroy = (func == 18)[..., None]
+        logits2 = jnp.where(
+            jnp.logical_and(is_equip_place, ~mask["mask_equip_place"].astype(bool)), neg_inf, logits2
+        )
+        logits2 = jnp.where(
+            jnp.logical_and(is_destroy, ~mask["mask_destroy"].astype(bool)), neg_inf, logits2
+        )
+    d2 = OneHotCategoricalStraightThrough(logits=logits2)
+    a2 = d2.mode if greedy else d2.rsample(seed=keys[2])
+    return jnp.concatenate([a0, a1, a2], axis=-1)
+
+
 def sample_actor_actions(
     actor: Actor, params: Any, state: Array, key: Array, greedy: bool = False
 ) -> Array:
@@ -599,7 +651,15 @@ class PlayerDV3:
             action = sample_actor_actions(actor, actor_params, latent, k2, greedy)
             return action, h, z
 
+        def _step_masked(wm_params, actor_params, obs, h, z, prev_action, key, mask, greedy):
+            k1, k2 = jax.random.split(key)
+            z, h = wm.apply(wm_params, z, h, prev_action, obs, k1, method=WorldModel.observe_step)
+            latent = jnp.concatenate([z, h], axis=-1)
+            action = sample_minedojo_actions(actor, actor_params, latent, k2, mask, greedy)
+            return action, h, z
+
         self._step = jax.jit(_step, static_argnames="greedy")
+        self._step_masked = jax.jit(_step_masked, static_argnames="greedy")
         self._initial = jax.jit(
             lambda p, n: wm.apply(p, (n,), method=WorldModel.initial_state), static_argnums=1
         )
@@ -615,10 +675,23 @@ class PlayerDV3:
             self.z[idx] = z0[idx]
             self.actions[idx] = 0.0
 
-    def get_actions(self, obs: Dict[str, Array], key: Array, greedy: bool = False) -> Array:
-        action, h, z = self._step(
-            self.wm_params, self.actor_params, obs, self.h, self.z, self.actions, key, greedy
-        )
+    def get_actions(
+        self,
+        obs: Dict[str, Array],
+        key: Array,
+        greedy: bool = False,
+        mask: Optional[Dict[str, Array]] = None,
+    ) -> Array:
+        # only the MinedojoActor honors masks — the base Actor ignores them,
+        # matching the reference's forward signatures (agent.py:783, :882)
+        if mask and isinstance(self.actor, MinedojoActor):
+            action, h, z = self._step_masked(
+                self.wm_params, self.actor_params, obs, self.h, self.z, self.actions, key, mask, greedy
+            )
+        else:
+            action, h, z = self._step(
+                self.wm_params, self.actor_params, obs, self.h, self.z, self.actions, key, greedy
+            )
         # np.array: device_get hands back read-only buffers, but init_states
         # mutates these per-env on episode resets
         self.actions, self.h, self.z = (np.array(x) for x in jax.device_get((action, h, z)))
@@ -681,7 +754,10 @@ def build_agent(
         dtype=compute_dtype,
     )
 
-    actor = Actor(
+    actor_cls = (
+        MinedojoActor if "minedojo" in str(cfg["algo"]["actor"].get("cls", "")).lower() else Actor
+    )
+    actor = actor_cls(
         latent_state_size=wm.latent_state_size,
         actions_dim=tuple(actions_dim),
         is_continuous=bool(is_continuous),

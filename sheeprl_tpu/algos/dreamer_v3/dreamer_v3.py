@@ -422,7 +422,9 @@ def main(fabric, cfg: Dict[str, Any]):
         seed=cfg.seed,
     )
     if cfg.checkpoint.resume_from and cfg.buffer.checkpoint:
-        rb = state["rb"]
+        from sheeprl_tpu.utils.checkpoint import select_buffer
+
+        rb = select_buffer(state["rb"], rank, num_processes)
 
     # EMA update for the target critic (reference dreamer_v3.py:670-675)
     @jax.jit
@@ -488,7 +490,8 @@ def main(fabric, cfg: Dict[str, Any]):
             else:
                 key, action_key = jax.random.split(key)
                 prepared = prepare_obs(obs, cnn_keys=cnn_keys, num_envs=num_envs)
-                actions = player.get_actions(prepared, action_key)
+                mask = {k: v for k, v in prepared.items() if k.startswith("mask")}
+                actions = player.get_actions(prepared, action_key, mask=mask or None)
                 if is_continuous:
                     real_actions = actions
                 else:

@@ -77,7 +77,11 @@ def test(
     while not done:
         key, sub = jax.random.split(key)
         torch_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder)
-        actions = player.get_actions(torch_obs, sub, greedy=greedy)
+        # MineDojo-style action masks ride the observation dict
+        # (reference utils.py:105-108); only the DV3 player consumes them
+        mask = {k: v for k, v in torch_obs.items() if k.startswith("mask")}
+        kwargs = {"mask": mask} if mask else {}
+        actions = player.get_actions(torch_obs, sub, greedy=greedy, **kwargs)
         if player.actor.is_continuous:
             real_actions = actions[0]
         else:
@@ -93,3 +97,12 @@ def test(
         fabric.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
     player.num_envs = saved_num_envs
     env.close()
+
+
+def log_models_from_checkpoint(fabric, cfg, state, artifacts_dir):
+    """Pickle this algorithm's registered sub-models from a checkpoint
+    (reference per-algo log_models_from_checkpoint; shared body in
+    utils/model_manager.py)."""
+    from sheeprl_tpu.utils.model_manager import log_models_from_checkpoint as _log
+
+    return _log(state, sorted(MODELS_TO_REGISTER), artifacts_dir)

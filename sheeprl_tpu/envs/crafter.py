@@ -1,0 +1,71 @@
+"""Crafter adapter (reference: sheeprl/envs/crafter.py:17-66).
+
+Wraps ``crafter.Env`` (old gym API) into a gymnasium env with a Dict
+observation space holding the pixel stream under ``rgb``."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_CRAFTER_AVAILABLE
+
+if not _IS_CRAFTER_AVAILABLE:
+    raise ModuleNotFoundError(
+        "crafter is not installed; install it to use the Crafter environments"
+    )
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import crafter
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+
+class CrafterWrapper(gym.Wrapper):
+    def __init__(self, id: str, screen_size: Union[Sequence[int], int], seed: Optional[int] = None) -> None:
+        if id not in {"crafter_reward", "crafter_nonreward"}:
+            raise ValueError(f"unknown crafter id {id!r}")
+        if isinstance(screen_size, int):
+            screen_size = (screen_size, screen_size)
+
+        env = crafter.Env(size=tuple(screen_size), seed=seed, reward=(id == "crafter_reward"))
+        super().__init__(env)
+        self.observation_space = spaces.Dict(
+            {
+                "rgb": spaces.Box(
+                    self.env.observation_space.low,
+                    self.env.observation_space.high,
+                    self.env.observation_space.shape,
+                    self.env.observation_space.dtype,
+                )
+            }
+        )
+        self.action_space = spaces.Discrete(self.env.action_space.n)
+        self.reward_range = self.env.reward_range or (-np.inf, np.inf)
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+        self._render_mode = "rgb_array"
+        self._metadata = {"render_fps": 30}
+
+    @property
+    def render_mode(self) -> Optional[str]:
+        return self._render_mode
+
+    def step(self, action: Any) -> Tuple[Any, float, bool, bool, Dict[str, Any]]:
+        obs, reward, done, info = self.env.step(action)
+        # crafter signals time-limit ends with a non-zero discount
+        terminated = done and info["discount"] == 0
+        truncated = done and info["discount"] != 0
+        return {"rgb": obs}, reward, terminated, truncated, info
+
+    def reset(
+        self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
+    ) -> Tuple[Any, Dict[str, Any]]:
+        self.env._seed = seed
+        obs = self.env.reset()
+        return {"rgb": obs}, {}
+
+    def render(self):
+        return self.env.render()
+
+    def close(self) -> None:
+        return

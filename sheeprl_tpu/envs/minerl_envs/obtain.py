@@ -1,0 +1,209 @@
+"""Custom MineRL Obtain specs (reference: sheeprl/envs/minerl_envs/obtain.py,
+adapted from github.com/minerllabs/minerl)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_MINERL_AVAILABLE
+
+if not _IS_MINERL_AVAILABLE:
+    raise ModuleNotFoundError("minerl==0.4.4 is not installed; install it to use the MineRL environments")
+
+from typing import Dict, List, Union
+
+from minerl.herobraine.hero import handlers
+from minerl.herobraine.hero.handler import Handler
+
+from sheeprl_tpu.envs.minerl_envs.backend import CustomSimpleEmbodimentEnvSpec
+
+none = "none"
+other = "other"
+
+_OBTAIN_REWARD_SCHEDULE = [
+    dict(type="log", amount=1, reward=1),
+    dict(type="planks", amount=1, reward=2),
+    dict(type="stick", amount=1, reward=4),
+    dict(type="crafting_table", amount=1, reward=4),
+    dict(type="wooden_pickaxe", amount=1, reward=8),
+    dict(type="cobblestone", amount=1, reward=16),
+    dict(type="furnace", amount=1, reward=32),
+    dict(type="stone_pickaxe", amount=1, reward=32),
+    dict(type="iron_ore", amount=1, reward=64),
+    dict(type="iron_ingot", amount=1, reward=128),
+    dict(type="iron_pickaxe", amount=1, reward=256),
+]
+
+
+def _snake_to_camel(word: str) -> str:
+    return "".join(x.capitalize() or "_" for x in word.split("_"))
+
+
+class CustomObtain(CustomSimpleEmbodimentEnvSpec):
+    """Item-hierarchy task: the agent is rewarded along the tool progression
+    toward ``target_item`` (dense = every collection, sparse = first only)."""
+
+    def __init__(
+        self,
+        target_item,
+        dense,
+        reward_schedule: List[Dict[str, Union[str, int, float]]],
+        *args,
+        max_episode_steps=None,
+        **kwargs,
+    ):
+        self.target_item = target_item
+        self.dense = dense
+        self.reward_schedule = reward_schedule
+        suffix = _snake_to_camel(target_item) + ("Dense" if dense else "")
+        super().__init__(
+            *args, name=f"CustomMineRLObtain{suffix}-v0", max_episode_steps=max_episode_steps, **kwargs
+        )
+
+    def create_observables(self) -> List[Handler]:
+        return super().create_observables() + [
+            handlers.FlatInventoryObservation(
+                [
+                    "dirt",
+                    "coal",
+                    "torch",
+                    "log",
+                    "planks",
+                    "stick",
+                    "crafting_table",
+                    "wooden_axe",
+                    "wooden_pickaxe",
+                    "stone",
+                    "cobblestone",
+                    "furnace",
+                    "stone_axe",
+                    "stone_pickaxe",
+                    "iron_ore",
+                    "iron_ingot",
+                    "iron_axe",
+                    "iron_pickaxe",
+                ]
+            ),
+            handlers.EquippedItemObservation(
+                items=[
+                    "air",
+                    "wooden_axe",
+                    "wooden_pickaxe",
+                    "stone_axe",
+                    "stone_pickaxe",
+                    "iron_axe",
+                    "iron_pickaxe",
+                    other,
+                ],
+                _default="air",
+                _other=other,
+            ),
+        ]
+
+    def create_actionables(self):
+        return super().create_actionables() + [
+            handlers.PlaceBlock(
+                [none, "dirt", "stone", "cobblestone", "crafting_table", "furnace", "torch"],
+                _other=none,
+                _default=none,
+            ),
+            handlers.EquipAction(
+                [none, "air", "wooden_axe", "wooden_pickaxe", "stone_axe", "stone_pickaxe", "iron_axe", "iron_pickaxe"],
+                _other=none,
+                _default=none,
+            ),
+            handlers.CraftAction([none, "torch", "stick", "planks", "crafting_table"], _other=none, _default=none),
+            handlers.CraftNearbyAction(
+                [
+                    none,
+                    "wooden_axe",
+                    "wooden_pickaxe",
+                    "stone_axe",
+                    "stone_pickaxe",
+                    "iron_axe",
+                    "iron_pickaxe",
+                    "furnace",
+                ],
+                _other=none,
+                _default=none,
+            ),
+            handlers.SmeltItemNearby([none, "iron_ingot", "coal"], _other=none, _default=none),
+        ]
+
+    def create_rewardables(self) -> List[Handler]:
+        reward_handler = handlers.RewardForCollectingItems if self.dense else handlers.RewardForCollectingItemsOnce
+        return [reward_handler(self.reward_schedule if self.reward_schedule else {self.target_item: 1})]
+
+    def create_agent_start(self) -> List[Handler]:
+        return super().create_agent_start()
+
+    def create_agent_handlers(self) -> List[Handler]:
+        return [handlers.AgentQuitFromPossessingItem([dict(type="diamond", amount=1)])]
+
+    def create_server_world_generators(self) -> List[Handler]:
+        return [handlers.DefaultWorldGenerator(force_reset=True)]
+
+    def create_server_quit_producers(self) -> List[Handler]:
+        return [handlers.ServerQuitWhenAnyAgentFinishes()]
+
+    def create_server_decorators(self) -> List[Handler]:
+        return []
+
+    def create_server_initial_conditions(self) -> List[Handler]:
+        return [
+            handlers.TimeInitialCondition(start_time=6000, allow_passage_of_time=True),
+            handlers.SpawningInitialCondition(allow_spawning=True),
+        ]
+
+    def is_from_folder(self, folder: str):
+        return folder == f"o_{self.target_item}"
+
+    def get_docstring(self):
+        return f"Obtain {self.target_item} through the item hierarchy."
+
+    def determine_success_from_rewards(self, rewards: list) -> bool:
+        rewards = set(rewards)
+        max_missing = round(len(self.reward_schedule) * 0.1)
+        reward_values = [s["reward"] for s in self.reward_schedule]
+        return len(rewards.intersection(reward_values)) >= len(reward_values) - max_missing
+
+
+class CustomObtainDiamond(CustomObtain):
+    def __init__(self, dense, *args, **kwargs):
+        # the time limit is enforced by the gym wrapper (truncation vs
+        # termination must stay distinguishable)
+        kwargs.pop("max_episode_steps", None)
+        super().__init__(
+            *args,
+            target_item="diamond",
+            dense=dense,
+            reward_schedule=_OBTAIN_REWARD_SCHEDULE + [dict(type="diamond", amount=1, reward=1024)],
+            max_episode_steps=None,
+            **kwargs,
+        )
+
+    def is_from_folder(self, folder: str) -> bool:
+        return folder == "o_dia"
+
+    def get_docstring(self):
+        return "Obtain a diamond from scratch on a random survival map."
+
+
+class CustomObtainIronPickaxe(CustomObtain):
+    def __init__(self, dense, *args, **kwargs):
+        kwargs.pop("max_episode_steps", None)
+        super().__init__(
+            *args,
+            target_item="iron_pickaxe",
+            dense=dense,
+            reward_schedule=list(_OBTAIN_REWARD_SCHEDULE),
+            max_episode_steps=None,
+            **kwargs,
+        )
+
+    def create_agent_handlers(self):
+        return [handlers.AgentQuitFromCraftingItem([dict(type="iron_pickaxe", amount=1)])]
+
+    def is_from_folder(self, folder: str) -> bool:
+        return folder == "o_iron"
+
+    def get_docstring(self):
+        return "Craft an iron pickaxe from scratch on a random survival map."
