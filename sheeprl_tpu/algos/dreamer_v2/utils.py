@@ -40,3 +40,12 @@ def test(player: Any, fabric: Any, cfg: Dict[str, Any], log_dir: str, test_name:
     """Frozen-policy evaluation episode (reference dv2/utils.py:122-168) —
     the player API matches Dreamer-V3's, so the harness is shared."""
     _dv3_test(player, fabric, cfg, log_dir, test_name=test_name, greedy=greedy)
+
+
+def log_models_from_checkpoint(fabric, cfg, state, artifacts_dir):
+    """Pickle this algorithm's registered sub-models from a checkpoint
+    (reference per-algo log_models_from_checkpoint; shared body in
+    utils/model_manager.py)."""
+    from sheeprl_tpu.utils.model_manager import log_models_from_checkpoint as _log
+
+    return _log(state, sorted(MODELS_TO_REGISTER), artifacts_dir)

@@ -1,12 +1,13 @@
-"""A2C helpers (reference: sheeprl/algos/a2c/utils.py)."""
+"""DroQ helpers (reference: sheeprl/algos/droq/utils.py — DroQ shares SAC's
+observation/test plumbing and registers the same single ``agent`` model)."""
 
 from __future__ import annotations
 
-AGGREGATOR_KEYS = {"Rewards/rew_avg", "Game/ep_len_avg", "Loss/value_loss", "Loss/policy_loss"}
+from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test  # noqa: F401
+
 MODELS_TO_REGISTER = {"agent"}
 
-# vector-only observation prep and greedy test episode are identical to PPO's
-from sheeprl_tpu.algos.ppo.utils import prepare_obs, test  # noqa: E402,F401
+__all__ = ["AGGREGATOR_KEYS", "MODELS_TO_REGISTER", "prepare_obs", "test"]
 
 
 def log_models_from_checkpoint(fabric, cfg, state, artifacts_dir):

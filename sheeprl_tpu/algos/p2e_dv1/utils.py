@@ -39,3 +39,12 @@ AGGREGATOR_KEYS = {
 MODELS_TO_REGISTER = {"world_model", "ensembles", "actor_exploration", "critic_exploration", "actor_task", "critic_task"}
 
 __all__ = ["AGGREGATOR_KEYS", "MODELS_TO_REGISTER", "prepare_obs", "test"]
+
+
+def log_models_from_checkpoint(fabric, cfg, state, artifacts_dir):
+    """Pickle this algorithm's registered sub-models from a checkpoint
+    (reference per-algo log_models_from_checkpoint; shared body in
+    utils/model_manager.py)."""
+    from sheeprl_tpu.utils.model_manager import log_models_from_checkpoint as _log
+
+    return _log(state, sorted(MODELS_TO_REGISTER), artifacts_dir)

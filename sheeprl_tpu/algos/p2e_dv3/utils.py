@@ -68,3 +68,12 @@ AGGREGATOR_KEYS_FINETUNING = {
 # Both entrypoints share this module's AGGREGATOR_KEYS for the CLI's metric
 # whitelist, so the union must cover the finetuning names too.
 AGGREGATOR_KEYS |= AGGREGATOR_KEYS_FINETUNING
+
+
+def log_models_from_checkpoint(fabric, cfg, state, artifacts_dir):
+    """Pickle this algorithm's registered sub-models from a checkpoint
+    (reference per-algo log_models_from_checkpoint; shared body in
+    utils/model_manager.py)."""
+    from sheeprl_tpu.utils.model_manager import log_models_from_checkpoint as _log
+
+    return _log(state, sorted(MODELS_TO_REGISTER), artifacts_dir)

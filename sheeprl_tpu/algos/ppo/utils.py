@@ -81,3 +81,12 @@ def normalize_obs(
     """Reference utils.py normalize_obs — here a passthrough selector: pixel
     normalization happens inside the agent module (agent.py CNNEncoder)."""
     return {k: obs[k] for k in obs_keys}
+
+
+def log_models_from_checkpoint(fabric, cfg, state, artifacts_dir):
+    """Pickle this algorithm's registered sub-models from a checkpoint
+    (reference per-algo log_models_from_checkpoint; shared body in
+    utils/model_manager.py)."""
+    from sheeprl_tpu.utils.model_manager import log_models_from_checkpoint as _log
+
+    return _log(state, sorted(MODELS_TO_REGISTER), artifacts_dir)

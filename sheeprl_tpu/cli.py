@@ -206,6 +206,102 @@ def evaluation(args: Optional[List[str]] = None) -> None:
     eval_algorithm(cfg)
 
 
+def registration(args: Optional[List[str]] = None) -> None:
+    """``python -m sheeprl_tpu.cli_registration checkpoint_path=... [overrides]``
+    (reference cli.py:394-436 + sheeprl_model_manager.py): rebuild the run
+    config stored beside the checkpoint, pick the algorithm's
+    ``log_models_from_checkpoint``, and register the configured sub-models
+    with the model manager."""
+    import yaml
+
+    overrides = list(sys.argv[1:] if args is None else args)
+    kv = dict(o.split("=", 1) for o in overrides if "=" in o and not o.startswith(("+", "~")))
+    ckpt_path = kv.get("checkpoint_path")
+    if not ckpt_path:
+        raise ValueError("checkpoint_path=<file> is required")
+    cfg_path = os.path.join(os.path.dirname(os.path.dirname(ckpt_path)), "config.yaml")
+    with open(cfg_path) as f:
+        cfg = dotdict(yaml.safe_load(f))
+    cfg.checkpoint_path = ckpt_path
+    # the stored run may have trained with model_manager disabled; compose the
+    # algorithm's model-manager group so the registration targets exist
+    from sheeprl_tpu.config.compose import group_options
+
+    mm_name = cfg.algo.name
+    if mm_name not in group_options("model_manager"):
+        mm_name = "default"
+    cfg.model_manager = compose_model_manager_group(mm_name, cfg)
+    for k, v in kv.items():
+        if k == "checkpoint_path":
+            continue
+        node = cfg
+        parts = k.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, dotdict({})) if isinstance(node, dict) else node[p]
+        node[parts[-1]] = yaml.safe_load(v)
+
+    from sheeprl_tpu.parallel.fabric import Fabric
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+    from sheeprl_tpu.utils.model_manager import register_model_from_checkpoint
+
+    fabric = Fabric(devices=1, precision=str(cfg.fabric.get("precision", "fp32")))
+    state = load_checkpoint(ckpt_path)
+
+    algo_name = cfg.algo.name
+    if "decoupled" in algo_name:
+        algo_name = algo_name.replace("_decoupled", "")
+    if algo_name.startswith("p2e_dv"):
+        algo_name = "_".join(algo_name.split("_")[:2])
+    utils_module = importlib.import_module(f"sheeprl_tpu.algos.{algo_name}.utils")
+    register_model_from_checkpoint(fabric, cfg, state, utils_module.log_models_from_checkpoint)
+
+
+def compose_model_manager_group(name: str, cfg: dotdict) -> dotdict:
+    """Resolve ``configs/model_manager/<name>.yaml`` with interpolations
+    against the checkpoint's config (exp_name/env.id)."""
+    import yaml
+
+    from sheeprl_tpu.config.compose import _default_search_path, _find_config_file
+
+    merged: Dict[str, Any] = {}
+
+    def load(rel_name: str) -> None:
+        p = _find_config_file(os.path.join("model_manager", rel_name), _default_search_path())
+        with open(p) as f:
+            content = yaml.safe_load(f) or {}
+        for entry in content.pop("defaults", []) or []:
+            if isinstance(entry, str) and entry != "_self_":
+                load(entry)
+        _deep_merge(merged, content)
+
+    def _deep_merge(dst, src):
+        for k, v in src.items():
+            if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                _deep_merge(dst[k], v)
+            else:
+                dst[k] = v
+
+    load(name)
+
+    # resolve ${dotted.path} interpolations against the checkpoint's config
+    # with the composer's own resolver (the yamls use ${exp_name}/${env.id})
+    from sheeprl_tpu.config.compose import _resolve_value
+
+    root = dict(cfg)
+    root["model_manager"] = merged
+
+    def resolve(node: Any) -> Any:
+        if isinstance(node, dict):
+            return {k: resolve(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [resolve(v) for v in node]
+        return _resolve_value(root, node, ())
+
+    resolved = resolve(merged)
+    resolved["disabled"] = False
+    return dotdict(resolved)
+
+
 def available_agents() -> None:
     """Print the registry as a table (reference available_agents.py:7)."""
     try:

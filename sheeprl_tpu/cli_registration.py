@@ -1,0 +1,7 @@
+"""``python -m sheeprl_tpu.cli_registration checkpoint_path=...``
+(reference: sheeprl_model_manager.py)."""
+
+from sheeprl_tpu.cli import registration
+
+if __name__ == "__main__":
+    registration()

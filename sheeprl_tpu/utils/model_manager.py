@@ -1,0 +1,284 @@
+"""Model registry / manager (reference: sheeprl/utils/mlflow.py:35-427 and
+cli.py:394-436).
+
+Two backends behind the reference's ``AbstractModelManager`` interface:
+
+- :class:`LocalModelManager` — a file-backed registry (``registry.json`` +
+  per-version artifact copies). TPU pods usually run with zero external
+  services, so this is the default backend and what the tests exercise.
+- :class:`MlflowModelManager` — the reference's MLflow registry, import-gated
+  (models are logged as pickled param-tree artifacts instead of
+  ``mlflow.pytorch`` modules — the framework's models ARE pytrees).
+
+"Logging a model" = pickling one checkpoint sub-tree (params + metadata) to
+an artifact file; ``log_models_from_checkpoint`` is the shared per-algo hook
+(the reference defines one per algorithm over ``MODELS_TO_REGISTER``).
+"""
+
+from __future__ import annotations
+
+import getpass
+import json
+import os
+import pickle
+import shutil
+from abc import ABC, abstractmethod
+from datetime import datetime
+from typing import Any, Dict, Iterable, Literal, Optional
+
+from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+VERSION_MD_TEMPLATE = "## **Version {}**\n"
+DESCRIPTION_MD_TEMPLATE = "### Description: \n{}\n"
+
+
+class AbstractModelManager(ABC):
+    """The reference's model-manager interface (mlflow.py:35-72)."""
+
+    def __init__(self, fabric: Any) -> None:
+        self.fabric = fabric
+
+    @abstractmethod
+    def register_model(
+        self, model_location: str, model_name: str, description: Optional[str] = None, tags: Optional[Dict] = None
+    ) -> Any:
+        """Register a model artifact in the registry."""
+
+    @abstractmethod
+    def get_latest_version(self, model_name: str) -> Any:
+        """Get the latest registered version of a model."""
+
+    @abstractmethod
+    def transition_model(
+        self, model_name: str, version: int, stage: str, description: Optional[str] = None
+    ) -> Any:
+        """Move a model version to a new stage."""
+
+    @abstractmethod
+    def delete_model(self, model_name: str, version: int, description: Optional[str] = None) -> None:
+        """Delete a model version."""
+
+    @abstractmethod
+    def download_model(self, model_name: str, version: int, output_path: str) -> None:
+        """Copy a model version's artifact to ``output_path``."""
+
+
+def _author_and_date() -> str:
+    try:
+        author = getpass.getuser()
+    except Exception:
+        author = "unknown"
+    return f"**Author**: {author}\n**Date**: {datetime.now().strftime('%d/%m/%Y %H:%M:%S')}\n"
+
+
+class LocalModelManager(AbstractModelManager):
+    """File-backed registry: ``<registry_dir>/registry.json`` holds the
+    version metadata; artifacts are copied to
+    ``<registry_dir>/<model_name>/v<version>/``."""
+
+    def __init__(self, fabric: Any, registry_dir: str) -> None:
+        super().__init__(fabric)
+        self.registry_dir = registry_dir
+        os.makedirs(registry_dir, exist_ok=True)
+        self._index_path = os.path.join(registry_dir, "registry.json")
+
+    def _load_index(self) -> Dict[str, Any]:
+        if os.path.isfile(self._index_path):
+            with open(self._index_path) as f:
+                return json.load(f)
+        return {}
+
+    def _save_index(self, index: Dict[str, Any]) -> None:
+        with open(self._index_path, "w") as f:
+            json.dump(index, f, indent=2)
+
+    def register_model(
+        self, model_location: str, model_name: str, description: Optional[str] = None, tags: Optional[Dict] = None
+    ) -> Dict[str, Any]:
+        index = self._load_index()
+        versions = index.setdefault(model_name, [])
+        version = len(versions) + 1
+        dst_dir = os.path.join(self.registry_dir, model_name, f"v{version}")
+        os.makedirs(dst_dir, exist_ok=True)
+        dst = os.path.join(dst_dir, os.path.basename(model_location))
+        shutil.copy2(model_location, dst)
+        changelog = (
+            VERSION_MD_TEMPLATE.format(version)
+            + _author_and_date()
+            + DESCRIPTION_MD_TEMPLATE.format(description or "")
+        )
+        record = {
+            "version": version,
+            "artifact": dst,
+            "stage": "None",
+            "description": description or "",
+            "tags": tags or {},
+            "changelog": changelog,
+        }
+        versions.append(record)
+        self._save_index(index)
+        print(f"Registered model {model_name} with version {version}")
+        return record
+
+    def get_latest_version(self, model_name: str) -> Dict[str, Any]:
+        versions = self._load_index().get(model_name, [])
+        if not versions:
+            raise KeyError(f"no registered versions for model {model_name!r}")
+        return versions[-1]
+
+    def transition_model(
+        self, model_name: str, version: int, stage: str, description: Optional[str] = None
+    ) -> Dict[str, Any]:
+        index = self._load_index()
+        record = index[model_name][version - 1]
+        record["stage"] = stage
+        if description:
+            record["changelog"] += DESCRIPTION_MD_TEMPLATE.format(description)
+        self._save_index(index)
+        return record
+
+    def delete_model(self, model_name: str, version: int, description: Optional[str] = None) -> None:
+        index = self._load_index()
+        record = index[model_name][version - 1]
+        artifact_dir = os.path.dirname(record["artifact"])
+        if os.path.isdir(artifact_dir):
+            shutil.rmtree(artifact_dir)
+        record["stage"] = "Deleted"
+        record["artifact"] = None
+        self._save_index(index)
+
+    def download_model(self, model_name: str, version: int, output_path: str) -> None:
+        record = self._load_index()[model_name][version - 1]
+        if not record["artifact"]:
+            raise FileNotFoundError(f"model {model_name} v{version} was deleted")
+        os.makedirs(output_path, exist_ok=True)
+        shutil.copy2(record["artifact"], output_path)
+
+
+class MlflowModelManager(AbstractModelManager):
+    """MLflow-backed registry (reference MlflowModelManager,
+    mlflow.py:75-327). Artifacts are pickled param trees logged with
+    ``mlflow.log_artifact``."""
+
+    def __init__(self, fabric: Any, tracking_uri: str) -> None:
+        if not _IS_MLFLOW_AVAILABLE:
+            raise ModuleNotFoundError("mlflow is not installed; use the 'local' model-manager backend instead")
+        super().__init__(fabric)
+        import mlflow
+        from mlflow.tracking import MlflowClient
+
+        self.tracking_uri = tracking_uri
+        mlflow.set_tracking_uri(tracking_uri)
+        self._mlflow = mlflow
+        self.client = MlflowClient()
+
+    def register_model(
+        self, model_location: str, model_name: str, description: Optional[str] = None, tags: Optional[Dict] = None
+    ):
+        model_version = self._mlflow.register_model(model_uri=model_location, name=model_name, tags=tags)
+        registered_description = self.client.get_registered_model(model_name).description or ""
+        header = "# MODEL CHANGELOG\n" if model_version.version == "1" else ""
+        new_description = (
+            VERSION_MD_TEMPLATE.format(model_version.version)
+            + _author_and_date()
+            + DESCRIPTION_MD_TEMPLATE.format(description or "")
+        )
+        self.client.update_registered_model(model_name, header + registered_description + new_description)
+        self.client.update_model_version(
+            model_name, model_version.version, "# MODEL CHANGELOG\n" + new_description
+        )
+        return model_version
+
+    def get_latest_version(self, model_name: str):
+        latest = max(int(x.version) for x in self.client.get_latest_versions(model_name))
+        return self.client.get_model_version(model_name, latest)
+
+    def transition_model(
+        self, model_name: str, version: int, stage: str, description: Optional[str] = None
+    ):
+        self.client.transition_model_version_stage(model_name, str(version), stage)
+        if description:
+            self.client.update_model_version(
+                model_name, str(version), DESCRIPTION_MD_TEMPLATE.format(description)
+            )
+        return self.client.get_model_version(model_name, str(version))
+
+    def delete_model(self, model_name: str, version: int, description: Optional[str] = None) -> None:
+        self.client.delete_model_version(model_name, str(version))
+
+    def download_model(self, model_name: str, version: int, output_path: str) -> None:
+        from mlflow.artifacts import download_artifacts
+
+        version_info = self.client.get_model_version(model_name, str(version))
+        download_artifacts(artifact_uri=version_info.source, dst_path=output_path)
+
+
+def make_model_manager(fabric: Any, cfg: Dict[str, Any]) -> AbstractModelManager:
+    """Build the configured backend (``model_manager.backend``)."""
+    mm = cfg["model_manager"]
+    backend = str(mm.get("backend", "local")).lower()
+    if backend == "mlflow":
+        tracking_uri = mm.get("tracking_uri") or os.getenv("MLFLOW_TRACKING_URI")
+        if not tracking_uri:
+            raise ValueError(
+                "model_manager.backend=mlflow needs model_manager.tracking_uri or MLFLOW_TRACKING_URI"
+            )
+        return MlflowModelManager(fabric, tracking_uri)
+    if backend == "local":
+        return LocalModelManager(fabric, mm.get("registry_dir") or "models_registry")
+    raise ValueError(f"unknown model_manager backend {backend!r} (choose 'local' or 'mlflow')")
+
+
+def log_models_from_checkpoint(
+    state: Dict[str, Any], keys: Iterable[str], artifacts_dir: str
+) -> Dict[str, str]:
+    """Pickle each registered sub-model's checkpoint tree into
+    ``artifacts_dir`` (the shared body of every per-algo
+    ``log_models_from_checkpoint``; reference e.g.
+    dreamer_v3/utils.py:189-235). Keys nested under a top-level ``agent``
+    dict (ppo/sac-style checkpoints) are resolved there."""
+    os.makedirs(artifacts_dir, exist_ok=True)
+    out: Dict[str, str] = {}
+    for k in keys:
+        if k in state:
+            tree = state[k]
+        elif isinstance(state.get("agent"), dict) and k in state["agent"]:
+            tree = state["agent"][k]
+        else:
+            # a phase may checkpoint fewer sub-models than the algo registers
+            # (e.g. P2E finetuning has no ensembles); the registration-time
+            # subset check surfaces genuinely missing models
+            continue
+        path = os.path.join(artifacts_dir, f"{k}.pkl")
+        with open(path, "wb") as f:
+            pickle.dump(tree, f, protocol=pickle.HIGHEST_PROTOCOL)
+        out[k] = path
+    return out
+
+
+def register_model_from_checkpoint(
+    fabric: Any,
+    cfg: Dict[str, Any],
+    state: Dict[str, Any],
+    log_models_fn: Any,
+) -> Dict[str, Any]:
+    """Log the checkpoint's sub-models and register the configured subset
+    (reference register_model_from_checkpoint, mlflow.py:330-382)."""
+    artifacts_dir = os.path.join(
+        cfg["model_manager"].get("registry_dir") or "models_registry", "_artifacts", cfg["exp_name"]
+    )
+    models_info = log_models_fn(fabric, cfg, state, artifacts_dir)
+    manager = make_model_manager(fabric, cfg)
+    wanted = set(cfg["model_manager"]["models"].keys())
+    if not wanted.issubset(models_info.keys()):
+        raise RuntimeError(
+            f"The models you want to register must be a subset of the models of the {cfg['algo']['name']} "
+            f"agent.\nModels specified in the configs: {sorted(wanted)}."
+            f"\nModels of the agent: {sorted(models_info)}."
+        )
+    registered = {}
+    for k, cfg_model in cfg["model_manager"]["models"].items():
+        registered[k] = manager.register_model(
+            models_info[k], cfg_model["model_name"], cfg_model.get("description"), cfg_model.get("tags")
+        )
+    return registered

@@ -1,0 +1,107 @@
+"""Model manager + registration CLI (reference: sheeprl/utils/mlflow.py,
+cli.py:394-436, tests via the MLflow-integration CI mode).
+
+MLflow is optional; the default file-backed LocalModelManager is exercised
+end to end: train a tiny Dreamer-V3, register its sub-models through the
+registration CLI, then inspect/transition/download through the manager."""
+
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import registration, run
+from sheeprl_tpu.utils.model_manager import LocalModelManager
+
+
+def dv3_args(tmp_path):
+    return [
+        "exp=dreamer_v3",
+        "env=dummy",
+        "env.id=dummy_discrete",
+        "dry_run=True",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "algo.per_rank_batch_size=1",
+        "algo.per_rank_sequence_length=1",
+        "buffer.size=10",
+        "algo.learning_starts=0",
+        "algo.replay_ratio=1",
+        "algo.per_rank_pretrain_steps=1",
+        "algo.horizon=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.discrete_size=4",
+        "algo.world_model.stochastic_size=4",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.mlp_keys.encoder=[state]",
+        "env.num_envs=2",
+        "algo.run_test=False",
+        "checkpoint.save_last=True",
+        "metric.log_level=0",
+        f"log_base_dir={tmp_path}/logs",
+    ]
+
+
+def find_checkpoints(tmp_path):
+    ckpts = []
+    for root, _, files in os.walk(tmp_path):
+        ckpts += [os.path.join(root, f) for f in files if f.endswith(".ckpt")]
+    return ckpts
+
+
+def test_registration_cli_local_backend(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(dv3_args(tmp_path))
+    (ckpt,) = find_checkpoints(tmp_path)
+
+    registry_dir = str(tmp_path / "registry")
+    registration([f"checkpoint_path={ckpt}", f"model_manager.registry_dir={registry_dir}"])
+
+    index = json.load(open(os.path.join(registry_dir, "registry.json")))
+    # the dreamer_v3 model_manager config registers all five sub-models
+    names = sorted(index)
+    assert len(names) == 5
+    assert any("world_model" in n for n in names)
+    for records in index.values():
+        assert records[-1]["version"] == 1
+        with open(records[-1]["artifact"], "rb") as f:
+            tree = pickle.load(f)
+        assert tree is not None
+
+    # registering again bumps the version
+    registration([f"checkpoint_path={ckpt}", f"model_manager.registry_dir={registry_dir}"])
+    index = json.load(open(os.path.join(registry_dir, "registry.json")))
+    assert all(records[-1]["version"] == 2 for records in index.values())
+
+
+def test_local_manager_lifecycle(tmp_path):
+    mgr = LocalModelManager(None, str(tmp_path / "registry"))
+    artifact = tmp_path / "model.pkl"
+    artifact.write_bytes(pickle.dumps({"w": np.ones(3)}))
+
+    rec = mgr.register_model(str(artifact), "my_model", "first version", {"algo": "test"})
+    assert rec["version"] == 1 and rec["tags"] == {"algo": "test"}
+    assert mgr.get_latest_version("my_model")["version"] == 1
+
+    mgr.register_model(str(artifact), "my_model", "second version")
+    assert mgr.get_latest_version("my_model")["version"] == 2
+
+    rec = mgr.transition_model("my_model", 1, "production", "promoted")
+    assert rec["stage"] == "production"
+
+    out = tmp_path / "download"
+    mgr.download_model("my_model", 2, str(out))
+    assert (out / "model.pkl").exists()
+
+    mgr.delete_model("my_model", 1)
+    with pytest.raises(FileNotFoundError):
+        mgr.download_model("my_model", 1, str(out))
+    # the latest version is untouched
+    assert mgr.get_latest_version("my_model")["version"] == 2
