@@ -391,7 +391,9 @@ def main(fabric, cfg: Dict[str, Any]):
         seed=cfg.seed,
     )
     if cfg.checkpoint.resume_from and cfg.buffer.checkpoint:
-        rb = state["rb"]
+        from sheeprl_tpu.utils.checkpoint import select_buffer
+
+        rb = select_buffer(state["rb"], rank, num_processes)
 
     train_fn = make_train_fn(
         fabric, wm, actor, critic, world_tx, actor_tx, critic_tx, cfg, is_continuous, actions_dim

@@ -426,7 +426,9 @@ def main(fabric, cfg: Dict[str, Any]):
     else:
         raise ValueError(f"Unrecognized buffer type: must be one of `sequential` or `episode`, received: {buffer_type}")
     if cfg.checkpoint.resume_from and cfg.buffer.checkpoint:
-        rb = state["rb"]
+        from sheeprl_tpu.utils.checkpoint import select_buffer
+
+        rb = select_buffer(state["rb"], rank, num_processes)
 
     # hard target-critic copy (reference dreamer_v2.py:691-693)
     @jax.jit

@@ -189,7 +189,9 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     if (resume_from_checkpoint and cfg.buffer.checkpoint) or (
         cfg.buffer.get("load_from_exploration") and exploration_cfg.buffer.checkpoint
     ):
-        rb = state["rb"]
+        from sheeprl_tpu.utils.checkpoint import select_buffer
+
+        rb = select_buffer(state["rb"], rank, num_processes)
 
     @jax.jit
     def hard_copy(cp):

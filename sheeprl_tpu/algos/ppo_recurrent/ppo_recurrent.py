@@ -427,6 +427,22 @@ def main(fabric, cfg: Dict[str, Any]):
         hx0 = sequences.pop("hx0")
         cx0 = sequences.pop("cx0")
         if fabric.num_processes > 1:
+            # every process must contribute the SAME padded count to the
+            # global array — agree on the max and pad with masked dummies
+            from sheeprl_tpu.parallel.collectives import all_gather_object
+
+            n_here = sequences["mask"].shape[1]
+            n_target = max(all_gather_object(n_here))
+            if n_here < n_target:
+                extra = n_target - n_here
+                sequences = {
+                    k: np.concatenate(
+                        [v, np.zeros((v.shape[0], extra, *v.shape[2:]), v.dtype)], axis=1
+                    )
+                    for k, v in sequences.items()
+                }
+                hx0 = np.concatenate([hx0, np.zeros((extra, hx0.shape[1]), hx0.dtype)], axis=0)
+                cx0 = np.concatenate([cx0, np.zeros((extra, cx0.shape[1]), cx0.dtype)], axis=0)
             sequences = fabric.make_global(sequences, (None, fabric.data_axis))
             hx0 = fabric.make_global(hx0, (fabric.data_axis,))
             cx0 = fabric.make_global(cx0, (fabric.data_axis,))

@@ -412,7 +412,7 @@ def build_agent(
     if agent_state is not None:
         agent.target_encoder_params = fabric.replicate(jax.tree.map(jnp.asarray, agent_state["target_encoder"]))
         agent.target_qfs_params = fabric.replicate(jax.tree.map(jnp.asarray, agent_state["target_qfs"]))
-        agent.log_alpha = jnp.asarray(agent_state["log_alpha"])
+        agent.log_alpha = fabric.replicate(jnp.asarray(agent_state["log_alpha"]))
     else:
         agent.target_encoder_params = fabric.replicate(agent.target_encoder_params)
         agent.target_qfs_params = fabric.replicate(agent.target_qfs_params)

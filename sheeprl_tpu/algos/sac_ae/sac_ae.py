@@ -186,13 +186,10 @@ def make_train_fn(fabric, agent: SACAEAgent, actor_tx, qf_tx, alpha_tx, encoder_
                 def recon_loss_fn(ep, dp):
                     hidden = encoder.apply(ep, obs)
                     recon = decoder.apply(dp, hidden)
-                    loss = 0.0
+                    loss = l2_lambda * jnp.mean(0.5 * jnp.square(hidden).sum(-1))
                     for k in cnn_dec_keys + mlp_dec_keys:
                         target_k = preprocess_target(batch[k]) if k in cnn_dec_keys else batch[k]
-                        loss = loss + (
-                            jnp.mean(jnp.square(target_k - recon[k]))
-                            + l2_lambda * jnp.mean(0.5 * jnp.square(hidden).sum(-1))
-                        )
+                        loss = loss + jnp.mean(jnp.square(target_k - recon[k]))
                     return loss
 
                 rec_loss, (enc_grads, dec_grads) = jax.value_and_grad(recon_loss_fn, argnums=(0, 1))(
@@ -339,7 +336,9 @@ def main(fabric, cfg: Dict[str, Any]):
         seed=cfg.seed,
     )
     if cfg.checkpoint.resume_from and cfg.buffer.checkpoint:
-        rb = state["rb"]
+        from sheeprl_tpu.utils.checkpoint import select_buffer
+
+        rb = select_buffer(state["rb"], rank, num_processes)
 
     train_fn = make_train_fn(fabric, agent, actor_tx, qf_tx, alpha_tx, encoder_tx, decoder_tx, cfg)
 

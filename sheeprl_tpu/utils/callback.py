@@ -1,20 +1,32 @@
 """Checkpoint callback (reference: sheeprl/utils/callback.py:14-148).
 
 Invoked by algorithms through ``fabric.call("on_checkpoint_coupled", ...)``;
-delegates serialization to ``sheeprl_tpu.core.checkpoint`` (orbax) and prunes
-old checkpoints with ``keep_last``.
+serialization goes through ``sheeprl_tpu.utils.checkpoint`` (pickle or
+orbax backend) and old checkpoints are pruned with ``keep_last``.
+
+When a replay buffer rides the checkpoint, the stored copy must be
+self-consistent without the live env state: the last stored step of every
+env is flagged TRUNCATED for the save and restored right after (reference
+``_ckpt_rb`` / ``_experiment_consistent_rb``, callback.py:87-142); open
+episodes of an ``EpisodeBuffer`` are dropped the same way. On multi-host
+runs every process's buffer is gathered over the host-object plane and the
+checkpoint stores one buffer per process (reference gloo ``gather_object``,
+callback.py:40-51; restore with ``checkpoint.select_buffer``).
 """
 
 from __future__ import annotations
 
 import os
 import shutil
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
+
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, ReplayBuffer
 
 
 class CheckpointCallback:
-    def __init__(self, keep_last: Optional[int] = None) -> None:
+    def __init__(self, keep_last: Optional[int] = None, backend: str = "pickle") -> None:
         self.keep_last = keep_last
+        self.backend = backend
 
     def on_checkpoint_coupled(
         self,
@@ -22,17 +34,85 @@ class CheckpointCallback:
         ckpt_path: str,
         state: Dict[str, Any],
         replay_buffer: Any = None,
+        gather_buffers: bool = True,
+        backend: str = None,
     ) -> None:
+        backend = backend or self.backend
+        rb_state = None
         if replay_buffer is not None:
-            state = {**state, "rb": replay_buffer}
-        fabric.save(ckpt_path, state)
-        if self.keep_last:
+            rb_state = self._ckpt_rb(replay_buffer)
+            rb_to_save: Any = replay_buffer
+            if gather_buffers and fabric.num_processes > 1:
+                from sheeprl_tpu.parallel.collectives import all_gather_object
+
+                rb_to_save = all_gather_object(replay_buffer)
+            state = {**state, "rb": rb_to_save}
+        from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+        # the orbax store coordinates its own multi-process write barriers, so
+        # EVERY process must enter save_checkpoint (the object sidecar is
+        # still written by process 0 only); the pickle backend writes once
+        if fabric.is_global_zero or (backend == "orbax" and fabric.num_processes > 1):
+            save_checkpoint(ckpt_path, state, backend=backend)
+        if replay_buffer is not None:
+            self._experiment_consistent_rb(replay_buffer, rb_state)
+        if fabric.is_global_zero and self.keep_last:
             self._prune(os.path.dirname(ckpt_path))
 
     # Decoupled topologies save from the player with trainer-provided state
-    # (reference callback.py:58-78).
-    def on_checkpoint_player(self, fabric: Any, ckpt_path: str, state: Dict[str, Any], replay_buffer: Any = None) -> None:
-        self.on_checkpoint_coupled(fabric, ckpt_path, state, replay_buffer)
+    # (reference callback.py:58-78). Only the player enters this hook, so no
+    # buffer gather must run — it would be a collective the trainer processes
+    # never join (and the player owns the only buffer in this topology).
+    def on_checkpoint_player(
+        self, fabric: Any, ckpt_path: str, state: Dict[str, Any], replay_buffer: Any = None
+    ) -> None:
+        backend = self.backend
+        if backend == "orbax" and fabric.num_processes > 1:
+            import warnings
+
+            warnings.warn(
+                "the orbax backend needs every process at its save barrier, but only the "
+                "decoupled player checkpoints — falling back to pickle for this save"
+            )
+            backend = "pickle"
+        self.on_checkpoint_coupled(
+            fabric, ckpt_path, state, replay_buffer, gather_buffers=False, backend=backend
+        )
+
+    # ------------------------------------------------------------------ #
+    # buffer consistency (reference callback.py:87-142)
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _ckpt_rb(rb: Any) -> Any:
+        """Make the stored buffer self-consistent: the env state is not
+        checkpointed, so the last stored step must end its episode. Returns
+        the clobbered values for the undo."""
+        if isinstance(rb, EnvIndependentReplayBuffer):
+            saved: List[Any] = []
+            for b in rb.buffer:
+                saved.append(b["truncated"][(b._pos - 1) % b.buffer_size, :].copy())
+                b["truncated"][(b._pos - 1) % b.buffer_size, :] = 1
+            return saved
+        if isinstance(rb, ReplayBuffer):
+            saved = rb["truncated"][(rb._pos - 1) % rb.buffer_size, :].copy()
+            rb["truncated"][(rb._pos - 1) % rb.buffer_size, :] = 1
+            return saved
+        if isinstance(rb, EpisodeBuffer):
+            saved = rb._open_episodes
+            rb._open_episodes = [[] for _ in range(rb.n_envs)]
+            return saved
+        return None
+
+    @staticmethod
+    def _experiment_consistent_rb(rb: Any, saved: Any) -> None:
+        """Undo :meth:`_ckpt_rb` so the live run continues unchanged."""
+        if isinstance(rb, EnvIndependentReplayBuffer):
+            for b, s in zip(rb.buffer, saved):
+                b["truncated"][(b._pos - 1) % b.buffer_size, :] = s
+        elif isinstance(rb, ReplayBuffer):
+            rb["truncated"][(rb._pos - 1) % rb.buffer_size, :] = saved
+        elif isinstance(rb, EpisodeBuffer):
+            rb._open_episodes = saved
 
     def _prune(self, ckpt_dir: str) -> None:
         if not os.path.isdir(ckpt_dir):

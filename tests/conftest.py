@@ -33,3 +33,20 @@ def _no_env_leaks():
     yield
     after = {k: v for k, v in os.environ.items() if k.startswith("SHEEPRL_TPU")}
     assert before == after, f"test leaked env vars: {set(after) ^ set(before)}"
+
+
+@pytest.fixture(autouse=True)
+def _reset_observability_switches():
+    """run_algorithm() flips the CLASS-level kill-switches
+    (MetricAggregator.disabled / timer.disabled) from cfg.metric.log_level;
+    restore them so a log_level=0 CLI test cannot poison later metric tests
+    (the reference resets global state per test the same way,
+    conftest.py:64-69)."""
+    from sheeprl_tpu.utils.metric import MetricAggregator
+    from sheeprl_tpu.utils.timer import timer
+
+    agg_disabled = MetricAggregator.disabled
+    timer_disabled = timer.disabled
+    yield
+    MetricAggregator.disabled = agg_disabled
+    timer.disabled = timer_disabled

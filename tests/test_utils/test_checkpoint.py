@@ -1,0 +1,170 @@
+"""Checkpoint backends + buffer-consistency fixup (reference:
+sheeprl/utils/callback.py:87-148 and fabric.save/load)."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, ReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.utils.callback import CheckpointCallback
+from sheeprl_tpu.utils.checkpoint import load_checkpoint, save_checkpoint, select_buffer
+
+
+class _FakeFabric:
+    num_processes = 1
+    is_global_zero = True
+
+
+import collections
+
+Opt = collections.namedtuple("Opt", ["mu", "nu"])
+
+
+def _tree():
+    return {
+        "params": {"dense": {"kernel": np.random.rand(4, 3).astype(np.float32), "bias": np.zeros(3)}},
+        "opt": Opt(mu=np.ones((4, 3)), nu=np.zeros((4, 3))),
+        "ratio": {"ratio": 0.5, "prev": 10},
+        "update": 7,
+        "name": "run",
+        "mixed": [np.arange(5), "text", 3],
+    }
+
+
+@pytest.mark.parametrize("backend", ["pickle", "orbax"])
+def test_checkpoint_roundtrip(tmp_path, backend):
+    state = _tree()
+    path = str(tmp_path / ("ck.ckpt" if backend == "pickle" else "ck_dir.ckpt"))
+    save_checkpoint(path, state, backend=backend)
+    out = load_checkpoint(path)
+    np.testing.assert_array_equal(out["params"]["dense"]["kernel"], state["params"]["dense"]["kernel"])
+    np.testing.assert_array_equal(out["opt"].mu, state["opt"].mu)
+    assert out["ratio"] == state["ratio"] and out["update"] == 7 and out["name"] == "run"
+    np.testing.assert_array_equal(out["mixed"][0], np.arange(5))
+    assert out["mixed"][1:] == ["text", 3]
+    assert type(out["opt"]).__name__ == "Opt"
+
+
+def test_checkpoint_truncated_fixup(tmp_path):
+    """The SAVED buffer ends every env's episode (truncated=1 at the last
+    stored step) while the LIVE buffer is untouched (reference
+    callback.py:87-142)."""
+    rb = EnvIndependentReplayBuffer(8, n_envs=2, buffer_cls=SequentialReplayBuffer, seed=0)
+    data = {
+        "obs": np.random.rand(3, 2, 4).astype(np.float32),
+        "terminated": np.zeros((3, 2, 1), np.float32),
+        "truncated": np.zeros((3, 2, 1), np.float32),
+    }
+    rb.add(data)
+
+    cb = CheckpointCallback()
+    ckpt_path = str(tmp_path / "ck.ckpt")
+    cb.on_checkpoint_coupled(_FakeFabric(), ckpt_path, {"update": 1}, replay_buffer=rb)
+
+    # live buffer: unchanged
+    for b in rb.buffer:
+        assert b["truncated"][(b._pos - 1) % b.buffer_size].sum() == 0
+    # stored buffer: last step truncated for every env
+    saved = load_checkpoint(ckpt_path)["rb"]
+    for b in saved.buffer:
+        assert b["truncated"][(b._pos - 1) % b.buffer_size].sum() == 1
+
+
+def test_checkpoint_plain_replay_buffer_fixup(tmp_path):
+    rb = ReplayBuffer(8, n_envs=2, seed=0)
+    rb.add(
+        {
+            "observations": np.zeros((3, 2, 4), np.float32),
+            "terminated": np.zeros((3, 2, 1), np.float32),
+            "truncated": np.zeros((3, 2, 1), np.float32),
+        }
+    )
+    cb = CheckpointCallback()
+    ckpt_path = str(tmp_path / "ck.ckpt")
+    cb.on_checkpoint_coupled(_FakeFabric(), ckpt_path, {}, replay_buffer=rb)
+    assert rb["truncated"][(rb._pos - 1) % rb.buffer_size].sum() == 0
+    saved = load_checkpoint(ckpt_path)["rb"]
+    assert saved["truncated"][(saved._pos - 1) % saved.buffer_size].sum() == 2
+
+
+def test_dv3_orbax_resume_restores_buffer_and_counters(tmp_path, monkeypatch):
+    """End to end: train tiny DV3 with the orbax backend + buffer checkpoint,
+    resume, and verify the restored buffer contents and counters match the
+    saved run (VERDICT weak #6 done-criterion)."""
+    from sheeprl_tpu.cli import run
+
+    args = [
+        "exp=dreamer_v3",
+        "env=dummy",
+        "env.id=dummy_discrete",
+        # a real (non-dry) 2-update run so the resume has budget left
+        "algo.total_steps=4",
+        "checkpoint.every=2",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "buffer.checkpoint=True",
+        "checkpoint.backend=orbax",
+        "algo.per_rank_batch_size=1",
+        "algo.per_rank_sequence_length=1",
+        "buffer.size=10",
+        "algo.learning_starts=0",
+        "algo.replay_ratio=1",
+        "algo.per_rank_pretrain_steps=1",
+        "algo.horizon=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.discrete_size=4",
+        "algo.world_model.stochastic_size=4",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.mlp_keys.encoder=[state]",
+        "env.num_envs=2",
+        "algo.run_test=False",
+        "checkpoint.save_last=True",
+        "metric.log_level=0",
+        f"log_base_dir={tmp_path}/logs",
+    ]
+    def find_ckpt_dirs():
+        found = []
+        for root, dirs, _ in os.walk(tmp_path):
+            found += [os.path.join(root, d) for d in dirs if d.endswith(".ckpt")]
+        return sorted(found)
+
+    monkeypatch.chdir(tmp_path)
+    run(args)
+    ckpts = find_ckpt_dirs()
+    assert ckpts and all(os.path.isdir(c) for c in ckpts)  # orbax ckpts are dirs
+
+    # pretend the run died after update 1: resume from the earliest checkpoint
+    first = min(ckpts, key=lambda c: int(os.path.basename(c).split("_")[1]))
+    state = load_checkpoint(first)
+    assert state["update"] == 1
+    rb = select_buffer(state["rb"], 0, 1)
+    saved_pos = [b._pos for b in rb.buffer]
+    # the stored copy ends every env's episode
+    for b in rb.buffer:
+        assert b["truncated"][(b._pos - 1) % b.buffer_size].sum() == 1
+
+    run(args + [f"checkpoint.resume_from={first}"])
+    new = [c for c in find_ckpt_dirs() if c not in ckpts]
+    assert new, "resume did not write a new checkpoint"
+    last = max(new, key=lambda c: int(os.path.basename(c).split("_")[1]))
+    state2 = load_checkpoint(last)
+    assert state2["update"] == 2  # counters continued exactly from update 1
+    rb2 = select_buffer(state2["rb"], 0, 1)
+    # the restored buffer kept the saved contents and grew by the new steps
+    for b2, p in zip(rb2.buffer, saved_pos):
+        assert b2._pos == p + 1
+
+
+def test_select_buffer():
+    assert select_buffer("rb", 0, 1) == "rb"
+    assert select_buffer(["a", "b"], 1, 2) == "b"
+    assert select_buffer(["a"], 0, 1) == "a"
+    with pytest.raises(RuntimeError):
+        select_buffer(["a", "b", "c"], 0, 2)
