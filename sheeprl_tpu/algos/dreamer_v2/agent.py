@@ -613,9 +613,12 @@ class PlayerDV2:
         self.actions_dim = tuple(actions_dim)
         self.num_envs = num_envs
         self.expl_rng = np.random.default_rng(seed)
-        self.h: Optional[np.ndarray] = None
-        self.z: Optional[np.ndarray] = None
-        self.actions: Optional[np.ndarray] = None
+        # recurrent state lives on device between steps (one less host round
+        # trip per env step on a remote-attached chip); exploration noise is
+        # host-side, so the action still crosses to host every step
+        self.h: Optional[Any] = None
+        self.z: Optional[Any] = None
+        self.actions: Optional[Any] = None
 
         def _step(wm_params, actor_params, obs, h, z, prev_action, key, greedy):
             k1, k2 = jax.random.split(key)
@@ -631,14 +634,17 @@ class PlayerDV2:
 
     def init_states(self, reset_envs: Optional[Sequence[int]] = None) -> None:
         if reset_envs is None or len(reset_envs) == 0:
-            self.h = np.zeros((self.num_envs, self.wm.recurrent_state_size), np.float32)
-            self.z = np.zeros((self.num_envs, self.wm.stoch_state_size), np.float32)
+            self.h = jnp.zeros((self.num_envs, self.wm.recurrent_state_size), jnp.float32)
+            self.z = jnp.zeros((self.num_envs, self.wm.stoch_state_size), jnp.float32)
             self.actions = np.zeros((self.num_envs, int(np.sum(self.actions_dim))), np.float32)
         else:
-            idx = list(reset_envs)
-            self.h[idx] = 0.0
-            self.z[idx] = 0.0
-            self.actions[idx] = 0.0
+            mask = np.zeros((self.num_envs, 1), np.float32)
+            mask[list(reset_envs)] = 1.0
+            m = jnp.asarray(mask)
+            self.h = jnp.where(m, 0.0, self.h)
+            self.z = jnp.where(m, 0.0, self.z)
+            self.actions = np.asarray(self.actions).copy()
+            self.actions[list(reset_envs)] = 0.0
 
     def get_actions(
         self,
@@ -651,8 +657,8 @@ class PlayerDV2:
         action, h, z = self._step(
             self.wm_params, self.actor_params, obs, self.h, self.z, self.actions, key, greedy
         )
-        self.h, self.z = (np.array(x) for x in jax.device_get((h, z)))
-        actions = np.array(jax.device_get(action))
+        self.h, self.z = h, z
+        actions = np.asarray(jax.device_get(action))
         if with_exploration:
             actions = add_exploration_noise(self.actor, actions, self.actions_dim, expl_step, self.expl_rng)
         self.actions = actions

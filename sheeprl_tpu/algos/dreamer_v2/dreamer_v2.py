@@ -42,6 +42,7 @@ from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v2.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.data import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.prefetch import sampled_batches
 from sheeprl_tpu.envs import make_env
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.distributions import Bernoulli, Independent, Normal, OneHotCategorical
@@ -581,25 +582,24 @@ def main(fabric, cfg: Dict[str, Any]):
         if update >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step / num_processes)
             if per_rank_gradient_steps > 0:
-                local_data = rb.sample(
+                # batch i+1's host->HBM transfer overlaps gradient step i
+                batches = sampled_batches(
+                    rb,
                     per_rank_batch_size * fabric.local_device_count,
-                    sequence_length=sequence_length,
-                    n_samples=per_rank_gradient_steps,
+                    sequence_length,
+                    per_rank_gradient_steps,
+                    cnn_keys,
+                    fabric,
+                    prefetch=int(cfg.buffer.get("prefetch", 0) or 0),
                 )
                 with timer("Time/train_time"):
-                    for i in range(per_rank_gradient_steps):
+                    for i, batch in enumerate(batches):
                         if (
                             cumulative_per_rank_gradient_steps
                             % cfg.algo.critic.per_rank_target_network_update_freq
                             == 0
                         ):
                             target_critic_params = hard_copy(critic_params)
-                        batch = {
-                            k: (v[i] if k in cnn_keys else v[i].astype(np.float32))
-                            for k, v in local_data.items()
-                        }
-                        if num_processes > 1:
-                            batch = fabric.make_global(batch, (None, fabric.data_axis))
                         key, train_key = jax.random.split(key)
                         (
                             wm_params,

@@ -623,7 +623,12 @@ def make_critic(cfg_critic: Dict[str, Any], dtype: Any) -> MLP:
 class PlayerDV3:
     """Stateful env-interaction handle (reference PlayerDV3,
     agent.py:596-691): keeps (h, z, prev_action) per env and advances them
-    with one jitted observe+act step."""
+    with one jitted observe+act step.
+
+    The recurrent state lives ON DEVICE between steps — with a
+    remote-attached chip, pulling (h, z) to host every step doubles the
+    per-step round trips; only the action is downloaded. Per-env resets are
+    a jitted masked blend instead of host-side indexing."""
 
     def __init__(
         self,
@@ -640,9 +645,9 @@ class PlayerDV3:
         self.actor_params = actor_params
         self.actions_dim = tuple(actions_dim)
         self.num_envs = num_envs
-        self.h: Optional[np.ndarray] = None
-        self.z: Optional[np.ndarray] = None
-        self.actions: Optional[np.ndarray] = None
+        self.h: Optional[Any] = None  # device [E, H]
+        self.z: Optional[Any] = None  # device [E, S]
+        self.actions: Optional[Any] = None  # device [E, A]
 
         def _step(wm_params, actor_params, obs, h, z, prev_action, key, greedy):
             k1, k2 = jax.random.split(key)
@@ -658,22 +663,33 @@ class PlayerDV3:
             action = sample_minedojo_actions(actor, actor_params, latent, k2, mask, greedy)
             return action, h, z
 
+        def _masked_reset(wm_params, h, z, actions, mask):
+            # mask [E, 1]: 1 where the env restarts
+            h0, z0 = wm.apply(wm_params, (h.shape[0],), method=WorldModel.initial_state)
+            return (
+                jnp.where(mask, h0, h),
+                jnp.where(mask, z0, z),
+                jnp.where(mask, 0.0, actions),
+            )
+
         self._step = jax.jit(_step, static_argnames="greedy")
         self._step_masked = jax.jit(_step_masked, static_argnames="greedy")
         self._initial = jax.jit(
             lambda p, n: wm.apply(p, (n,), method=WorldModel.initial_state), static_argnums=1
         )
+        self._masked_reset = jax.jit(_masked_reset)
 
     def init_states(self, reset_envs: Optional[Sequence[int]] = None) -> None:
-        h0, z0 = jax.device_get(self._initial(self.wm_params, self.num_envs))
         if reset_envs is None or len(reset_envs) == 0:
-            self.h, self.z = np.array(h0), np.array(z0)
-            self.actions = np.zeros((self.num_envs, int(np.sum(self.actions_dim))), np.float32)
+            h0, z0 = self._initial(self.wm_params, self.num_envs)
+            self.h, self.z = h0, z0
+            self.actions = jnp.zeros((self.num_envs, int(np.sum(self.actions_dim))), jnp.float32)
         else:
-            idx = list(reset_envs)
-            self.h[idx] = h0[idx]
-            self.z[idx] = z0[idx]
-            self.actions[idx] = 0.0
+            mask = np.zeros((self.num_envs, 1), np.float32)
+            mask[list(reset_envs)] = 1.0
+            self.h, self.z, self.actions = self._masked_reset(
+                self.wm_params, self.h, self.z, self.actions, mask
+            )
 
     def get_actions(
         self,
@@ -692,10 +708,9 @@ class PlayerDV3:
             action, h, z = self._step(
                 self.wm_params, self.actor_params, obs, self.h, self.z, self.actions, key, greedy
             )
-        # np.array: device_get hands back read-only buffers, but init_states
-        # mutates these per-env on episode resets
-        self.actions, self.h, self.z = (np.array(x) for x in jax.device_get((action, h, z)))
-        return self.actions
+        # recurrent state stays on device; only the action crosses PCIe
+        self.actions, self.h, self.z = action, h, z
+        return np.asarray(jax.device_get(action))
 
 
 def build_agent(

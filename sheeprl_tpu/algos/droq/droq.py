@@ -297,6 +297,11 @@ def main(fabric, cfg: Dict[str, Any]):
                 if num_processes > 1:
                     critic_data = fabric.make_global(critic_data, (None, fabric.data_axis))
                     actor_batch = fabric.make_global(actor_batch, (fabric.data_axis,))
+                else:
+                    # async HBM staging ahead of the fused high-replay loop
+                    from sheeprl_tpu.data.buffers import to_device
+                    critic_data = to_device(critic_data)
+                    actor_batch = to_device(actor_batch)
                 with timer("Time/train_time"):
                     key, train_key = jax.random.split(key)
                     (

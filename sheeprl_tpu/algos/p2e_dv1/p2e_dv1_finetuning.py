@@ -22,6 +22,7 @@ from sheeprl_tpu.algos.p2e_dv1.agent import build_agent
 from sheeprl_tpu.algos.p2e_dv1.utils import prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.data import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.prefetch import sampled_batches
 from sheeprl_tpu.envs import make_env
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -319,19 +320,18 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 if player_actor_type != "task":
                     player_actor_type = "task"
                     player.actor_params = actor_task_params
-                local_data = rb.sample(
+                # batch i+1's host->HBM transfer overlaps gradient step i
+                batches = sampled_batches(
+                    rb,
                     per_rank_batch_size * fabric.local_device_count,
-                    sequence_length=sequence_length,
-                    n_samples=per_rank_gradient_steps,
+                    sequence_length,
+                    per_rank_gradient_steps,
+                    cnn_keys,
+                    fabric,
+                    prefetch=int(cfg.buffer.get("prefetch", 0) or 0),
                 )
                 with timer("Time/train_time"):
-                    for i in range(per_rank_gradient_steps):
-                        batch = {
-                            k: (v[i] if k in cnn_keys else v[i].astype(np.float32))
-                            for k, v in local_data.items()
-                        }
-                        if num_processes > 1:
-                            batch = fabric.make_global(batch, (None, fabric.data_axis))
+                    for i, batch in enumerate(batches):
                         key, train_key = jax.random.split(key)
                         (
                             wm_params,

@@ -318,7 +318,13 @@ def main(fabric, cfg: Dict[str, Any]):
                     sample_next_obs=cfg.buffer.sample_next_obs,
                 )
                 data = {k: np.asarray(v, np.float32) for k, v in sample.items()}
-                data = fabric.make_global(data, (None, fabric.data_axis)) if num_processes > 1 else data
+                if num_processes > 1:
+                    data = fabric.make_global(data, (None, fabric.data_axis))
+                else:
+                    # async HBM staging: device_put returns immediately and
+                    # XLA orders the copy before the fused train step reads it
+                    from sheeprl_tpu.data.buffers import to_device
+                    data = to_device(data)
                 with timer("Time/train_time"):
                     key, train_key = jax.random.split(key)
                     (

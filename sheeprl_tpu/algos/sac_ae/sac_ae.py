@@ -435,7 +435,12 @@ def main(fabric, cfg: Dict[str, Any]):
                         data[k] = v.astype(np.float32)
                     else:
                         data[k] = np.asarray(v, np.float32)
-                data = fabric.make_global(data, (None, fabric.data_axis)) if num_processes > 1 else data
+                if num_processes > 1:
+                    data = fabric.make_global(data, (None, fabric.data_axis))
+                else:
+                    # async HBM staging: overlap the [G, B] transfer with dispatch
+                    from sheeprl_tpu.data.buffers import to_device
+                    data = to_device(data)
                 with timer("Time/train_time"):
                     key, train_key = jax.random.split(key)
                     (

@@ -63,6 +63,30 @@ def all_gather_object(obj: Any) -> List[Any]:
     ]
 
 
+def gather_object(obj: Any, dst: int = 0) -> List[Any] | None:
+    """Gather one picklable object per process to process ``dst`` only
+    (reference rank-0 gloo ``gather_object``, callback.py:40-51). The wire
+    pattern is still an allgather (the only primitive the device fabric
+    offers), but non-destination processes skip the P unpickles — the
+    dominant cost for replay-buffer-sized payloads."""
+    if jax.process_count() == 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+
+    payload = pickle.dumps(obj)
+    size = np.asarray([len(payload)], dtype=np.int64)
+    all_sizes = multihost_utils.process_allgather(size)
+    max_size = int(all_sizes.max())
+    buf = np.zeros(max_size, dtype=np.uint8)
+    buf[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    gathered = multihost_utils.process_allgather(buf)
+    if jax.process_index() != dst:
+        return None
+    return [
+        pickle.loads(gathered[p, : int(all_sizes[p, 0])].tobytes()) for p in range(jax.process_count())
+    ]
+
+
 def host_allreduce_sum(value: float) -> float:
     """Sum a host scalar across processes (replaces small fabric.all_reduce
     host syncs, e.g. metric counters)."""

@@ -43,16 +43,23 @@ class CheckpointCallback:
             rb_state = self._ckpt_rb(replay_buffer)
             rb_to_save: Any = replay_buffer
             if gather_buffers and fabric.num_processes > 1:
-                from sheeprl_tpu.parallel.collectives import all_gather_object
+                from sheeprl_tpu.parallel.collectives import gather_object
 
-                rb_to_save = all_gather_object(replay_buffer)
+                gathered = gather_object(replay_buffer, dst=0)
+                rb_to_save = gathered if fabric.is_global_zero else replay_buffer
             state = {**state, "rb": rb_to_save}
         from sheeprl_tpu.utils.checkpoint import save_checkpoint
 
         # the orbax store coordinates its own multi-process write barriers, so
-        # EVERY process must enter save_checkpoint (the object sidecar is
-        # still written by process 0 only); the pickle backend writes once
-        if fabric.is_global_zero or (backend == "orbax" and fabric.num_processes > 1):
+        # EVERY process must enter save_checkpoint with the SAME directory
+        # (per-rank paths would break the collective commit); the pickle
+        # backend writes once
+        if backend == "orbax" and fabric.num_processes > 1:
+            import re
+
+            shared = re.sub(r"_\d+(\.ckpt)$", r"_0\1", ckpt_path)
+            save_checkpoint(shared, state, backend=backend)
+        elif fabric.is_global_zero:
             save_checkpoint(ckpt_path, state, backend=backend)
         if replay_buffer is not None:
             self._experiment_consistent_rb(replay_buffer, rb_state)

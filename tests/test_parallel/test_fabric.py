@@ -105,8 +105,9 @@ def test_grad_pmean_matches_single_device():
     """DP gradient on an 8-way mesh == single-device gradient on full batch."""
     from functools import partial
 
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from sheeprl_tpu.parallel.shard_map import shard_map
 
     f = Fabric(devices=8)
     w = jnp.asarray([2.0, -1.0])
@@ -122,7 +123,6 @@ def test_grad_pmean_matches_single_device():
         mesh=f.mesh,
         in_specs=(P(), P("data")),
         out_specs=P(),
-        check_rep=False,
     )
     def dp_grad(w, x):
         return jax.lax.pmean(jax.grad(loss)(w, x), "data")
