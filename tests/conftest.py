@@ -26,6 +26,56 @@ def tmp_logdir(tmp_path):
     return str(tmp_path / "logs")
 
 
+def run_two_process(code: str, argv=(), cwd=None, extra_env=None, timeout=540):
+    """Launch ``code`` in two real ``jax.distributed`` CPU processes
+    (TEST_COORD/TEST_NPROC/TEST_PID env contract) and return their outputs,
+    asserting both exit 0. Workers are killed on failure/timeout so a wedged
+    pair cannot leak into later tests. Shared by the decoupled-topology and
+    collective-plane tests."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    try:
+        for pid in range(2):
+            env = dict(os.environ)
+            env.pop("SHEEPRL_TPU_COORDINATOR", None)
+            env.pop("SHEEPRL_TPU_NUM_PROCESSES", None)
+            env.pop("SHEEPRL_TPU_PROCESS_ID", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+            env["TEST_COORD"] = f"127.0.0.1:{port}"
+            env["TEST_NPROC"] = "2"
+            env["TEST_PID"] = str(pid)
+            env["PYTHONPATH"] = os.pathsep.join(p for p in (repo_root, env.get("PYTHONPATH")) if p)
+            env.update(extra_env or {})
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-c", code, *argv],
+                    env=env,
+                    cwd=cwd,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
+    return outs
+
+
 @pytest.fixture(autouse=True)
 def _no_env_leaks():
     """Fail a test that leaks SHEEPRL_TPU_* env vars (reference conftest.py:20-61)."""

@@ -3,9 +3,8 @@ test_sac_decoupled). Process 0 plays and owns the replay buffer; process 1
 trains on its own mesh and ships the actor back."""
 
 import os
-import socket
-import subprocess
-import sys
+
+from tests.conftest import run_two_process
 
 RUNNER = """
 import os, sys
@@ -22,14 +21,7 @@ run(sys.argv[1:])
 """
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 def test_sac_decoupled_two_process(tmp_path):
-    port = _free_port()
     args = [
         "exp=sac_decoupled",
         "env=dummy",
@@ -51,34 +43,7 @@ def test_sac_decoupled_two_process(tmp_path):
         "metric.log_level=1",
         f"log_base_dir={tmp_path}/logs",
     ]
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ)
-        env.pop("SHEEPRL_TPU_COORDINATOR", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-        env["TEST_COORD"] = f"127.0.0.1:{port}"
-        env["TEST_NPROC"] = "2"
-        env["TEST_PID"] = str(pid)
-        env["PYTHONPATH"] = os.pathsep.join(
-            p for p in (os.path.dirname(os.path.dirname(os.path.dirname(__file__))), env.get("PYTHONPATH")) if p
-        )
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, "-c", RUNNER, *args],
-                env=env,
-                cwd=str(tmp_path),
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
-            )
-        )
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=540)
-        outs.append(out)
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
+    run_two_process(RUNNER, argv=args, cwd=str(tmp_path))
 
     ckpts = []
     for root, _, files in os.walk(tmp_path):

@@ -1,0 +1,72 @@
+"""Multi-process collectives + mesh assembly (reference: the 2-process gloo
+tests of tests/test_algos/test_algos.py:16-51).
+
+Spawns two real ``jax.distributed`` CPU processes and exercises the
+host-object plane (broadcast / all-gather / gather-to-zero / scalar
+allreduce), the log-dir broadcast, and ``Fabric.make_global`` assembling
+per-process blocks into one mesh-global array."""
+
+import os
+
+from tests.conftest import run_two_process
+
+WORKER = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=os.environ["TEST_COORD"],
+    num_processes=2,
+    process_id=int(os.environ["TEST_PID"]),
+)
+import numpy as np
+
+from sheeprl_tpu.parallel.collectives import (
+    all_gather_object,
+    broadcast_object,
+    gather_object,
+    host_allreduce_sum,
+)
+from sheeprl_tpu.parallel.fabric import Fabric
+from sheeprl_tpu.utils.logger import get_log_dir
+
+pid = jax.process_index()
+
+# object plane
+got = broadcast_object({"cfg": [1, 2, 3]} if pid == 0 else None, src=0)
+assert got == {"cfg": [1, 2, 3]}, got
+gathered = all_gather_object(("rank", pid))
+assert gathered == [("rank", 0), ("rank", 1)], gathered
+to_zero = gather_object(np.full(4, pid), dst=0)
+if pid == 0:
+    assert [int(a[0]) for a in to_zero] == [0, 1]
+else:
+    assert to_zero is None
+assert host_allreduce_sum(pid + 1.0) == 3.0
+
+# log-dir broadcast: both processes must agree on process 0's versioned dir
+cfg = {"root_dir": "algo/env", "run_name": "run", "log_base_dir": os.environ["TEST_TMP"]}
+log_dir = get_log_dir(cfg)
+assert log_dir.endswith("version_0"), log_dir
+
+# make_global: per-process [2, 3] blocks -> one [4, 3] mesh-global array
+fabric = Fabric(precision="fp32")
+assert fabric.num_processes == 2 and fabric.world_size == 4
+local = np.full((2, 3), pid, np.float32)
+global_arr = fabric.make_global(local, (fabric.data_axis,))
+assert global_arr.shape == (4, 3)
+import jax.numpy as jnp
+
+total = float(jnp.sum(global_arr))  # 0*6 + 1*6
+assert total == 6.0, total
+print(f"proc {pid}: distributed plane OK")
+"""
+
+
+def test_two_process_collectives_and_make_global(tmp_path):
+    outs = run_two_process(
+        WORKER, cwd=str(tmp_path), extra_env={"TEST_TMP": str(tmp_path)}, timeout=300
+    )
+    for out in outs:
+        assert "distributed plane OK" in out
