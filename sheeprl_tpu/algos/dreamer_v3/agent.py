@@ -36,6 +36,7 @@ from sheeprl_tpu.ops.distributions import (
     TanhNormal,
 )
 from sheeprl_tpu.ops.math import symlog
+from sheeprl_tpu.ops.pallas_gru import fused_recurrent_step, resolve_backend
 
 Array = jax.Array
 
@@ -206,6 +207,96 @@ class RecurrentModel(nn.Module):
         return new_h.astype(jnp.float32)
 
 
+class _DenseParams(nn.Module):
+    """Parameter-only shadow of ``nn.Dense`` — declares the identical
+    ``kernel``/``bias`` params (same names, shapes, inits) without running the
+    matmul, so a fused kernel can consume them directly."""
+
+    features: int
+    in_dim: int
+    use_bias: bool = True
+    kernel_init: Any = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self) -> Tuple[Array, Optional[Array]]:
+        kernel = self.param("kernel", self.kernel_init, (self.in_dim, self.features), jnp.float32)
+        bias = (
+            self.param("bias", nn.initializers.zeros_init(), (self.features,), jnp.float32)
+            if self.use_bias
+            else None
+        )
+        return kernel, bias
+
+
+class _LayerNormParams(nn.Module):
+    """Parameter-only shadow of the repo's LayerNorm wrapper: the wrapper
+    nests an ``nn.LayerNorm`` child, so the tree is LayerNorm_0/{scale,bias}
+    one level down — reproduced here for checkpoint interchange."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self) -> Tuple[Array, Array]:
+        class _Inner(nn.Module):
+            features: int
+
+            @nn.compact
+            def __call__(self) -> Tuple[Array, Array]:
+                scale = self.param("scale", nn.initializers.ones_init(), (self.features,), jnp.float32)
+                bias = self.param("bias", nn.initializers.zeros_init(), (self.features,), jnp.float32)
+                return scale, bias
+
+        return _Inner(self.features, name="LayerNorm_0")()
+
+
+class FusedRecurrentModel(nn.Module):
+    """Drop-in for :class:`RecurrentModel` whose whole step — input Dense →
+    LN → SiLU → LayerNorm-GRU — runs as ONE Pallas TPU kernel
+    (:func:`sheeprl_tpu.ops.pallas_gru.fused_recurrent_step`): both matmuls
+    on the MXU from VMEM-resident weights, LayerNorm statistics and gate
+    math on the VPU with no HBM round-trips between ops.
+
+    The parameter tree exactly mirrors :class:`RecurrentModel`'s
+    (Dense_0, LayerNorm_0/LayerNorm_0, LayerNormGRUCell_0/{Dense_0,
+    LayerNorm_0/LayerNorm_0}), so checkpoints interchange freely between the
+    fused and flax backends — ``fused=auto`` may resolve differently on the
+    training and eval/resume hosts without breaking restore."""
+
+    recurrent_state_size: int
+    dense_units: int
+    dtype: Any = jnp.float32
+    eps: float = 1e-3
+    interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x: Array, h: Array) -> Array:
+        in_dim = x.shape[-1]
+        d, hid = self.dense_units, self.recurrent_state_size
+        w1, b1 = _DenseParams(d, in_dim, kernel_init=hafner_init, name="Dense_0")()
+        g1, be1 = _LayerNormParams(d, name="LayerNorm_0")()
+
+        class _GRUParams(nn.Module):
+            hidden: int
+            in_features: int
+
+            @nn.compact
+            def __call__(self) -> Tuple[Array, Array, Array]:
+                kernel, _ = _DenseParams(
+                    3 * self.hidden, self.in_features, use_bias=False, name="Dense_0"
+                )()
+                scale, bias = _LayerNormParams(3 * self.hidden, name="LayerNorm_0")()
+                return kernel, scale, bias
+
+        w2, g2, be2 = _GRUParams(hid, hid + d, name="LayerNormGRUCell_0")()
+        batch_shape = x.shape[:-1]
+        x2 = x.reshape(-1, in_dim)
+        h2 = h.astype(jnp.float32).reshape(-1, hid)
+        out = fused_recurrent_step(
+            x2, h2, w1, b1, g1, be1, w2, g2, be2, eps1=self.eps, interpret=self.interpret
+        )
+        return out.reshape(*batch_shape, hid)
+
+
 def _uniform_mix(logits: Array, discrete: int, unimix: float) -> Array:
     """1% uniform mixing of the categorical (reference agent.py:437-449)."""
     logits = logits.reshape(*logits.shape[:-1], -1, discrete)
@@ -256,6 +347,7 @@ class WorldModel(nn.Module):
     continue_dense_units: int = 1024
     cnn_stages: int = 4
     learnable_initial_recurrent_state: bool = True
+    fused_recurrent: Any = "auto"  # "auto" | True/"pallas" | False/"flax"
     dtype: Any = jnp.float32
 
     @property
@@ -290,9 +382,21 @@ class WorldModel(nn.Module):
                 self.decoder_dense_units,
                 dtype=self.dtype,
             )
-        self.recurrent_model = RecurrentModel(
-            self.recurrent_state_size, self.recurrent_dense_units, dtype=self.dtype
+        gru_in_dim = self.stoch_state_size + int(sum(self.actions_dim))
+        use_pallas, interpret = resolve_backend(
+            self.fused_recurrent, gru_in_dim, self.recurrent_dense_units, self.recurrent_state_size
         )
+        if use_pallas:
+            self.recurrent_model = FusedRecurrentModel(
+                self.recurrent_state_size,
+                self.recurrent_dense_units,
+                dtype=self.dtype,
+                interpret=interpret,
+            )
+        else:
+            self.recurrent_model = RecurrentModel(
+                self.recurrent_state_size, self.recurrent_dense_units, dtype=self.dtype
+            )
         self.representation_model = nn.Sequential(
             [
                 _LNMLP(1, self.representation_hidden_size, self.dtype),
@@ -751,6 +855,7 @@ def build_agent(
         unimix=float(cfg["algo"]["unimix"]),
         recurrent_state_size=int(wm_cfg["recurrent_model"]["recurrent_state_size"]),
         recurrent_dense_units=int(wm_cfg["recurrent_model"]["dense_units"]),
+        fused_recurrent=wm_cfg["recurrent_model"].get("fused", "auto"),
         encoder_cnn_multiplier=int(wm_cfg["encoder"]["cnn_channels_multiplier"]),
         encoder_mlp_layers=int(wm_cfg["encoder"]["mlp_layers"]),
         encoder_dense_units=int(wm_cfg["encoder"]["dense_units"]),

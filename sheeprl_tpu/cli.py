@@ -136,7 +136,14 @@ def run_algorithm(cfg: dotdict) -> None:
     except ModuleNotFoundError:
         pass
 
-    entrypoint(fabric, cfg, **kwargs)
+    from sheeprl_tpu.utils.logger import run_base_dir
+    from sheeprl_tpu.utils.profiler import maybe_profile
+
+    # the run's TB root (the versioned dir itself is only chosen inside the
+    # entrypoint): traces land at <root>/profile, next to version_N, so
+    # `tensorboard --logdir <root>` picks up the profile plugin data
+    with maybe_profile(cfg, log_dir=run_base_dir(cfg)):
+        entrypoint(fabric, cfg, **kwargs)
 
 
 def run(args: Optional[List[str]] = None) -> None:

@@ -1,0 +1,7 @@
+"""``python -m sheeprl_tpu.cli_agents`` — print the registered algorithms
+table (reference: sheeprl/available_agents.py, console script `sheeprl-agents`)."""
+
+from sheeprl_tpu.cli import available_agents
+
+if __name__ == "__main__":
+    available_agents()

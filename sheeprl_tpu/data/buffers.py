@@ -28,6 +28,7 @@ from typing import Any, Dict, List, Optional, Sequence, Type
 
 import numpy as np
 
+from sheeprl_tpu import native
 from sheeprl_tpu.data.memmap import MemmapArray, _ALLOWED_MODES
 
 
@@ -237,13 +238,19 @@ class ReplayBuffer:
         out: Dict[str, np.ndarray] = {}
         for k, v in self._buf.items():
             arr = np.asarray(v)
-            out[k] = arr[batch_idxes, env_idxes]
-            if clone:
-                out[k] = out[k].copy()
-            if sample_next_obs and k in self._obs_keys:
-                out[f"next_{k}"] = arr[(batch_idxes + 1) % self._buffer_size, env_idxes]
+            g = native.gather_rows(arr, batch_idxes, env_idxes)
+            if g is None:
+                g = arr[batch_idxes, env_idxes]
                 if clone:
-                    out[f"next_{k}"] = out[f"next_{k}"].copy()
+                    g = g.copy()
+            out[k] = g
+            if sample_next_obs and k in self._obs_keys:
+                nxt = native.gather_rows(arr, (batch_idxes + 1) % self._buffer_size, env_idxes)
+                if nxt is None:
+                    nxt = arr[(batch_idxes + 1) % self._buffer_size, env_idxes]
+                    if clone:
+                        nxt = nxt.copy()
+                out[f"next_{k}"] = nxt
         return out
 
     def sample_device(
@@ -345,22 +352,45 @@ class SequentialReplayBuffer(ReplayBuffer):
             start_idxes = valid[self._rng.integers(0, len(valid), size=(batch_dim,), dtype=np.intp)]
         else:
             start_idxes = self._rng.integers(0, self._pos - span + 1, size=(batch_dim,), dtype=np.intp)
-        offsets = np.arange(sequence_length, dtype=np.intp)
-        idxes = (start_idxes[:, None] + offsets[None, :]) % self._buffer_size  # [batch_dim, L]
         # one env per sequence
         env_idxes = self._rng.integers(0, self._n_envs, size=(batch_dim,), dtype=np.intp)
-        env_idxes_tiled = np.repeat(env_idxes[:, None], sequence_length, axis=1)
+
+        # numpy-fallback index grids, built once and only if the native path
+        # declines (they are pure overhead on the C++ hot path)
+        _grids: List[np.ndarray] = []
+
+        def _fallback_grids():
+            if not _grids:
+                offsets = np.arange(sequence_length, dtype=np.intp)
+                _grids.append((start_idxes[:, None] + offsets[None, :]) % self._buffer_size)
+                _grids.append(np.repeat(env_idxes[:, None], sequence_length, axis=1))
+            return _grids[0], _grids[1]
 
         out: Dict[str, np.ndarray] = {}
         for k, v in self._buf.items():
             arr = np.asarray(v)
-            g = arr[idxes, env_idxes_tiled]  # [batch_dim, L, ...]
-            g = g.reshape(n_samples, batch_size, sequence_length, *g.shape[2:]).swapaxes(1, 2)
-            out[k] = g.copy() if clone else g
+            # native path: one multi-threaded C++ pass writes the final
+            # contiguous [n_samples, L, batch, ...] layout (gather + transpose
+            # fused), so the host->device DMA reads sequential memory
+            g = native.gather_sequences(
+                arr, start_idxes, env_idxes, sequence_length, n_samples, batch_size
+            )
+            if g is None:
+                idxes, env_tiled = _fallback_grids()
+                g = arr[idxes, env_tiled]  # [batch_dim, L, ...]
+                g = g.reshape(n_samples, batch_size, sequence_length, *g.shape[2:]).swapaxes(1, 2)
+                g = g.copy() if clone else g
+            out[k] = g
             if sample_next_obs and k in self._obs_keys:
-                nxt = arr[(idxes + 1) % self._buffer_size, env_idxes_tiled]
-                nxt = nxt.reshape(n_samples, batch_size, sequence_length, *nxt.shape[2:]).swapaxes(1, 2)
-                out[f"next_{k}"] = nxt.copy() if clone else nxt
+                nxt = native.gather_sequences(
+                    arr, start_idxes, env_idxes, sequence_length, n_samples, batch_size, shift=1
+                )
+                if nxt is None:
+                    idxes, env_tiled = _fallback_grids()
+                    nxt = arr[(idxes + 1) % self._buffer_size, env_tiled]
+                    nxt = nxt.reshape(n_samples, batch_size, sequence_length, *nxt.shape[2:]).swapaxes(1, 2)
+                    nxt = nxt.copy() if clone else nxt
+                out[f"next_{k}"] = nxt
         return out
 
 

@@ -41,6 +41,67 @@ class TensorBoardLogger:
         self._writer.close()
 
 
+class MlflowLogger:
+    """MLflow run logger with the same log_metrics / log_hyperparams /
+    finalize surface (reference: lightning MLFlowLogger selected by
+    sheeprl/configs/logger/mlflow.yaml). Import-gated — building it without
+    mlflow installed raises at construction, not at framework import."""
+
+    def __init__(
+        self,
+        tracking_uri: Optional[str] = None,
+        experiment_name: str = "sheeprl_tpu",
+        run_name: Optional[str] = None,
+        tags: Optional[Dict[str, Any]] = None,
+        log_dir: Optional[str] = None,
+    ) -> None:
+        from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+        if not _IS_MLFLOW_AVAILABLE:
+            raise ModuleNotFoundError(
+                "logger.name=mlflow requires the 'mlflow' package (pip install mlflow)"
+            )
+        import mlflow
+
+        self._mlflow = mlflow
+        self.log_dir = log_dir
+        if tracking_uri is None:
+            tracking_uri = os.environ.get("MLFLOW_TRACKING_URI")
+        if tracking_uri:
+            mlflow.set_tracking_uri(tracking_uri)
+        mlflow.set_experiment(experiment_name)
+        self._run = mlflow.start_run(run_name=run_name, tags=tags)
+        self.run_id = self._run.info.run_id
+
+    def log_metrics(self, metrics: Mapping[str, float], step: int) -> None:
+        import math
+
+        # drop non-finite values: SQL-backed mlflow stores reject NaN/inf
+        clean = {k: float(v) for k, v in metrics.items() if math.isfinite(v)}
+        if clean:
+            self._mlflow.log_metrics(clean, step=step)
+
+    def log_hyperparams(self, params: Mapping[str, Any]) -> None:
+        def _flatten(d: Mapping[str, Any], prefix: str = "") -> Dict[str, Any]:
+            out: Dict[str, Any] = {}
+            for k, v in d.items():
+                key = f"{prefix}{k}"
+                if isinstance(v, Mapping):
+                    out.update(_flatten(v, key + "."))
+                else:
+                    out[key] = v
+            return out
+
+        flat = _flatten(dict(params))
+        # mlflow caps params per batch; chunk defensively
+        items = list(flat.items())
+        for i in range(0, len(items), 100):
+            self._mlflow.log_params(dict(items[i : i + 100]))
+
+    def finalize(self) -> None:
+        self._mlflow.end_run()
+
+
 class NoOpLogger:
     """Used on non-zero processes and when logging is disabled."""
 
@@ -56,15 +117,21 @@ class NoOpLogger:
         pass
 
 
+def run_base_dir(cfg: Mapping[str, Any], root_dir: Optional[str] = None, run_name: Optional[str] = None) -> str:
+    """The run's TB root ``<log_base_dir>/<root_dir>/<run_name>`` — the parent
+    of the versioned dirs; also where profiler traces land."""
+    root_dir = root_dir or cfg["root_dir"]
+    run_name = run_name or cfg["run_name"]
+    base_dir = cfg.get("log_base_dir") or os.path.join("logs", "runs")
+    return os.path.join(base_dir, root_dir, run_name)
+
+
 def get_log_dir(cfg: Mapping[str, Any], root_dir: Optional[str] = None, run_name: Optional[str] = None) -> str:
     """Versioned run directory ``<root>/<run_name>/version_N``, chosen once on
     process 0 and broadcast (reference logger.py:39-89)."""
     import jax
 
-    root_dir = root_dir or cfg["root_dir"]
-    run_name = run_name or cfg["run_name"]
-    base_dir = cfg.get("log_base_dir") or os.path.join("logs", "runs")
-    base = os.path.join(base_dir, root_dir, run_name)
+    base = run_base_dir(cfg, root_dir, run_name)
     if jax.process_index() == 0:
         version = 0
         while os.path.isdir(os.path.join(base, f"version_{version}")):
@@ -88,4 +155,12 @@ def get_logger(cfg: Mapping[str, Any], log_dir: str):
     kind = str(logger_cfg.get("name", "tensorboard")).lower()
     if kind == "tensorboard":
         return TensorBoardLogger(log_dir)
-    raise ValueError(f"unknown logger {kind!r}; available: ['tensorboard']")
+    if kind == "mlflow":
+        return MlflowLogger(
+            tracking_uri=logger_cfg.get("tracking_uri"),
+            experiment_name=str(logger_cfg.get("experiment_name", cfg.get("exp_name", "sheeprl_tpu"))),
+            run_name=logger_cfg.get("mlflow_run_name") or cfg.get("run_name"),
+            tags=logger_cfg.get("tags"),
+            log_dir=log_dir,
+        )
+    raise ValueError(f"unknown logger {kind!r}; available: ['tensorboard', 'mlflow']")

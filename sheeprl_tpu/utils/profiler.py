@@ -1,0 +1,43 @@
+"""Optional XLA profiler hook (SURVEY.md §5 tracing: "same wall-clock timers
+plus optional ``jax.profiler.trace`` hooks").
+
+The reference has no torch-profiler integration; on TPU the XLA trace is the
+native tool — it records HLO timelines, per-op device time, and HBM traffic
+viewable in TensorBoard's profile plugin or Perfetto.  Enabled via config:
+
+    metric.profiler.enabled=True [metric.profiler.trace_dir=...]
+
+and wrapped around the whole training entrypoint by the CLI, so one run
+yields one trace directory next to the run's logs.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Any, Iterator, Mapping, Optional
+
+
+@contextmanager
+def maybe_profile(cfg: Mapping[str, Any], log_dir: Optional[str] = None) -> Iterator[Optional[str]]:
+    """Start a ``jax.profiler`` trace when ``metric.profiler.enabled`` is set;
+    no-op (yields None) otherwise. Only process 0 traces — each host tracing
+    its own devices would do, but one trace is what the tooling expects."""
+    prof_cfg = (cfg.get("metric") or {}).get("profiler") or {}
+    enabled = bool(prof_cfg.get("enabled", False))
+    if not enabled:
+        yield None
+        return
+
+    import jax
+
+    if jax.process_index() != 0:
+        yield None
+        return
+    trace_dir = prof_cfg.get("trace_dir") or os.path.join(log_dir or ".", "profile")
+    os.makedirs(trace_dir, exist_ok=True)
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield trace_dir
+    finally:
+        jax.profiler.stop_trace()

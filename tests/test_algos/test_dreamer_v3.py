@@ -67,6 +67,14 @@ def test_dreamer_v3_mlp_only(tmp_path, monkeypatch):
     )
 
 
+def test_dreamer_v3_fused_pallas_recurrent(tmp_path, monkeypatch):
+    """Full train update through the Pallas RSSM-step kernel (interpreter
+    mode on the CPU test mesh; Mosaic-compiled on a real TPU)."""
+    monkeypatch.chdir(tmp_path)
+    run(dv3_args(tmp_path) + ["algo.world_model.recurrent_model.fused=pallas"])
+    assert find_checkpoints(tmp_path)
+
+
 def test_dreamer_v3_resume(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     run(dv3_args(tmp_path))

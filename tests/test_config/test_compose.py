@@ -203,5 +203,5 @@ def test_instantiate():
 def test_builtin_tree_composes():
     cfg = compose("config", ["exp=default", "algo.name=x", "algo.total_steps=1", "algo.per_rank_batch_size=1", "env.id=e", "env.wrapper=w", "buffer.size=8"])
     assert cfg.exp_name == "x_e"
-    assert cfg.metric.logger._target_.endswith("TensorBoardLogger")
+    assert cfg.logger.name == "tensorboard"
     assert cfg.fabric.mesh_axes == ["data"]

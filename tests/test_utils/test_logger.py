@@ -1,0 +1,96 @@
+"""Logger tests: versioned log dir, TB writer, MLflow backend (stubbed)."""
+
+import sys
+import types
+
+import pytest
+
+from sheeprl_tpu.utils.logger import MlflowLogger, NoOpLogger, get_log_dir, get_logger
+
+
+def test_get_log_dir_versions(tmp_path):
+    cfg = {"root_dir": "algo/env", "run_name": "run", "log_base_dir": str(tmp_path)}
+    d0 = get_log_dir(cfg)
+    d1 = get_log_dir(cfg)
+    assert d0.endswith("version_0") and d1.endswith("version_1")
+
+
+def test_get_logger_dispatch(tmp_path):
+    cfg = {"metric": {"log_level": 1}, "logger": {"name": "tensorboard"}}
+    logger = get_logger(cfg, str(tmp_path / "tb"))
+    assert type(logger).__name__ == "TensorBoardLogger"
+    logger.log_metrics({"a": 1.0}, step=0)
+    logger.finalize()
+
+    assert isinstance(get_logger({"metric": {"log_level": 0}}, str(tmp_path)), NoOpLogger)
+    with pytest.raises(ValueError):
+        get_logger({"metric": {"log_level": 1}, "logger": {"name": "wandb"}}, str(tmp_path))
+
+
+class _StubMlflow(types.ModuleType):
+    def __init__(self):
+        super().__init__("mlflow")
+        self.metrics = []
+        self.params = {}
+        self.tracking_uri = None
+        self.experiment = None
+        self.ended = False
+
+    def set_tracking_uri(self, uri):
+        self.tracking_uri = uri
+
+    def set_experiment(self, name):
+        self.experiment = name
+
+    def start_run(self, run_name=None, tags=None):
+        info = types.SimpleNamespace(run_id="stub-run-id")
+        return types.SimpleNamespace(info=info)
+
+    def log_metrics(self, metrics, step=None):
+        self.metrics.append((dict(metrics), step))
+
+    def log_params(self, params):
+        self.params.update(params)
+
+    def end_run(self):
+        self.ended = True
+
+
+@pytest.fixture
+def stub_mlflow(monkeypatch):
+    stub = _StubMlflow()
+    monkeypatch.setitem(sys.modules, "mlflow", stub)
+    import sheeprl_tpu.utils.imports as imports
+
+    monkeypatch.setattr(imports, "_IS_MLFLOW_AVAILABLE", True)
+    return stub
+
+
+def test_mlflow_logger(stub_mlflow, tmp_path):
+    logger = MlflowLogger(
+        tracking_uri="file:///tmp/mlruns", experiment_name="exp", run_name="r0"
+    )
+    assert logger.run_id == "stub-run-id"
+    assert stub_mlflow.tracking_uri == "file:///tmp/mlruns"
+    assert stub_mlflow.experiment == "exp"
+
+    logger.log_metrics({"loss": 1.5, "nan": float("nan")}, step=3)
+    assert stub_mlflow.metrics == [({"loss": 1.5}, 3)]
+
+    logger.log_hyperparams({"algo": {"lr": 1e-3, "name": "ppo"}, "seed": 42})
+    assert stub_mlflow.params == {"algo.lr": 1e-3, "algo.name": "ppo", "seed": 42}
+
+    logger.finalize()
+    assert stub_mlflow.ended
+
+
+def test_get_logger_mlflow_dispatch(stub_mlflow, tmp_path):
+    cfg = {
+        "metric": {"log_level": 1},
+        "exp_name": "exp",
+        "run_name": "run",
+        "logger": {"name": "mlflow", "experiment_name": "exp", "tracking_uri": None},
+    }
+    logger = get_logger(cfg, str(tmp_path))
+    assert isinstance(logger, MlflowLogger)
+    logger.finalize()

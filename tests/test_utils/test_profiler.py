@@ -1,0 +1,33 @@
+"""XLA profiler hook tests."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.utils.profiler import maybe_profile
+
+
+def test_disabled_is_noop():
+    with maybe_profile({"metric": {}}) as trace_dir:
+        assert trace_dir is None
+    with maybe_profile({}) as trace_dir:
+        assert trace_dir is None
+
+
+def test_enabled_writes_trace(tmp_path):
+    cfg = {"metric": {"profiler": {"enabled": True, "trace_dir": str(tmp_path / "prof")}}}
+    with maybe_profile(cfg) as trace_dir:
+        assert trace_dir == str(tmp_path / "prof")
+        jax.block_until_ready(jax.jit(lambda x: x * 2)(jnp.ones((8, 8))))
+    found = []
+    for root, _, files in os.walk(trace_dir):
+        found += files
+    assert found, "profiler trace produced no files"
+
+
+def test_default_dir_from_log_dir(tmp_path):
+    cfg = {"metric": {"profiler": {"enabled": True}}}
+    with maybe_profile(cfg, log_dir=str(tmp_path)) as trace_dir:
+        assert trace_dir == os.path.join(str(tmp_path), "profile")
+        jax.block_until_ready(jnp.ones(4) + 1)
