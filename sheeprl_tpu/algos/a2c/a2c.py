@@ -132,7 +132,13 @@ def main(fabric, cfg: Dict[str, Any]):
         observation_space,
         state["agent"] if cfg.checkpoint.resume_from else None,
     )
-    player = PPOPlayer(agent, params)
+    from sheeprl_tpu.parallel.fabric import resolve_player_device
+
+    player = PPOPlayer(
+        agent, params, device=resolve_player_device(
+            cfg.algo.get("player_device", "auto"), has_cnn=bool(cfg.algo.cnn_keys.encoder)
+        )
+    )
 
     rollout_steps = int(cfg.algo.rollout_steps)
     policy_steps_per_update = num_envs * rollout_steps * num_processes
@@ -169,6 +175,11 @@ def main(fabric, cfg: Dict[str, Any]):
     last_train = 0
 
     key = jax.random.PRNGKey(int(cfg.seed))
+    # action keys live on the player's device so a host-pinned player
+    # never blocks on a chip round trip per env step
+    from sheeprl_tpu.parallel.fabric import put_tree as _put_tree
+
+    player_key = _put_tree(jax.random.fold_in(key, 1), player.device)
     next_obs, _ = envs.reset(seed=cfg.seed)
     next_obs = prepare_obs(next_obs, num_envs=num_envs)
 
@@ -177,7 +188,7 @@ def main(fabric, cfg: Dict[str, Any]):
         with timer("Time/env_interaction_time"):
             for _ in range(rollout_steps):
                 policy_step += num_envs * num_processes
-                key, action_key = jax.random.split(key)
+                player_key, action_key = jax.random.split(player_key)
                 actions, logprobs, values = player.get_actions(next_obs, action_key)
                 actions_np, logprobs_np, values_np = jax.device_get((actions, logprobs, values))
                 if is_continuous:

@@ -396,7 +396,19 @@ def build_agent(
     actor_params = fabric.replicate(actor_params)
     critic_params = fabric.replicate(critic_params)
 
+    from sheeprl_tpu.parallel.fabric import resolve_player_device
+
     player = PlayerDV2(
-        wm, wm_params, actor, actor_params, actions_dim, int(cfg["env"]["num_envs"]), int(cfg["seed"])
+        wm,
+        wm_params,
+        actor,
+        actor_params,
+        actions_dim,
+        int(cfg["env"]["num_envs"]),
+        int(cfg["seed"]),
+        device=resolve_player_device(
+            cfg["algo"].get("player_device", "auto"),
+            has_cnn=bool(cfg["algo"]["cnn_keys"]["encoder"]),
+        ),
     )
     return wm, wm_params, actor, actor_params, critic, critic_params, player

@@ -41,6 +41,7 @@ from sheeprl_tpu.ops.distributions import (
     TanhNormal,
     TruncatedNormal,
 )
+from sheeprl_tpu.parallel.fabric import HostPlayerParams, put_tree, resolve_player_device
 
 Array = jax.Array
 
@@ -591,10 +592,15 @@ class CriticDV2(nn.Module):
         return _dense(1, jnp.float32)(x)
 
 
-class PlayerDV2:
+class PlayerDV2(HostPlayerParams):
     """Stateful env-interaction handle (reference PlayerDV2,
     agent.py:735-860): per-env (h, z, prev_action) advanced by one jitted
-    observe+act step; zero initial states."""
+    observe+act step; zero initial states.
+
+    ``device`` optionally pins the observe+act step to the host CPU backend
+    (learner-on-chip/actor-on-host; see ``parallel.fabric.resolve_player_device``)."""
+
+    _placed_attrs = ("wm_params", "actor_params")
 
     def __init__(
         self,
@@ -605,9 +611,11 @@ class PlayerDV2:
         actions_dim: Sequence[int],
         num_envs: int,
         seed: int = 0,
+        device: Optional[Any] = None,
     ) -> None:
         self.wm = wm
         self.actor = actor
+        self.device = device  # must precede the param assignments below
         self.wm_params = wm_params
         self.actor_params = actor_params
         self.actions_dim = tuple(actions_dim)
@@ -634,8 +642,10 @@ class PlayerDV2:
 
     def init_states(self, reset_envs: Optional[Sequence[int]] = None) -> None:
         if reset_envs is None or len(reset_envs) == 0:
-            self.h = jnp.zeros((self.num_envs, self.wm.recurrent_state_size), jnp.float32)
-            self.z = jnp.zeros((self.num_envs, self.wm.stoch_state_size), jnp.float32)
+            # host-side zeros: uncommitted, so the jitted step pulls them
+            # onto whichever backend the params live on
+            self.h = np.zeros((self.num_envs, self.wm.recurrent_state_size), np.float32)
+            self.z = np.zeros((self.num_envs, self.wm.stoch_state_size), np.float32)
             self.actions = np.zeros((self.num_envs, int(np.sum(self.actions_dim))), np.float32)
         else:
             mask = np.zeros((self.num_envs, 1), np.float32)
@@ -655,7 +665,7 @@ class PlayerDV2:
         with_exploration: bool = False,
     ) -> Array:
         action, h, z = self._step(
-            self.wm_params, self.actor_params, obs, self.h, self.z, self.actions, key, greedy
+            self.wm_params, self.actor_params, obs, self.h, self.z, self.actions, put_tree(key, self.device), greedy
         )
         self.h, self.z = h, z
         actions = np.asarray(jax.device_get(action))
@@ -798,6 +808,13 @@ def build_agent(
     target_critic_params = fabric.replicate(target_critic_params)
 
     player = PlayerDV2(
-        wm, wm_params, actor, actor_params, actions_dim, int(cfg["env"]["num_envs"]), int(cfg["seed"])
+        wm,
+        wm_params,
+        actor,
+        actor_params,
+        actions_dim,
+        int(cfg["env"]["num_envs"]),
+        int(cfg["seed"]),
+        device=resolve_player_device(cfg["algo"].get("player_device", "auto"), has_cnn=bool(cnn_keys)),
     )
     return wm, wm_params, actor, actor_params, critic, critic_params, target_critic_params, player

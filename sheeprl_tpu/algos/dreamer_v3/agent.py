@@ -37,6 +37,7 @@ from sheeprl_tpu.ops.distributions import (
 )
 from sheeprl_tpu.ops.math import symlog
 from sheeprl_tpu.ops.pallas_gru import fused_recurrent_step, resolve_backend
+from sheeprl_tpu.parallel.fabric import HostPlayerParams, put_tree
 
 Array = jax.Array
 
@@ -264,6 +265,10 @@ class FusedRecurrentModel(nn.Module):
 
     recurrent_state_size: int
     dense_units: int
+    # accepted for signature parity with RecurrentModel but NOT used: the
+    # Pallas kernel always computes in fp32 (LayerNorm statistics dominate
+    # and the weights are VMEM-resident, so bf16 would save no bandwidth —
+    # only cost precision in the gate math)
     dtype: Any = jnp.float32
     eps: float = 1e-3
     interpret: bool = False
@@ -724,7 +729,7 @@ def make_critic(cfg_critic: Dict[str, Any], dtype: Any) -> MLP:
     )
 
 
-class PlayerDV3:
+class PlayerDV3(HostPlayerParams):
     """Stateful env-interaction handle (reference PlayerDV3,
     agent.py:596-691): keeps (h, z, prev_action) per env and advances them
     with one jitted observe+act step.
@@ -732,7 +737,15 @@ class PlayerDV3:
     The recurrent state lives ON DEVICE between steps — with a
     remote-attached chip, pulling (h, z) to host every step doubles the
     per-step round trips; only the action is downloaded. Per-env resets are
-    a jitted masked blend instead of host-side indexing."""
+    a jitted masked blend instead of host-side indexing.
+
+    ``device`` (see ``parallel.fabric.resolve_player_device``) optionally
+    pins the player to the host CPU backend: the observe+act step then runs
+    host-side with zero chip round trips per env step, and ``update_params``
+    streams fresh learner params chip→host once per train block — the
+    learner-on-chip/actor-on-host split for remote-attached chips."""
+
+    _placed_attrs = ("wm_params", "actor_params")
 
     def __init__(
         self,
@@ -742,9 +755,11 @@ class PlayerDV3:
         actor_params: Any,
         actions_dim: Sequence[int],
         num_envs: int,
+        device: Optional[Any] = None,
     ) -> None:
         self.wm = wm
         self.actor = actor
+        self.device = device  # must precede the param assignments below
         self.wm_params = wm_params
         self.actor_params = actor_params
         self.actions_dim = tuple(actions_dim)
@@ -783,11 +798,20 @@ class PlayerDV3:
         )
         self._masked_reset = jax.jit(_masked_reset)
 
+    def update_params(self, wm_params: Any, actor_params: Any) -> None:
+        """Refresh the player's weights from the learner's (async device_put
+        when the player is pinned to another backend — the transfer overlaps
+        the next env steps and the next train dispatch)."""
+        self.wm_params = wm_params
+        self.actor_params = actor_params
+
     def init_states(self, reset_envs: Optional[Sequence[int]] = None) -> None:
         if reset_envs is None or len(reset_envs) == 0:
             h0, z0 = self._initial(self.wm_params, self.num_envs)
             self.h, self.z = h0, z0
-            self.actions = jnp.zeros((self.num_envs, int(np.sum(self.actions_dim))), jnp.float32)
+            # host-side zeros: uncommitted, so the next jitted step pulls
+            # them onto whichever backend the params live on
+            self.actions = np.zeros((self.num_envs, int(np.sum(self.actions_dim))), np.float32)
         else:
             mask = np.zeros((self.num_envs, 1), np.float32)
             mask[list(reset_envs)] = 1.0
@@ -802,6 +826,9 @@ class PlayerDV3:
         greedy: bool = False,
         mask: Optional[Dict[str, Array]] = None,
     ) -> Array:
+        # keys minted on another backend would clash with host-pinned params
+        # (committed-device mismatch) — re-place; identity when aligned
+        key = put_tree(key, self.device)
         # only the MinedojoActor honors masks — the base Actor ignores them,
         # matching the reference's forward signatures (agent.py:783, :882)
         if mask and isinstance(self.actor, MinedojoActor):
@@ -944,5 +971,20 @@ def build_agent(
     critic_params = fabric.replicate(critic_params)
     target_critic_params = fabric.replicate(target_critic_params)
 
-    player = PlayerDV3(wm, wm_params, actor, actor_params, actions_dim, int(cfg["env"]["num_envs"]))
+    from sheeprl_tpu.parallel.fabric import resolve_player_device
+
+    player_device = resolve_player_device(cfg["algo"].get("player_device", "auto"), has_cnn=bool(cnn_keys))
+    # a host-pinned player runs on the CPU backend, where the Pallas TPU
+    # kernel cannot execute — swap in the flax GRU cell (identical param
+    # tree, pallas_gru docstring) for the player's module only
+    player_wm = wm.clone(fused_recurrent="flax") if player_device is not None else wm
+    player = PlayerDV3(
+        player_wm,
+        wm_params,
+        actor,
+        actor_params,
+        actions_dim,
+        int(cfg["env"]["num_envs"]),
+        device=player_device,
+    )
     return wm, wm_params, actor, actor_params, critic, critic_params, target_critic_params, player

@@ -460,6 +460,12 @@ def main(fabric, cfg: Dict[str, Any]):
     key = jax.random.PRNGKey(int(cfg.seed))
     if cfg.checkpoint.resume_from and "rng_key" in state:
         key = jnp.asarray(state["rng_key"])
+    # action sampling draws from its own stream committed to the player's
+    # device, so a host-pinned player (agent.PlayerDV3 device) never waits on
+    # a chip round trip for a key
+    from sheeprl_tpu.parallel.fabric import put_tree
+
+    player_key = put_tree(jax.random.fold_in(key, 1), player.device)
 
     # first observation (reference dreamer_v3.py:534-543)
     step_data: Dict[str, np.ndarray] = {}
@@ -474,6 +480,7 @@ def main(fabric, cfg: Dict[str, Any]):
     player.init_states()
 
     cumulative_per_rank_gradient_steps = 0
+    pending_metrics: list = []  # device-resident metric vectors, fetched at log time
     for update in range(start_step, num_updates + 1):
         policy_step += num_envs * num_processes
 
@@ -489,7 +496,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         axis=-1,
                     )
             else:
-                key, action_key = jax.random.split(key)
+                player_key, action_key = jax.random.split(player_key)
                 prepared = prepare_obs(obs, cnn_keys=cnn_keys, num_envs=num_envs)
                 mask = {k: v for k, v in prepared.items() if k.startswith("mask")}
                 actions = player.get_actions(prepared, action_key, mask=mask or None)
@@ -621,16 +628,28 @@ def main(fabric, cfg: Dict[str, Any]):
                             train_key,
                         )
                         cumulative_per_rank_gradient_steps += 1
-                    metrics = np.asarray(jax.device_get(metrics))
+                    if not timer.disabled:
+                        # only when timing: wait so Time/train_time measures
+                        # the chip, not the async dispatch
+                        jax.block_until_ready(wm_params)
                     train_step += num_processes
-                player.wm_params = wm_params
-                player.actor_params = actor_params
+                player.update_params(wm_params, actor_params)
                 if cfg.metric.log_level > 0:
-                    for name, value in zip(METRIC_ORDER, metrics):
-                        aggregator.update(name, float(value))
+                    # keep the metric vector ON DEVICE: fetching here would
+                    # serialize the async train dispatch against the host
+                    # loop (one chip round trip per train block); the queue
+                    # drains at log time instead
+                    pending_metrics.append(metrics)
 
         # ---------------- logging ---------------- #
         if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or update == num_updates):
+            if pending_metrics:
+                # stack ON DEVICE first: one transfer for the whole window
+                # instead of one round trip per train block
+                for metrics_np in np.asarray(jax.device_get(jnp.stack(pending_metrics))):
+                    for name, value in zip(METRIC_ORDER, metrics_np):
+                        aggregator.update(name, float(value))
+                pending_metrics.clear()
             metrics_dict = aggregator.compute()
             logger.log_metrics(metrics_dict, policy_step)
             aggregator.reset()

@@ -563,6 +563,11 @@ def main(fabric, cfg: Dict[str, Any]):
     key = jax.random.PRNGKey(int(cfg.seed))
     if cfg.checkpoint.resume_from and "rng_key" in state:
         key = jnp.asarray(state["rng_key"])
+    # action keys live on the player's device so a host-pinned player
+    # never blocks on a chip round trip per env step
+    from sheeprl_tpu.parallel.fabric import put_tree as _put_tree
+
+    player_key = _put_tree(jax.random.fold_in(key, 1), player.device)
 
     step_data: Dict[str, np.ndarray] = {}
     obs, _ = envs.reset(seed=cfg.seed)
@@ -593,7 +598,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         axis=-1,
                     )
             else:
-                key, action_key = jax.random.split(key)
+                player_key, action_key = jax.random.split(player_key)
                 prepared = prepare_obs(obs, cnn_keys=cnn_keys, num_envs=num_envs)
                 actions = player.get_actions(
                     prepared, action_key, expl_step=policy_step, with_exploration=True

@@ -20,6 +20,7 @@ import numpy as np
 
 from sheeprl_tpu.models import MLP, NatureCNN
 from sheeprl_tpu.ops.distributions import Categorical, Independent, Normal
+from sheeprl_tpu.parallel.fabric import HostPlayerParams, put_tree
 
 Array = jax.Array
 
@@ -187,20 +188,30 @@ def evaluate_actions(
     return logprob, entropy, values
 
 
-class PPOPlayer:
+class PPOPlayer(HostPlayerParams):
     """Host-side convenience handle for rollout/eval: module + params with
-    jitted action/value functions (reference PPOPlayer, agent.py:194-251)."""
+    jitted action/value functions (reference PPOPlayer, agent.py:194-251).
 
-    def __init__(self, agent: PPOAgent, params: Any) -> None:
+    ``device`` optionally pins inference to the host CPU backend so env
+    stepping never waits on a remote-chip round trip; ``update_params``
+    streams learner params across (see ``parallel.fabric.resolve_player_device``)."""
+
+    _placed_attrs = ("params",)
+
+    def __init__(self, agent: PPOAgent, params: Any, device: Optional[Any] = None) -> None:
         self.agent = agent
+        self.device = device  # must precede the params assignment
         self.params = params
         self._sample = jax.jit(
             lambda p, o, k, greedy: sample_actions(agent, p, o, k, greedy), static_argnames="greedy"
         )
         self._values = jax.jit(lambda p, o: agent.apply(p, o)[1])
 
+    def update_params(self, params: Any) -> None:
+        self.params = params
+
     def get_actions(self, obs: Dict[str, Array], key: Array, greedy: bool = False):
-        return self._sample(self.params, obs, key, greedy)
+        return self._sample(self.params, obs, put_tree(key, self.device), greedy)
 
     def get_values(self, obs: Dict[str, Array]) -> Array:
         return self._values(self.params, obs)

@@ -40,6 +40,7 @@ from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.envs import make_env
 from sheeprl_tpu.ops.math import gae
+from sheeprl_tpu.parallel.fabric import put_tree, resolve_player_device
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -179,7 +180,9 @@ def main(fabric, cfg: Dict[str, Any]):
         observation_space,
         state["agent"] if cfg.checkpoint.resume_from else None,
     )
-    player = PPOPlayer(agent, params)
+    player = PPOPlayer(
+        agent, params, device=resolve_player_device(cfg.algo.get("player_device", "auto"), has_cnn=bool(cnn_keys))
+    )
 
     num_envs = int(cfg.env.num_envs)
     rollout_steps = int(cfg.algo.rollout_steps)
@@ -242,6 +245,9 @@ def main(fabric, cfg: Dict[str, Any]):
     key = jax.random.PRNGKey(int(cfg.seed))
     if cfg.checkpoint.resume_from and "rng_key" in state:
         key = jnp.asarray(state["rng_key"])
+    # rollout action keys live on the player's device so a host-pinned
+    # player never blocks on a chip round trip per env step
+    player_key = put_tree(jax.random.fold_in(key, 1), player.device)
 
     clip_coef = float(cfg.algo.clip_coef)
     ent_coef = float(cfg.algo.ent_coef)
@@ -254,7 +260,7 @@ def main(fabric, cfg: Dict[str, Any]):
         with timer("Time/env_interaction_time"):
             for _ in range(rollout_steps):
                 policy_step += num_envs * fabric.num_processes
-                key, action_key = jax.random.split(key)
+                player_key, action_key = jax.random.split(player_key)
                 actions, logprobs, values = player.get_actions(next_obs, action_key)
                 # ONE device->host fetch per step: over a remote-attached TPU
                 # a round trip costs ~100ms, so separate np.asarray() calls on
@@ -307,13 +313,15 @@ def main(fabric, cfg: Dict[str, Any]):
 
         local_data = {k: np.stack(v, axis=0) for k, v in rollout.items()}  # [T, E, ...]
 
-        # GAE on device (reference ppo.py:345-360)
+        # GAE on the player's device (reference ppo.py:345-360) — rollout
+        # arrays are host-side already, so with a host-pinned player the
+        # whole advantage pass stays off the chip's round-trip path
         next_values = np.asarray(player.get_values(next_obs))  # [E, 1]
         returns, advantages = gae_fn(
-            jnp.asarray(local_data["rewards"]),
-            jnp.asarray(local_data["values"]),
-            jnp.asarray(local_data["dones"]),
-            jnp.asarray(next_values),
+            put_tree(local_data["rewards"], player.device),
+            put_tree(local_data["values"], player.device),
+            put_tree(local_data["dones"], player.device),
+            put_tree(next_values, player.device),
         )
         local_data["returns"] = np.asarray(returns)
         local_data["advantages"] = np.asarray(advantages)
@@ -335,7 +343,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 jnp.float32(ent_coef),
             )
             metrics = jax.block_until_ready(metrics)
-        player.params = params
+        player.update_params(params)
         train_step += world_size
 
         if cfg.metric.log_level > 0:

@@ -144,7 +144,11 @@ def _player(fabric, cfg):
     # identical deterministic init on every process replaces the reference's
     # startup param broadcast (:126-130)
     agent, params = build_agent(LocalFabric(fabric), actions_dim, is_continuous, cfg, observation_space, None)
-    player = PPOPlayer(agent, params)
+    from sheeprl_tpu.parallel.fabric import resolve_player_device
+
+    player = PPOPlayer(
+        agent, params, device=resolve_player_device(cfg.algo.get("player_device", "auto"), has_cnn=bool(cnn_keys))
+    )
 
     if fabric.is_global_zero:
         save_configs(cfg, log_dir)
@@ -158,6 +162,11 @@ def _player(fabric, cfg):
     policy_step = 0
     last_log = 0
     key = jax.random.PRNGKey(int(cfg.seed))
+    # action keys live on the player's device so a host-pinned player
+    # never blocks on a chip round trip per env step
+    from sheeprl_tpu.parallel.fabric import put_tree as _put_tree
+
+    player_key = _put_tree(jax.random.fold_in(key, 1), player.device)
     next_obs, _ = envs.reset(seed=cfg.seed)
     next_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=num_envs)
 
@@ -166,7 +175,7 @@ def _player(fabric, cfg):
         with timer("Time/env_interaction_time"):
             for _ in range(rollout_steps):
                 policy_step += num_envs
-                key, action_key = jax.random.split(key)
+                player_key, action_key = jax.random.split(player_key)
                 actions, logprobs, values = player.get_actions(next_obs, action_key)
                 actions_np, logprobs_np, values_np = jax.device_get((actions, logprobs, values))
                 if is_continuous:
@@ -228,7 +237,9 @@ def _player(fabric, cfg):
         # receive the updated params (+ metrics, + opt state when
         # checkpointing) back from trainer rank 1 (reference :304-308)
         payload = broadcast_object(None, src=1)
-        player.params = jax.device_put(payload["params"])
+        # pre-upload once so per-step action sampling doesn't re-stage host
+        # arrays (device=None places on the default backend)
+        player.params = jax.device_put(payload["params"], player.device)
 
         if cfg.metric.log_level > 0:
             aggregator.update("Loss/policy_loss", float(payload["metrics"][0]))

@@ -20,6 +20,7 @@ import numpy as np
 from sheeprl_tpu.algos.ppo.agent import CNNEncoder, MLPEncoder
 from sheeprl_tpu.models import MLP
 from sheeprl_tpu.ops.distributions import Categorical, Independent, Normal
+from sheeprl_tpu.parallel.fabric import HostPlayerParams, put_tree
 
 Array = jax.Array
 
@@ -199,13 +200,19 @@ def evaluate_actions(
     return logprob, entropy, values
 
 
-class RecurrentPPOPlayer:
+class RecurrentPPOPlayer(HostPlayerParams):
     """Host-side rollout handle: params + jitted single-step functions; the
     caller owns the recurrent state (reference player usage,
-    ppo_recurrent.py:283-371)."""
+    ppo_recurrent.py:283-371).
 
-    def __init__(self, agent: RecurrentPPOAgent, params: Any) -> None:
+    ``device`` optionally pins inference to the host CPU backend
+    (see ``parallel.fabric.resolve_player_device``)."""
+
+    _placed_attrs = ("params",)
+
+    def __init__(self, agent: RecurrentPPOAgent, params: Any, device: Optional[Any] = None) -> None:
         self.agent = agent
+        self.device = device  # must precede the params assignment
         self.params = params
         self._sample = jax.jit(
             lambda p, o, pa, hx, cx, k, greedy: sample_actions(agent, p, o, pa, hx, cx, k, greedy),
@@ -213,8 +220,11 @@ class RecurrentPPOPlayer:
         )
         self._values = jax.jit(lambda p, o, pa, hx, cx: agent.apply(p, o, pa, hx, cx)[1])
 
+    def update_params(self, params: Any) -> None:
+        self.params = params
+
     def get_actions(self, obs, prev_actions, hx, cx, key, greedy: bool = False):
-        return self._sample(self.params, obs, prev_actions, hx, cx, key, greedy)
+        return self._sample(self.params, obs, prev_actions, hx, cx, put_tree(key, self.device), greedy)
 
     def get_values(self, obs, prev_actions, hx, cx) -> Array:
         return self._values(self.params, obs, prev_actions, hx, cx)

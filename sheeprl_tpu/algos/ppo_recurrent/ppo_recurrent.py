@@ -260,7 +260,13 @@ def main(fabric, cfg: Dict[str, Any]):
         observation_space,
         state["agent"] if cfg.checkpoint.resume_from else None,
     )
-    player = RecurrentPPOPlayer(agent, params)
+    from sheeprl_tpu.parallel.fabric import resolve_player_device
+
+    player = RecurrentPPOPlayer(
+        agent, params, device=resolve_player_device(
+            cfg.algo.get("player_device", "auto"), has_cnn=bool(cfg.algo.cnn_keys.encoder)
+        )
+    )
 
     rollout_steps = int(cfg.algo.rollout_steps)
     seq_len = int(cfg.algo.per_rank_sequence_length)
@@ -304,6 +310,11 @@ def main(fabric, cfg: Dict[str, Any]):
     key = jax.random.PRNGKey(int(cfg.seed))
     if cfg.checkpoint.resume_from and "rng_key" in state:
         key = jnp.asarray(state["rng_key"])
+    # action keys live on the player's device so a host-pinned player
+    # never blocks on a chip round trip per env step
+    from sheeprl_tpu.parallel.fabric import put_tree as _put_tree
+
+    player_key = _put_tree(jax.random.fold_in(key, 1), player.device)
 
     clip_coef = float(cfg.algo.clip_coef)
     ent_coef = float(cfg.algo.ent_coef)
@@ -333,7 +344,7 @@ def main(fabric, cfg: Dict[str, Any]):
         with timer("Time/env_interaction_time"):
             for _ in range(rollout_steps):
                 policy_step += num_envs * fabric.num_processes
-                key, action_key = jax.random.split(key)
+                player_key, action_key = jax.random.split(player_key)
                 obs_t = {k: v[None] for k, v in next_obs.items()}
                 actions, logprobs, values, new_hx, new_cx = player.get_actions(
                     obs_t, prev_actions[None], hx, cx, action_key

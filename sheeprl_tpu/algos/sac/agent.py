@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.parallel.fabric import HostPlayerParams, put_tree
+
 Array = jax.Array
 
 LOG_STD_MAX = 2.0
@@ -131,19 +133,29 @@ def critic_ensemble_apply(critic: SACCritic, stacked_params: Any, obs: Array, ac
     return jnp.moveaxis(qs[..., 0], 0, -1)
 
 
-class SACPlayer:
-    """Rollout/eval policy handle (reference SACPlayer, agent.py:270-314)."""
+class SACPlayer(HostPlayerParams):
+    """Rollout/eval policy handle (reference SACPlayer, agent.py:270-314).
 
-    def __init__(self, actor: SACActor, params: Any) -> None:
+    ``device`` optionally pins inference to the host CPU backend
+    (learner-on-chip/actor-on-host for remote-attached chips; see
+    ``parallel.fabric.resolve_player_device``)."""
+
+    _placed_attrs = ("params",)
+
+    def __init__(self, actor: SACActor, params: Any, device: Optional[Any] = None) -> None:
         self.actor = actor
+        self.device = device  # must precede the params assignment
         self.params = params
         self._sample = jax.jit(lambda p, o, k: actor_action_and_log_prob(actor, p, o, k)[0])
         self._greedy = jax.jit(lambda p, o: actor_greedy_action(actor, p, o))
 
+    def update_params(self, params: Any) -> None:
+        self.params = params
+
     def get_actions(self, obs: Array, key: Optional[Array] = None, greedy: bool = False) -> np.ndarray:
         if greedy:
             return np.asarray(self._greedy(self.params, obs))
-        return np.asarray(self._sample(self.params, obs, key))
+        return np.asarray(self._sample(self.params, obs, put_tree(key, self.device)))
 
 
 def build_agent(
@@ -208,5 +220,11 @@ def build_agent(
             num_critics=n_critics,
         )
         agent.target_critic_params = fabric.replicate(agent.target_critic_params)
-    player = SACPlayer(actor, agent.actor_params)
+    from sheeprl_tpu.parallel.fabric import resolve_player_device
+
+    player = SACPlayer(
+        actor,
+        agent.actor_params,
+        device=resolve_player_device(cfg["algo"].get("player_device", "auto")),
+    )
     return agent, player

@@ -260,6 +260,11 @@ def main(fabric, cfg: Dict[str, Any]):
 
     key = jax.random.PRNGKey(int(cfg.seed))
     grad_counter = jnp.zeros((), jnp.int32)
+    # action keys stay on the player's device (no chip round trip per step
+    # when the player is host-pinned)
+    from sheeprl_tpu.parallel.fabric import put_tree
+
+    player_key = put_tree(jax.random.fold_in(key, 1), player.device)
 
     obs, _ = envs.reset(seed=cfg.seed)
     cumulative_per_rank_gradient_steps = 0
@@ -271,7 +276,7 @@ def main(fabric, cfg: Dict[str, Any]):
             if update <= learning_starts:
                 actions = envs.action_space.sample()
             else:
-                key, action_key = jax.random.split(key)
+                player_key, action_key = jax.random.split(player_key)
                 np_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=num_envs)
                 actions = player.get_actions(np_obs, action_key)
             next_obs, rewards, terminated, truncated, infos = envs.step(
@@ -352,7 +357,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     metrics = np.asarray(jax.device_get(metrics))
                     train_step += num_processes
                 cumulative_per_rank_gradient_steps += per_rank_gradient_steps
-                player.params = agent.actor_params
+                player.update_params(agent.actor_params)
                 if cfg.metric.log_level > 0:
                     aggregator.update("Loss/value_loss", float(metrics[0]))
                     aggregator.update("Loss/policy_loss", float(metrics[1]))

@@ -115,6 +115,11 @@ def _player(fabric, cfg):
 
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     key = jax.random.PRNGKey(int(cfg.seed))
+    # action keys live on the player's device so a host-pinned player
+    # never blocks on a chip round trip per env step
+    from sheeprl_tpu.parallel.fabric import put_tree as _put_tree
+
+    player_key = _put_tree(jax.random.fold_in(key, 1), player.device)
 
     policy_step = 0
     last_log = 0
@@ -129,7 +134,7 @@ def _player(fabric, cfg):
             if update <= learning_starts:
                 actions = envs.action_space.sample()
             else:
-                key, action_key = jax.random.split(key)
+                player_key, action_key = jax.random.split(player_key)
                 np_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=num_envs)
                 actions = player.get_actions(np_obs, action_key)
             next_obs, rewards, terminated, truncated, infos = envs.step(
@@ -179,7 +184,7 @@ def _player(fabric, cfg):
         broadcast_object(data, src=0)
         payload = broadcast_object(None, src=1)
         if payload is not None:
-            player.params = jax.device_put(payload["actor"])
+            player.params = jax.device_put(payload["actor"], player.device)
             if cfg.metric.log_level > 0:
                 aggregator.update("Loss/value_loss", float(payload["metrics"][0]))
                 aggregator.update("Loss/policy_loss", float(payload["metrics"][1]))

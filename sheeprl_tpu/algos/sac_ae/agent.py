@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.models import MLP
+from sheeprl_tpu.parallel.fabric import HostPlayerParams, put_tree, resolve_player_device
 
 Array = jax.Array
 
@@ -281,12 +282,25 @@ class SACAEAgent:
         self.num_critics = num_critics
 
 
-class SACAEPlayer:
-    """Rollout/eval policy handle (reference SACAEPlayer, agent.py:523-560)."""
+class SACAEPlayer(HostPlayerParams):
+    """Rollout/eval policy handle (reference SACAEPlayer, agent.py:523-560).
 
-    def __init__(self, encoder: SACAEEncoder, actor: SACAEActorTrunk, encoder_params: Any, actor_params: Any) -> None:
+    ``device`` optionally pins inference to the host CPU backend
+    (see ``parallel.fabric.resolve_player_device``)."""
+
+    _placed_attrs = ("encoder_params", "actor_params")
+
+    def __init__(
+        self,
+        encoder: SACAEEncoder,
+        actor: SACAEActorTrunk,
+        encoder_params: Any,
+        actor_params: Any,
+        device: Optional[Any] = None,
+    ) -> None:
         self.encoder = encoder
         self.actor = actor
+        self.device = device  # must precede the param assignments below
         self.encoder_params = encoder_params
         self.actor_params = actor_params
 
@@ -304,7 +318,7 @@ class SACAEPlayer:
     def get_actions(self, obs: Dict[str, Array], key: Optional[Array] = None, greedy: bool = False) -> np.ndarray:
         if greedy:
             return np.asarray(self._greedy(self.encoder_params, self.actor_params, obs))
-        return np.asarray(self._sample(self.encoder_params, self.actor_params, obs, key))
+        return np.asarray(self._sample(self.encoder_params, self.actor_params, obs, put_tree(key, self.device)))
 
 
 def build_agent(
@@ -417,5 +431,11 @@ def build_agent(
         agent.target_encoder_params = fabric.replicate(agent.target_encoder_params)
         agent.target_qfs_params = fabric.replicate(agent.target_qfs_params)
 
-    player = SACAEPlayer(encoder, actor, agent.encoder_params, agent.actor_params)
+    player = SACAEPlayer(
+        encoder,
+        actor,
+        agent.encoder_params,
+        agent.actor_params,
+        device=resolve_player_device(cfg["algo"].get("player_device", "auto"), has_cnn=bool(cnn_keys)),
+    )
     return agent, player

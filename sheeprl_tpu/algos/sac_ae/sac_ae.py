@@ -362,6 +362,11 @@ def main(fabric, cfg: Dict[str, Any]):
         ratio.load_state_dict(state["ratio"])
 
     key = jax.random.PRNGKey(int(cfg.seed))
+    # action keys live on the player's device so a host-pinned player
+    # never blocks on a chip round trip per env step
+    from sheeprl_tpu.parallel.fabric import put_tree as _put_tree
+
+    player_key = _put_tree(jax.random.fold_in(key, 1), player.device)
     grad_counter = jnp.zeros((), jnp.int32)
 
     obs, _ = envs.reset(seed=cfg.seed)
@@ -374,7 +379,7 @@ def main(fabric, cfg: Dict[str, Any]):
             if update <= learning_starts:
                 actions = envs.action_space.sample()
             else:
-                key, action_key = jax.random.split(key)
+                player_key, action_key = jax.random.split(player_key)
                 np_obs = prepare_obs(obs, cnn_keys=cnn_keys, num_envs=num_envs)
                 actions = player.get_actions(np_obs, action_key)
             next_obs, rewards, terminated, truncated, infos = envs.step(

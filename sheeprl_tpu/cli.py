@@ -17,7 +17,7 @@ import warnings
 from typing import Any, Dict, List, Optional
 
 from sheeprl_tpu.config import compose
-from sheeprl_tpu.config.compose import instantiate
+from sheeprl_tpu.config.compose import compose_group, instantiate
 from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry
 from sheeprl_tpu.utils.utils import dotdict, print_config
 
@@ -201,15 +201,28 @@ def evaluation(args: Optional[List[str]] = None) -> None:
     cfg.checkpoint_path = ckpt_path
     cfg.env.num_envs = 1
     cfg.env.capture_video = kv.get("env.capture_video", "False").lower() in ("1", "true")
-    cfg.fabric["devices"] = 1
     for k, v in kv.items():
         if k in ("checkpoint_path", "env.capture_video"):
+            continue
+        value = yaml.safe_load(v)
+        if "." not in k and isinstance(cfg.get(k), dict) and isinstance(value, str):
+            # `fabric=cpu` style group re-selection: re-compose the group
+            # (hydra semantics), don't overwrite the subtree with a string
+            cfg[k] = dotdict(compose_group(k, value))
             continue
         node = cfg
         parts = k.split(".")
         for p in parts[:-1]:
             node = node[p]
-        node[parts[-1]] = yaml.safe_load(v)
+        node[parts[-1]] = value
+    # a spliced group may carry ${...} interpolations (e.g. logger=mlflow's
+    # ${exp_name}) — resolve them against the full tree before use
+    from sheeprl_tpu.config.compose import resolve
+
+    cfg = dotdict(resolve(cfg))
+    # evaluation always runs single-device (reference cli.py:363-387) — after
+    # the overrides so a group re-selection cannot undo it
+    cfg.fabric["devices"] = 1
     eval_algorithm(cfg)
 
 
@@ -241,16 +254,22 @@ def registration(args: Optional[List[str]] = None) -> None:
     for k, v in kv.items():
         if k == "checkpoint_path":
             continue
+        value = yaml.safe_load(v)
+        if "." not in k and isinstance(cfg.get(k), dict) and isinstance(value, str):
+            cfg[k] = dotdict(compose_group(k, value))
+            continue
         node = cfg
         parts = k.split(".")
         for p in parts[:-1]:
             node = node.setdefault(p, dotdict({})) if isinstance(node, dict) else node[p]
-        node[parts[-1]] = yaml.safe_load(v)
+        node[parts[-1]] = value
 
+    from sheeprl_tpu.config.compose import resolve
     from sheeprl_tpu.parallel.fabric import Fabric
     from sheeprl_tpu.utils.checkpoint import load_checkpoint
     from sheeprl_tpu.utils.model_manager import register_model_from_checkpoint
 
+    cfg = dotdict(resolve(cfg))
     fabric = Fabric(devices=1, precision=str(cfg.fabric.get("precision", "fp32")))
     state = load_checkpoint(ckpt_path)
 

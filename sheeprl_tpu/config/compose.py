@@ -441,6 +441,21 @@ def compose(
     return dotdict(out)
 
 
+def compose_group(group: str, option: str, search_path: Optional[Sequence[str]] = None) -> dict:
+    """Compose a single config group's subtree (``<group>/<option>.yaml``
+    with its sibling-include defaults) and return just that subtree.
+
+    Used by the eval/registration CLIs, whose base config comes from a
+    checkpoint's ``config.yaml`` rather than full composition: a
+    ``group=option`` override there must re-compose the group the way
+    ``hydra`` would, not assign the bare string."""
+    sp = list(search_path) if search_path else _default_search_path()
+    composer = _Composer(sp, Overrides())
+    out: dict = {}
+    composer.compose_file(os.path.join(group, option), out)
+    return out.get(group, out)
+
+
 def _has_nested(d: Mapping, dotted: str) -> bool:
     node: Any = d
     for part in dotted.split("."):

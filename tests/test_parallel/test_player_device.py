@@ -1,0 +1,139 @@
+"""Host-player placement specs (learner-on-chip / actor-on-host split).
+
+No reference counterpart — the torch player always shares the trainer's
+device; this framework adds ``algo.player_device`` for remote-attached chips
+(parallel/fabric.py ``resolve_player_device`` / ``HostPlayerParams``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.parallel.fabric import (
+    HostPlayerParams,
+    _ParamStreamer,
+    dispatch_roundtrip_seconds,
+    put_tree,
+    resolve_player_device,
+)
+
+
+def test_param_streamer_roundtrip_exact():
+    """Mixed-dtype tree survives the flat byte-vector transfer bit-exact."""
+    dev = jax.devices("cpu")[0]
+    tree = {
+        "a": jnp.ones((3, 5), jnp.float32) * 1.5,
+        "b": {"c": jnp.arange(7, dtype=jnp.int32), "d": jnp.full((2, 2, 2), 0.25, jnp.bfloat16)},
+        "e": jnp.float32(3.25),
+    }
+    s = _ParamStreamer(tree, dev)
+    out = s(tree)
+    for l1, l2 in zip(jax.tree.leaves(tree), jax.tree.leaves(out)):
+        assert l1.shape == l2.shape and l1.dtype == l2.dtype
+        assert np.array_equal(np.asarray(l1, np.float32), np.asarray(l2, np.float32))
+    assert s.matches(tree)
+    assert not s.matches({"a": tree["a"]})
+
+
+def test_resolve_accelerator_is_none():
+    assert resolve_player_device("accelerator") is None
+    assert resolve_player_device(None) is None
+
+
+def test_resolve_cpu_on_cpu_backend_is_none():
+    # the test session runs on the CPU backend: "cpu" means "already there"
+    assert resolve_player_device("cpu") is None
+
+
+def test_resolve_auto_on_cpu_backend_is_none():
+    assert resolve_player_device("auto") is None
+    # conv policies always stay on the training backend under auto
+    assert resolve_player_device("auto", has_cnn=True) is None
+
+
+def test_resolve_unknown_spec_raises():
+    with pytest.raises(ValueError):
+        resolve_player_device("gpu0")
+
+
+def test_dispatch_roundtrip_is_fast_locally():
+    # virtual CPU devices are in-process: far below the 5 ms remote threshold
+    assert dispatch_roundtrip_seconds() < 0.005
+
+
+def test_put_tree_identity_without_device():
+    tree = {"a": np.ones((2,), np.float32)}
+    assert put_tree(tree, None) is tree
+
+
+def test_put_tree_places_on_device():
+    dev = jax.devices("cpu")[0]
+    out = put_tree({"a": np.ones((2,), np.float32)}, dev)
+    assert out["a"].devices() == {dev}
+
+
+class _Player(HostPlayerParams):
+    _placed_attrs = ("params",)
+
+    def __init__(self, params, device=None):
+        self.device = device
+        self.params = params
+
+
+def test_mixin_passthrough_without_device():
+    p = _Player({"w": np.zeros((2,), np.float32)})
+    assert isinstance(p.params["w"], np.ndarray)
+
+
+def test_mixin_places_assignments():
+    dev = jax.devices("cpu")[0]
+    p = _Player({"w": np.zeros((2,), np.float32)}, device=dev)
+    assert p.params["w"].devices() == {dev}
+    # every later assignment is placed too — the loops' `player.params = ...`
+    # sync sites rely on this
+    p.params = {"w": np.ones((2,), np.float32)}
+    assert p.params["w"].devices() == {dev}
+    assert float(p.params["w"][0]) == 1.0
+
+
+def test_mixin_ignores_other_attrs():
+    dev = jax.devices("cpu")[0]
+    p = _Player({"w": np.zeros((2,), np.float32)}, device=dev)
+    p.note = np.ones((1,), np.float32)
+    assert isinstance(p.note, np.ndarray)
+
+
+def test_player_on_explicit_device_end_to_end():
+    """A PPOPlayer pinned to an explicit device samples actions correctly and
+    keeps its params there after an update_params refresh."""
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.ppo.agent import PPOPlayer, build_agent
+    from sheeprl_tpu.parallel import Fabric
+
+    cfg = {
+        "algo": {
+            "cnn_keys": {"encoder": []},
+            "mlp_keys": {"encoder": ["state"]},
+            "encoder": {"cnn_features_dim": 64, "mlp_features_dim": 16, "dense_units": 8, "mlp_layers": 1},
+            "actor": {"dense_units": 8, "mlp_layers": 1},
+            "critic": {"dense_units": 8, "mlp_layers": 1},
+            "dense_act": "tanh",
+            "layer_norm": False,
+        },
+        "seed": 0,
+    }
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-1, 1, (3,), np.float32)})
+    fabric = Fabric(devices=1)
+    agent, params = build_agent(fabric, (2,), False, cfg, obs_space)
+    dev = jax.devices("cpu")[0]
+    player = PPOPlayer(agent, params, device=dev)
+
+    obs = {"state": np.zeros((4, 3), np.float32)}
+    actions, logprobs, values = player.get_actions(obs, jax.random.PRNGKey(0))
+    assert np.asarray(actions).shape == (4, 2)
+    # refresh params through the sync path used by the train loop
+    player.update_params(params)
+    leaf = jax.tree.leaves(player.params)[0]
+    assert leaf.devices() == {dev}
