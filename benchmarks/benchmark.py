@@ -1,0 +1,38 @@
+"""Wall-clock benchmark harness (reference: benchmarks/benchmark.py).
+
+Runs one of the ``*_benchmarks`` exp configs end to end through the CLI and
+prints the elapsed seconds. The reference selects the workload by commenting
+blocks in and out; here it's an argument:
+
+    python benchmarks/benchmark.py ppo [extra overrides...]
+    python benchmarks/benchmark.py dreamer_v3 fabric.devices=2
+
+Workloads: ppo, a2c, sac, dreamer_v1, dreamer_v2, dreamer_v3.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+
+WORKLOADS = ("ppo", "a2c", "sac", "dreamer_v1", "dreamer_v2", "dreamer_v3")
+
+
+def main() -> None:
+    if len(sys.argv) < 2 or sys.argv[1] not in WORKLOADS:
+        raise SystemExit(f"usage: python benchmarks/benchmark.py <{'|'.join(WORKLOADS)}> [overrides...]")
+    workload, extra = sys.argv[1], sys.argv[2:]
+
+    from sheeprl_tpu.cli import run
+
+    tic = time.perf_counter()
+    run([f"exp={workload}_benchmarks", *extra])
+    print(time.perf_counter() - tic)
+
+
+if __name__ == "__main__":
+    main()

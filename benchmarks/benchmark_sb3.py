@@ -1,0 +1,53 @@
+"""Stable-Baselines3 comparison harness (reference: benchmarks/benchmark_sb3.py).
+
+Times ``model.learn(total_timesteps=1024 * 64)`` for the SB3 PPO/A2C/SAC
+counterparts of the ``*_benchmarks`` workloads with the same wall-clock
+timer the framework uses, so the numbers are directly comparable with
+``benchmarks/benchmark.py``. Requires ``stable_baselines3`` (not a framework
+dependency); exits cleanly when absent.
+
+    python benchmarks/benchmark_sb3.py ppo    # CartPole-v1
+    python benchmarks/benchmark_sb3.py a2c    # CartPole-v1
+    python benchmarks/benchmark_sb3.py sac    # LunarLanderContinuous-v2
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+TOTAL_TIMESTEPS = 1024 * 64
+
+
+def main() -> None:
+    try:
+        import stable_baselines3 as sb3
+    except ImportError:
+        raise SystemExit("stable_baselines3 is not installed — skipping the SB3 comparison")
+    import gymnasium as gym
+
+    from sheeprl_tpu.utils.timer import timer
+
+    algo = sys.argv[1] if len(sys.argv) > 1 else "ppo"
+    with timer("run_time"):
+        if algo == "ppo":
+            env = gym.make("CartPole-v1", render_mode="rgb_array")
+            model = sb3.PPO("MlpPolicy", env, verbose=0, device="cpu", n_steps=128)
+        elif algo == "a2c":
+            env = gym.make("CartPole-v1", render_mode="rgb_array")
+            model = sb3.A2C("MlpPolicy", env, verbose=0, device="cpu", vf_coef=1.0)
+        elif algo == "sac":
+            env = gym.make("LunarLanderContinuous-v2", render_mode="rgb_array")
+            model = sb3.SAC("MlpPolicy", env, verbose=0, device="cpu")
+        else:
+            raise SystemExit(f"unknown workload {algo!r}; use ppo/a2c/sac")
+        model.learn(total_timesteps=TOTAL_TIMESTEPS, log_interval=None)
+    print(timer.compute())
+    print(sb3.common.evaluation.evaluate_policy(model.policy, env))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Template for a decoupled (player / buffer / trainer) RL topology on the
+TPU-native runtime (reference: examples/architecture_template.py, which
+builds the same roles from lightning ``TorchCollective`` groups).
+
+Roles, one process each (process index = role):
+
+    0           player   — steps envs, ships transitions
+    1           buffer   — owns the replay store, samples batches
+    2..N-1      trainers — run the jitted update on their own device mesh,
+                            stream fresh params back to the player
+
+All host-object traffic rides ``sheeprl_tpu.parallel.collectives`` (pickled
+objects over a jax.distributed all-gather — the gloo-object-collective
+replacement); device math stays inside each role's jitted functions. The
+production implementations of this topology are
+``sheeprl_tpu/algos/ppo/ppo_decoupled.py`` and
+``sheeprl_tpu/algos/sac/sac_decoupled.py`` (player + trainer roles, buffer
+owned by the player).
+
+Launch N processes with the env-var coordinator, e.g. for N=3:
+
+    for i in 0 1 2; do
+        SHEEPRL_TPU_COORDINATOR=127.0.0.1:3333 \
+        SHEEPRL_TPU_NUM_PROCESSES=3 \
+        SHEEPRL_TPU_PROCESS_ID=$i \
+        JAX_PLATFORMS=cpu python examples/architecture_template.py &
+    done; wait
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.parallel.collectives import broadcast_object, gather_object
+from sheeprl_tpu.parallel.fabric import Fabric
+
+ROUNDS = 3
+
+
+def player() -> None:
+    rng = np.random.default_rng(0)
+    for round_ in range(ROUNDS):
+        # fresh params from trainer rank 2 (flat host arrays)
+        params = broadcast_object(None, src=2)
+        print(f"player: round {round_} got params {params['w'][:2]}...")
+        # "play the game": collect fake transitions with the current params
+        data = {"obs": rng.normal(size=(8, 4)).astype(np.float32)}
+        gather_object(data, dst=1)  # ship to the buffer
+        broadcast_object(None, src=1)  # stay aligned with the batch broadcast
+    broadcast_object(None, src=2)  # final params, unused
+
+
+def buffer() -> None:
+    store = []
+    for _ in range(ROUNDS):
+        broadcast_object(None, src=2)  # stay aligned with the param broadcast
+        shards = gather_object(None, dst=1)
+        store.extend(d for d in shards if d is not None)
+        # sample a batch and ship it to the trainers
+        batch = store[-1]
+        broadcast_object(batch, src=1)
+    broadcast_object(None, src=2)
+
+
+def trainer(fabric: Fabric) -> None:
+    params = {"w": np.zeros(4, np.float32)}
+
+    @jax.jit
+    def update(w, obs):
+        return w + 0.01 * obs.mean(axis=0)
+
+    for _ in range(ROUNDS):
+        broadcast_object(params, src=2)  # params to the player
+        gather_object(None, dst=1)  # stay aligned with the data gather
+        batch = broadcast_object(None, src=1)
+        params = {"w": np.asarray(update(jnp.asarray(params["w"]), jnp.asarray(batch["obs"])))}
+        print(f"trainer {jax.process_index()}: updated params to {params['w'][:2]}...")
+    broadcast_object(params, src=2)
+
+
+def main() -> None:
+    fabric = Fabric(precision="fp32")  # reads the SHEEPRL_TPU_* coordinator env vars
+    if jax.process_count() < 3:
+        raise SystemExit("launch at least 3 processes (player, buffer, trainer) — see module docstring")
+    role = jax.process_index()
+    if role == 0:
+        player()
+    elif role == 1:
+        buffer()
+    else:
+        trainer(fabric)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Model-manager walkthrough (reference: examples/model_manager.ipynb).
+
+Registers a trained checkpoint, lists the latest version, transitions its
+stage, downloads it, and deletes it — against the file-backed local registry
+(swap ``LocalModelManager`` for ``MlflowModelManager`` when mlflow is
+installed and ``logger=mlflow`` is configured). The per-algorithm sub-model
+registration used in production goes through the registration CLI instead:
+``python -m sheeprl_tpu.cli_registration checkpoint_path=<ckpt>``.
+
+Run a quick training first so a checkpoint exists, e.g.:
+
+    python -m sheeprl_tpu exp=ppo dry_run=True checkpoint.save_last=True \
+        env.capture_video=False metric.log_level=0
+    python examples/model_manager.py logs/runs/ppo/*/version_0/checkpoint/*.ckpt
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import tempfile
+
+from sheeprl_tpu.parallel.fabric import Fabric
+from sheeprl_tpu.utils.model_manager import LocalModelManager
+
+
+def main() -> None:
+    if len(sys.argv) < 2:
+        raise SystemExit("usage: python examples/model_manager.py <checkpoint.ckpt>")
+    ckpt_path = sys.argv[1]
+    fabric = Fabric(devices=1, precision="fp32")
+
+    with tempfile.TemporaryDirectory() as registry_dir:
+        manager = LocalModelManager(fabric, registry_dir)
+        manager.register_model(ckpt_path, "ppo_agent", description="PPO agent from the example")
+        record = manager.get_latest_version("ppo_agent")
+        print(f"latest version: {record['version']} (stage {record['stage']})")
+        manager.transition_model("ppo_agent", record["version"], stage="staging", description="promoting")
+        with tempfile.TemporaryDirectory() as out:
+            manager.download_model("ppo_agent", record["version"], out)
+            print(f"downloaded version {record['version']} to {out}")
+        manager.delete_model("ppo_agent", record["version"], description="example cleanup")
+        print("deleted the example version")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Print the observation space an agent would see for a given env config
+(reference: examples/observation_space.py).
+
+    python examples/observation_space.py agent=dreamer_v3 env=dmc env.id=walker_walk
+
+``agent`` selects the algorithm whose obs-key config shapes the dict space;
+every other override is the usual config syntax.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+from sheeprl_tpu.config.compose import compose
+from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.utils.registry import algorithm_registry
+from sheeprl_tpu.utils.utils import dotdict
+
+
+def main() -> None:
+    overrides = list(sys.argv[1:])
+    kv = dict(o.split("=", 1) for o in overrides if "=" in o)
+    agent = kv.pop("agent", "dreamer_v3")
+    registered = {e["name"] for entries in algorithm_registry.values() for e in entries}
+    if agent not in registered:
+        raise SystemExit(
+            f"invalid agent {agent!r}; run `python -m sheeprl_tpu.cli_agents` for the list"
+        )
+    rest = [o for o in overrides if not o.startswith("agent=")]
+    cfg = dotdict(compose("config", [f"exp={agent}", "env.capture_video=False", *rest]))
+    env = make_env(cfg, cfg.seed, 0)()
+    print()
+    print(f"Observation space of `{cfg.env.id}` environment for `{agent}` agent:")
+    print(env.observation_space)
+    env.close()
+
+
+if __name__ == "__main__":
+    import sheeprl_tpu  # noqa: F401  (registers the algorithms)
+
+    main()

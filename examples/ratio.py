@@ -1,0 +1,40 @@
+"""Replay-ratio walkthrough (reference: examples/ratio.py).
+
+Shows how :class:`sheeprl_tpu.utils.utils.Ratio` converts policy-step deltas
+into per-rank gradient-step repeats — the knob behind
+``algo.replay_ratio`` in every off-policy/Dreamer config (see
+howto/work_with_steps.md).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu.utils.utils import Ratio
+
+if __name__ == "__main__":
+    num_envs = 1
+    world_size = 1
+    replay_ratio = 0.0625
+    per_rank_batch_size = 16
+    per_rank_sequence_length = 64
+    learning_starts = 128
+    total_policy_steps = 2**10
+
+    replayed_steps = world_size * per_rank_batch_size * per_rank_sequence_length
+    r = Ratio(ratio=replay_ratio, pretrain_steps=0)
+    policy_steps_per_iter = num_envs * world_size
+    gradient_steps = 0
+    for i in range(0, total_policy_steps, policy_steps_per_iter):
+        if i >= learning_starts:
+            per_rank_repeats = r(i / world_size)
+            if per_rank_repeats > 0:
+                print(
+                    f"iteration {i}: {per_rank_repeats} per-rank repeats "
+                    f"({per_rank_repeats * world_size} global)"
+                )
+            gradient_steps += per_rank_repeats * world_size
+    print("Replay ratio", replay_ratio)
+    print("Hafner train ratio", replay_ratio * replayed_steps)
+    print("Final ratio", gradient_steps / total_policy_steps)

@@ -129,6 +129,17 @@ def test_ppo_evaluate_roundtrip(tmp_path, monkeypatch):
     evaluation([f"checkpoint_path={ckpt}"])
 
 
+def test_ppo_evaluate_group_override(tmp_path, monkeypatch):
+    """`fabric=cpu` on the eval CLI must re-compose the fabric group (hydra
+    semantics), not overwrite cfg.fabric with the bare string."""
+    monkeypatch.chdir(tmp_path)
+    run(standard_args(tmp_path))
+    (ckpt,) = find_checkpoints(tmp_path)
+    from sheeprl_tpu.cli import evaluation
+
+    evaluation([f"checkpoint_path={ckpt}", "fabric=cpu"])
+
+
 def test_ppo_unknown_algo_error(tmp_path):
     with pytest.raises(ValueError, match="no registered algorithm"):
         run(standard_args(tmp_path) + ["algo.name=not_an_algo"])

@@ -205,3 +205,27 @@ def test_builtin_tree_composes():
     assert cfg.exp_name == "x_e"
     assert cfg.logger.name == "tensorboard"
     assert cfg.fabric.mesh_axes == ["data"]
+
+
+def test_compose_group_subtree():
+    """compose_group returns just the group's composed subtree (used by the
+    eval/registration CLIs for `group=option` overrides on checkpoint
+    configs)."""
+    from sheeprl_tpu.config.compose import compose_group
+
+    fab = compose_group("fabric", "cpu")
+    assert isinstance(fab, dict)
+    assert fab["accelerator"] == "cpu"
+    # sibling-include defaults of the group are applied
+    assert "precision" in fab
+
+
+def test_compose_group_interpolations_resolve_in_context():
+    """Interpolations inside a spliced group resolve against the full tree
+    (the eval CLI calls resolve() after splicing)."""
+    from sheeprl_tpu.config.compose import compose_group, resolve
+
+    logger = compose_group("logger", "tensorboard")
+    tree = {"exp_name": "myexp", "run_name": "r1", "root_dir": "d", "logger": logger}
+    resolved = resolve(tree)
+    assert "${" not in str(resolved["logger"])
