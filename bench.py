@@ -28,9 +28,10 @@ import time
 # measured on this host (see BASELINE.md "Measured baselines"):
 # python benchmarks/dv3_torch_baseline.py 2048
 _DV3_TORCH_CPU_SPS = 4.16
-# python bench.py's PPO workload counterpart: reference-class torch-CPU PPO
-# throughput is not measurable here either; the PPO number is reported
-# without a ratio and is informational only.
+# python benchmarks/ppo_torch_baseline.py 32768 (same workload shape as
+# bench_ppo: 64 envs, rollout 128, 10 epochs, 512 minibatch, 2x64 MLP);
+# measured on this host 2026-07-30 (BASELINE.md "Measured baselines")
+_PPO_TORCH_CPU_SPS = 12912.91
 
 DV3_STEPS = 2048
 PPO_STEPS = 32768
@@ -67,44 +68,67 @@ def _dv3_args(total_steps: int, learning_starts: int = 512):
 
 
 def bench_dv3() -> float:
-    import jax
+    import os
+    import tempfile
 
     from sheeprl_tpu.cli import run
 
-    # persistent compilation cache: the warmup run compiles the fused train
-    # step + player graphs once; the timed run hits the cache so the metric
-    # is steady-state throughput, not compile time
-    jax.config.update("jax_compilation_cache_dir", "/tmp/sheeprl_tpu_jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    # the warmup must reach real gradient steps (learning_starts=256 ->
-    # 64 updates of 4 envs fill one 64-step sequence) or the fused train
-    # step would compile inside the timed window
-    run(_dv3_args(288, learning_starts=256))
+    # ONE process, one run: the training loop itself records steady-state
+    # throughput from update ``learning_starts + 64`` (everything compiled
+    # and warm) to the last update via SHEEPRL_TPU_BENCH_JSON — no persistent
+    # compile cache, no second run whose jits must round-trip a cache
+    with tempfile.TemporaryDirectory() as d:
+        probe = os.path.join(d, "dv3_bench.json")
+        os.environ["SHEEPRL_TPU_BENCH_JSON"] = probe
+        try:
+            run(_dv3_args(DV3_STEPS))
+        finally:
+            os.environ.pop("SHEEPRL_TPU_BENCH_JSON", None)
+        rec = _read_probe(probe, "dreamer_v3")
+    return rec["steps"] / rec["seconds"]
 
-    start = time.perf_counter()
-    run(_dv3_args(DV3_STEPS))
-    return DV3_STEPS / (time.perf_counter() - start)
+
+def _read_probe(path, workload):
+    import os
+
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"the {workload} run finished without reaching its steady-state mark "
+            "(SteadyStateProbe never fired) — the workload is too short to measure; "
+            "raise total_steps or lower learning_starts"
+        )
+    with open(path) as f:
+        return json.load(f)
 
 
 def bench_ppo() -> float:
+    import os
+    import tempfile
+
     from sheeprl_tpu.cli import run
 
-    start = time.perf_counter()
-    run(
-        [
-            "exp=ppo",
-            f"algo.total_steps={PPO_STEPS}",
-            "env.num_envs=64",
-            "algo.per_rank_batch_size=512",
-            "env.capture_video=False",
-            "buffer.memmap=False",
-            "algo.run_test=False",
-            "checkpoint.every=10000000",
-            "checkpoint.save_last=False",
-            "metric.log_level=0",
-        ]
-    )
-    return PPO_STEPS / (time.perf_counter() - start)
+    with tempfile.TemporaryDirectory() as d:
+        probe = os.path.join(d, "ppo_bench.json")
+        os.environ["SHEEPRL_TPU_BENCH_JSON"] = probe
+        try:
+            run(
+                [
+                    "exp=ppo",
+                    f"algo.total_steps={PPO_STEPS}",
+                    "env.num_envs=64",
+                    "algo.per_rank_batch_size=512",
+                    "env.capture_video=False",
+                    "buffer.memmap=False",
+                    "algo.run_test=False",
+                    "checkpoint.every=10000000",
+                    "checkpoint.save_last=False",
+                    "metric.log_level=0",
+                ]
+            )
+        finally:
+            os.environ.pop("SHEEPRL_TPU_BENCH_JSON", None)
+        rec = _read_probe(probe, "ppo")
+    return rec["steps"] / rec["seconds"]
 
 
 def main() -> None:
@@ -121,6 +145,11 @@ def main() -> None:
                     "metric": "ppo_cartpole_env_steps_per_sec",
                     "value": round(ppo_sps, 2),
                     "unit": "steps/sec",
+                    **(
+                        {"vs_baseline": round(ppo_sps / _PPO_TORCH_CPU_SPS, 3)}
+                        if _PPO_TORCH_CPU_SPS
+                        else {}
+                    ),
                 },
             }
         )

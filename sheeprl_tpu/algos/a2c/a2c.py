@@ -135,9 +135,7 @@ def main(fabric, cfg: Dict[str, Any]):
     from sheeprl_tpu.parallel.fabric import resolve_player_device
 
     player = PPOPlayer(
-        agent, params, device=resolve_player_device(
-            cfg.algo.get("player_device", "auto"), has_cnn=bool(cfg.algo.cnn_keys.encoder)
-        )
+        agent, params, device=resolve_player_device(cfg.algo.get("player_device", "auto"))
     )
 
     rollout_steps = int(cfg.algo.rollout_steps)

@@ -406,9 +406,6 @@ def build_agent(
         actions_dim,
         int(cfg["env"]["num_envs"]),
         int(cfg["seed"]),
-        device=resolve_player_device(
-            cfg["algo"].get("player_device", "auto"),
-            has_cnn=bool(cfg["algo"]["cnn_keys"]["encoder"]),
-        ),
+        device=resolve_player_device(cfg["algo"].get("player_device", "auto")),
     )
     return wm, wm_params, actor, actor_params, critic, critic_params, player

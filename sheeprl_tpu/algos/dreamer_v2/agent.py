@@ -815,6 +815,6 @@ def build_agent(
         actions_dim,
         int(cfg["env"]["num_envs"]),
         int(cfg["seed"]),
-        device=resolve_player_device(cfg["algo"].get("player_device", "auto"), has_cnn=bool(cnn_keys)),
+        device=resolve_player_device(cfg["algo"].get("player_device", "auto")),
     )
     return wm, wm_params, actor, actor_params, critic, critic_params, target_critic_params, player

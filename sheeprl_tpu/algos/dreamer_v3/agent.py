@@ -799,11 +799,14 @@ class PlayerDV3(HostPlayerParams):
         self._masked_reset = jax.jit(_masked_reset)
 
     def update_params(self, wm_params: Any, actor_params: Any) -> None:
-        """Refresh the player's weights from the learner's (async device_put
-        when the player is pinned to another backend — the transfer overlaps
-        the next env steps and the next train dispatch)."""
-        self.wm_params = wm_params
-        self.actor_params = actor_params
+        """Refresh the player's weights from the learner's. In host-player
+        mode the trees stream through the non-blocking pipe
+        (``fabric.HostPlayerParams.stream_attr``): the call returns
+        immediately and the player flips to the new params a train block or
+        two later, once the async device→host copy lands — the env loop
+        never stalls on the link."""
+        self.stream_attr("wm_params", wm_params)
+        self.stream_attr("actor_params", actor_params)
 
     def init_states(self, reset_envs: Optional[Sequence[int]] = None) -> None:
         if reset_envs is None or len(reset_envs) == 0:
@@ -973,7 +976,7 @@ def build_agent(
 
     from sheeprl_tpu.parallel.fabric import resolve_player_device
 
-    player_device = resolve_player_device(cfg["algo"].get("player_device", "auto"), has_cnn=bool(cnn_keys))
+    player_device = resolve_player_device(cfg["algo"].get("player_device", "auto"))
     # a host-pinned player runs on the CPU backend, where the Pallas TPU
     # kernel cannot execute — swap in the flax GRU cell (identical param
     # tree, pallas_gru docstring) for the player's module only

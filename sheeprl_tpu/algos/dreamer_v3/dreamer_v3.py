@@ -45,7 +45,11 @@ from sheeprl_tpu.algos.dreamer_v3.agent import (
 from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
-from sheeprl_tpu.data import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.device_buffer import (
+    DeviceReplayBuffer,
+    adapt_restored_buffer,
+    make_sequential_replay,
+)
 from sheeprl_tpu.data.prefetch import sampled_batches
 from sheeprl_tpu.envs import make_env
 from sheeprl_tpu.envs.wrappers import RestartOnException
@@ -305,7 +309,12 @@ def make_train_fn(
         )
     else:
         train_fn = local_train
-    return jax.jit(train_fn, donate_argnums=(0, 1, 2, 4, 5, 6, 7))
+    # donate only optimizer/aux state: param buffers stay un-donated because
+    # concurrent readers (async param streaming to the host player, the ema /
+    # hard-copy target refresh) may still be in flight when the next train
+    # dispatch would otherwise alias over them (observed on the remote chip
+    # as spurious INVALID_ARGUMENT errors surfacing at unrelated fetches)
+    return jax.jit(train_fn, donate_argnums=(4, 5, 6, 7))
 
 
 @register_algorithm()
@@ -413,19 +422,25 @@ def main(fabric, cfg: Dict[str, Any]):
         aggregator.add(k, "mean")
 
     buffer_size = cfg.buffer.size // int(num_envs * num_processes) if not cfg.dry_run else 2
-    rb = EnvIndependentReplayBuffer(
+    rb = make_sequential_replay(
+        cfg,
+        fabric,
+        observation_space,
+        actions_dim,
         buffer_size,
-        n_envs=num_envs,
-        obs_keys=obs_keys,
-        memmap=cfg.buffer.memmap,
+        num_envs,
+        obs_keys,
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
-        buffer_cls=SequentialReplayBuffer,
         seed=cfg.seed,
     )
+    use_device_rb = isinstance(rb, DeviceReplayBuffer)
     if cfg.checkpoint.resume_from and cfg.buffer.checkpoint:
         from sheeprl_tpu.utils.checkpoint import select_buffer
 
-        rb = select_buffer(state["rb"], rank, num_processes)
+        # checkpoints from either buffer mode resume into this run's mode
+        rb = adapt_restored_buffer(
+            select_buffer(state["rb"], rank, num_processes), use_device_rb, seed=cfg.seed
+        )
 
     # EMA update for the target critic (reference dreamer_v3.py:670-675)
     @jax.jit
@@ -466,6 +481,9 @@ def main(fabric, cfg: Dict[str, Any]):
     from sheeprl_tpu.parallel.fabric import put_tree
 
     player_key = put_tree(jax.random.fold_in(key, 1), player.device)
+    if cfg.checkpoint.resume_from and "player_rng_key" in state:
+        # continue the pre-resume action-sampling stream
+        player_key = put_tree(jnp.asarray(state["player_rng_key"]), player.device)
 
     # first observation (reference dreamer_v3.py:534-543)
     step_data: Dict[str, np.ndarray] = {}
@@ -481,7 +499,19 @@ def main(fabric, cfg: Dict[str, Any]):
 
     cumulative_per_rank_gradient_steps = 0
     pending_metrics: list = []  # device-resident metric vectors, fetched at log time
+    # the loop never blocks on the accelerator; the fence keeps it at most a
+    # few train blocks ahead so the dispatch/transfer queues stay bounded
+    from sheeprl_tpu.parallel.fabric import DispatchFence
+
+    fence = DispatchFence(depth=int(cfg.algo.get("dispatch_fence_depth", 4) or 4))
+    # steady-state throughput probe (bench.py): measure from shortly after
+    # the gradient path has compiled to the final update, in one process
+    from sheeprl_tpu.utils.utils import SteadyStateProbe
+
+    probe = SteadyStateProbe()
     for update in range(start_step, num_updates + 1):
+        if update == learning_starts + 64:
+            probe.mark(policy_step)
         policy_step += num_envs * num_processes
 
         with timer("Time/env_interaction_time"):
@@ -524,11 +554,14 @@ def main(fabric, cfg: Dict[str, Any]):
                 if roe and not dones[i]:
                     # patch the last stored step to a truncation and restart the
                     # episode (reference dreamer_v3.py:591-604)
-                    sub = rb.buffer[i]
-                    last_idx = (sub._pos - 1) % sub.buffer_size
-                    sub["terminated"][last_idx] = 0.0
-                    sub["truncated"][last_idx] = 1.0
-                    sub["is_first"][last_idx] = 0.0
+                    if use_device_rb:
+                        rb.amend_last(i, terminated=0.0, truncated=1.0, is_first=0.0)
+                    else:
+                        sub = rb.buffer[i]
+                        last_idx = (sub._pos - 1) % sub.buffer_size
+                        sub["terminated"][last_idx] = 0.0
+                        sub["truncated"][last_idx] = 1.0
+                        sub["is_first"][last_idx] = 0.0
                     step_data["is_first"][0, i] = 1.0
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
@@ -634,6 +667,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         jax.block_until_ready(wm_params)
                     train_step += num_processes
                 player.update_params(wm_params, actor_params)
+                fence.push(metrics)
                 if cfg.metric.log_level > 0:
                     # keep the metric vector ON DEVICE: fetching here would
                     # serialize the async train dispatch against the host
@@ -702,6 +736,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
                 "rng_key": jax.device_get(key),
+                "player_rng_key": jax.device_get(player_key),
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
             fabric.call(
@@ -711,6 +746,13 @@ def main(fabric, cfg: Dict[str, Any]):
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
+    # drain materializes the newest fence marker too — an actual device sync
+    # on the tunnel (block_until_ready is advisory on the axon client)
+    fence.drain()
+    probe.finish(policy_step)
+    # land any in-flight async param stream so the final evaluation and
+    # model registration use the last update's weights
+    player.flush_stream_attrs()
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         test(player, fabric, cfg, log_dir, greedy=False)

@@ -142,7 +142,12 @@ def make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg):
         )
     else:
         train_fn = local_train
-    return jax.jit(train_fn, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+    # donate only optimizer/aux state: param buffers stay un-donated because
+    # concurrent readers (async param streaming to the host player, the ema /
+    # hard-copy target refresh) may still be in flight when the next train
+    # dispatch would otherwise alias over them (observed on the remote chip
+    # as spurious INVALID_ARGUMENT errors surfacing at unrelated fetches)
+    return jax.jit(train_fn, donate_argnums=(4, 5, 6))
 
 
 @register_algorithm()

@@ -31,7 +31,11 @@ from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
 from sheeprl_tpu.algos.p2e_dv2.agent import build_agent, ensemble_apply
 from sheeprl_tpu.algos.p2e_dv2.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
-from sheeprl_tpu.data import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.device_buffer import (
+    DeviceReplayBuffer,
+    adapt_restored_buffer,
+    make_sequential_replay,
+)
 from sheeprl_tpu.data.prefetch import sampled_batches
 from sheeprl_tpu.envs import make_env
 from sheeprl_tpu.envs.wrappers import RestartOnException
@@ -371,7 +375,12 @@ def make_train_fn(
         )
     else:
         train_fn = local_train
-    return jax.jit(train_fn, donate_argnums=(0, 1, 2, 4, 5, 7, 8, 9, 10, 11, 12, 13))
+    # donate only optimizer/aux state: param buffers stay un-donated because
+    # concurrent readers (async param streaming to the host player, the ema /
+    # hard-copy target refresh) may still be in flight when the next train
+    # dispatch would otherwise alias over them (observed on the remote chip
+    # as spurious INVALID_ARGUMENT errors surfacing at unrelated fetches)
+    return jax.jit(train_fn, donate_argnums=(8, 9, 10, 11, 12, 13))
 
 
 @register_algorithm()
@@ -504,19 +513,25 @@ def main(fabric, cfg: Dict[str, Any]):
         aggregator.add(k, "mean")
 
     buffer_size = cfg.buffer.size // int(num_envs * num_processes) if not cfg.dry_run else 4
-    rb = EnvIndependentReplayBuffer(
+    rb = make_sequential_replay(
+        cfg,
+        fabric,
+        observation_space,
+        actions_dim,
         buffer_size,
-        n_envs=num_envs,
-        obs_keys=obs_keys,
-        memmap=cfg.buffer.memmap,
+        num_envs,
+        obs_keys,
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
-        buffer_cls=SequentialReplayBuffer,
         seed=cfg.seed,
     )
     if cfg.checkpoint.resume_from and cfg.buffer.checkpoint:
         from sheeprl_tpu.utils.checkpoint import select_buffer
 
-        rb = select_buffer(state["rb"], rank, num_processes)
+        rb = adapt_restored_buffer(
+            select_buffer(state["rb"], rank, num_processes),
+            isinstance(rb, DeviceReplayBuffer),
+            seed=cfg.seed,
+        )
 
     # hard target copies (reference :823-833)
     @jax.jit
@@ -568,6 +583,9 @@ def main(fabric, cfg: Dict[str, Any]):
     from sheeprl_tpu.parallel.fabric import put_tree as _put_tree
 
     player_key = _put_tree(jax.random.fold_in(key, 1), player.device)
+    if cfg.checkpoint.resume_from and "player_rng_key" in state:
+        # continue the pre-resume action-sampling stream
+        player_key = _put_tree(jnp.asarray(state["player_rng_key"]), player.device)
 
     step_data: Dict[str, np.ndarray] = {}
     obs, _ = envs.reset(seed=cfg.seed)
@@ -729,8 +747,10 @@ def main(fabric, cfg: Dict[str, Any]):
                         cumulative_per_rank_gradient_steps += 1
                     metrics = np.asarray(jax.device_get(metrics))
                     train_step += num_processes
-                player.wm_params = wm_params
-                player.actor_params = actor_expl_params
+                # non-blocking in host-player mode: the trees stream through the
+                # async pipe and flip a block or two later (fabric.stream_attr)
+                player.stream_attr("wm_params", wm_params)
+                player.stream_attr("actor_params", actor_expl_params)
                 if cfg.metric.log_level > 0:
                     for name, value in zip(METRIC_ORDER, metrics):
                         aggregator.update(name, float(value))
@@ -795,6 +815,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
                 "rng_key": jax.device_get(key),
+                "player_rng_key": jax.device_get(player_key),
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
             fabric.call(
@@ -804,6 +825,9 @@ def main(fabric, cfg: Dict[str, Any]):
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
+    # land any in-flight async param stream so the final evaluation and
+    # model registration use the last update's weights
+    player.flush_stream_attrs()
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         player.actor_params = actor_task_params

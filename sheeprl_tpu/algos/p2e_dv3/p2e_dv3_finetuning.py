@@ -25,7 +25,11 @@ from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import METRIC_ORDER, make_train_fn
 from sheeprl_tpu.algos.p2e_dv3.agent import build_agent
 from sheeprl_tpu.algos.p2e_dv3.utils import AGGREGATOR_KEYS_FINETUNING, prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
-from sheeprl_tpu.data import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.device_buffer import (
+    DeviceReplayBuffer,
+    adapt_restored_buffer,
+    make_sequential_replay,
+)
 from sheeprl_tpu.data.prefetch import sampled_batches
 from sheeprl_tpu.envs import make_env
 from sheeprl_tpu.envs.wrappers import RestartOnException
@@ -166,13 +170,15 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
         aggregator.add(k, "mean")
 
     buffer_size = cfg.buffer.size // int(num_envs * num_processes) if not cfg.dry_run else 4
-    rb = EnvIndependentReplayBuffer(
+    rb = make_sequential_replay(
+        cfg,
+        fabric,
+        observation_space,
+        actions_dim,
         buffer_size,
-        n_envs=num_envs,
-        obs_keys=obs_keys,
-        memmap=cfg.buffer.memmap,
+        num_envs,
+        obs_keys,
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
-        buffer_cls=SequentialReplayBuffer,
         seed=cfg.seed,
     )
     if (resume_from_checkpoint and cfg.buffer.checkpoint) or (
@@ -180,7 +186,11 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     ):
         from sheeprl_tpu.utils.checkpoint import select_buffer
 
-        rb = select_buffer(state["rb"], rank, num_processes)
+        rb = adapt_restored_buffer(
+            select_buffer(state["rb"], rank, num_processes),
+            isinstance(rb, DeviceReplayBuffer),
+            seed=cfg.seed,
+        )
 
     @jax.jit
     def ema(cp, tcp, tau):
@@ -218,6 +228,9 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     from sheeprl_tpu.parallel.fabric import put_tree as _put_tree
 
     player_key = _put_tree(jax.random.fold_in(key, 1), player.device)
+    if cfg.checkpoint.resume_from and "player_rng_key" in state:
+        # continue the pre-resume action-sampling stream
+        player_key = _put_tree(jnp.asarray(state["player_rng_key"]), player.device)
 
     step_data: Dict[str, np.ndarray] = {}
     obs, _ = envs.reset(seed=cfg.seed)
@@ -266,11 +279,14 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
         if "restart_on_exception" in infos:
             for i, roe in enumerate(np.asarray(infos["restart_on_exception"]).reshape(-1)):
                 if roe and not dones[i]:
-                    sub = rb.buffer[i]
-                    last_idx = (sub._pos - 1) % sub.buffer_size
-                    sub["terminated"][last_idx] = 0.0
-                    sub["truncated"][last_idx] = 1.0
-                    sub["is_first"][last_idx] = 0.0
+                    if isinstance(rb, DeviceReplayBuffer):
+                        rb.amend_last(i, terminated=0.0, truncated=1.0, is_first=0.0)
+                    else:
+                        sub = rb.buffer[i]
+                        last_idx = (sub._pos - 1) % sub.buffer_size
+                        sub["terminated"][last_idx] = 0.0
+                        sub["truncated"][last_idx] = 1.0
+                        sub["is_first"][last_idx] = 0.0
                     step_data["is_first"][0, i] = 1.0
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
@@ -370,8 +386,10 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                         cumulative_per_rank_gradient_steps += 1
                     metrics = np.asarray(jax.device_get(metrics))
                     train_step += num_processes
-                player.wm_params = wm_params
-                player.actor_params = actor_task_params
+                # non-blocking in host-player mode: the trees stream through the
+                # async pipe and flip a block or two later (fabric.stream_attr)
+                player.stream_attr("wm_params", wm_params)
+                player.stream_attr("actor_params", actor_task_params)
                 if cfg.metric.log_level > 0:
                     for name, value in zip(METRIC_ORDER, metrics):
                         aggregator.update(name, float(value))
@@ -431,6 +449,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
                 "rng_key": jax.device_get(key),
+                "player_rng_key": jax.device_get(player_key),
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
             fabric.call(
@@ -440,6 +459,9 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
+    # land any in-flight async param stream so the final evaluation and
+    # model registration use the last update's weights
+    player.flush_stream_attrs()
     envs.close()
     # task test few-shot (reference :458-462)
     if fabric.is_global_zero and cfg.algo.run_test:

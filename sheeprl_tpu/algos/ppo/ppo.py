@@ -181,7 +181,7 @@ def main(fabric, cfg: Dict[str, Any]):
         state["agent"] if cfg.checkpoint.resume_from else None,
     )
     player = PPOPlayer(
-        agent, params, device=resolve_player_device(cfg.algo.get("player_device", "auto"), has_cnn=bool(cnn_keys))
+        agent, params, device=resolve_player_device(cfg.algo.get("player_device", "auto"))
     )
 
     num_envs = int(cfg.env.num_envs)
@@ -248,6 +248,9 @@ def main(fabric, cfg: Dict[str, Any]):
     # rollout action keys live on the player's device so a host-pinned
     # player never blocks on a chip round trip per env step
     player_key = put_tree(jax.random.fold_in(key, 1), player.device)
+    if cfg.checkpoint.resume_from and "player_rng_key" in state:
+        # continue the pre-resume action-sampling stream
+        player_key = put_tree(jnp.asarray(state["player_rng_key"]), player.device)
 
     clip_coef = float(cfg.algo.clip_coef)
     ent_coef = float(cfg.algo.ent_coef)
@@ -255,7 +258,14 @@ def main(fabric, cfg: Dict[str, Any]):
     next_obs, _ = envs.reset(seed=cfg.seed)
     next_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=num_envs)
 
+    # steady-state throughput probe (bench.py): updates 2..last, skipping the
+    # compile-heavy first update — shared contract in utils.SteadyStateProbe
+    from sheeprl_tpu.utils.utils import SteadyStateProbe
+
+    probe = SteadyStateProbe()
     for update in range(start_update, num_updates + 1):
+        if update == start_update + 1:
+            probe.mark(policy_step)
         rollout = {k: [] for k in (*obs_keys, "dones", "values", "actions", "logprobs", "rewards")}
         with timer("Time/env_interaction_time"):
             for _ in range(rollout_steps):
@@ -398,10 +408,14 @@ def main(fabric, cfg: Dict[str, Any]):
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
                 "rng_key": jax.device_get(key),
+                "player_rng_key": jax.device_get(player_key),
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
             fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
 
+    # the params fetch is a real device sync (everything dispatched before
+    # it has executed once it materializes)
+    probe.finish(policy_step, sync=lambda: jax.device_get(jax.tree.leaves(params)[0]))
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         test(player, fabric, cfg, log_dir)

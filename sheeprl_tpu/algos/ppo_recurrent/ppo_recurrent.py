@@ -263,9 +263,7 @@ def main(fabric, cfg: Dict[str, Any]):
     from sheeprl_tpu.parallel.fabric import resolve_player_device
 
     player = RecurrentPPOPlayer(
-        agent, params, device=resolve_player_device(
-            cfg.algo.get("player_device", "auto"), has_cnn=bool(cfg.algo.cnn_keys.encoder)
-        )
+        agent, params, device=resolve_player_device(cfg.algo.get("player_device", "auto"))
     )
 
     rollout_steps = int(cfg.algo.rollout_steps)
@@ -315,6 +313,9 @@ def main(fabric, cfg: Dict[str, Any]):
     from sheeprl_tpu.parallel.fabric import put_tree as _put_tree
 
     player_key = _put_tree(jax.random.fold_in(key, 1), player.device)
+    if cfg.checkpoint.resume_from and "player_rng_key" in state:
+        # continue the pre-resume action-sampling stream
+        player_key = _put_tree(jnp.asarray(state["player_rng_key"]), player.device)
 
     clip_coef = float(cfg.algo.clip_coef)
     ent_coef = float(cfg.algo.ent_coef)
@@ -525,6 +526,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
                 "rng_key": jax.device_get(key),
+                "player_rng_key": jax.device_get(player_key),
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
             fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)

@@ -436,6 +436,6 @@ def build_agent(
         actor,
         agent.encoder_params,
         agent.actor_params,
-        device=resolve_player_device(cfg["algo"].get("player_device", "auto"), has_cnn=bool(cnn_keys)),
+        device=resolve_player_device(cfg["algo"].get("player_device", "auto")),
     )
     return agent, player

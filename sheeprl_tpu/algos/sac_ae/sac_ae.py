@@ -235,7 +235,12 @@ def make_train_fn(fabric, agent: SACAEAgent, actor_tx, qf_tx, alpha_tx, encoder_
         )
     else:
         train_fn = local_train
-    return jax.jit(train_fn, donate_argnums=tuple(range(13)))
+    # donate only optimizer/aux state: param buffers stay un-donated because
+    # concurrent readers (async param streaming to the host player, the ema /
+    # hard-copy target refresh) may still be in flight when the next train
+    # dispatch would otherwise alias over them (observed on the remote chip
+    # as spurious INVALID_ARGUMENT errors surfacing at unrelated fetches)
+    return jax.jit(train_fn, donate_argnums=(7, 8, 9, 10, 11, 12))
 
 
 @register_algorithm()

@@ -1,0 +1,542 @@
+"""Accelerator-resident sequential replay buffer.
+
+The reference keeps replay in host RAM (numpy / memmap,
+``sheeprl/data/buffers.py:363-743``) and re-stages every sampled batch to the
+accelerator: at replay ratio 0.5 each stored frame crosses the host→device
+link ~16 times over its lifetime (batch 16 × seq 64 resamples). On TPU the
+natural layout is the opposite — the ring lives in HBM, each env step uploads
+its ~KB-sized transition exactly once, and sequence sampling is an on-chip
+gather (HBM→HBM at memory bandwidth, no host link traffic at all). With a
+remote-attached chip this turns the dominant per-update transfer
+(megabytes of pixels) into a few kilobytes of gather indices.
+
+Semantics mirror ``EnvIndependentReplayBuffer(buffer_cls=SequentialReplayBuffer)``
+(per-env ring cursors, contiguous windows that never straddle an env's write
+cursor, multinomial env split per batch — ``data/buffers.py:308-527``), so the
+Dreamer-family loops can swap buffers without touching their math. Index
+drawing stays on the host (the host mirrors the cursors; drawing needs no
+device data), only the draw result crosses the link.
+
+Storage layout: one array per key, ``[n_envs, capacity + 1, *item]`` —
+env-major so a sampled window is a contiguous HBM run; the extra slot at
+``capacity`` is a scratch row that absorbs writes of envs excluded from a
+partial ``add`` (every write is a fixed-shape scatter, so one compiled
+program serves full and partial adds alike). Writes donate the buffer state
+to XLA, which aliases the update in place — adding a step never copies the
+ring.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _is_pixel(v: np.ndarray) -> bool:
+    return v.dtype == np.uint8
+
+
+class DeviceReplayBuffer:
+    """Sequential replay ring resident on an accelerator device.
+
+    Drop-in for the ``EnvIndependentReplayBuffer``/``SequentialReplayBuffer``
+    pair in single-process, single-device training loops: same ``add``
+    signature (``[1, n, ...]`` step dicts, optional env ``indices``), same
+    sampling distribution, but ``sample_batches`` yields device-resident
+    ``[T, B, ...]`` batches gathered on-chip.
+    """
+
+    def __init__(
+        self,
+        buffer_size: int,
+        n_envs: int = 1,
+        obs_keys: Sequence[str] = ("observations",),
+        device: Optional[jax.Device] = None,
+        seed: Optional[int] = None,
+    ) -> None:
+        if buffer_size <= 0:
+            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+        if n_envs <= 0:
+            raise ValueError(f"The number of environments must be greater than zero, got: {n_envs}")
+        self._buffer_size = int(buffer_size)
+        self._n_envs = int(n_envs)
+        self._obs_keys = tuple(obs_keys)
+        self._device = device
+        self._rng = np.random.default_rng(seed)
+        # host mirrors of the per-env ring cursors (the device never needs
+        # to report them back)
+        self._pos = np.zeros((n_envs,), np.int64)
+        self._full = np.zeros((n_envs,), bool)
+        self._bufs: Optional[Dict[str, jax.Array]] = None
+        self._pending_arrays: Optional[Dict[str, np.ndarray]] = None
+        self._small_keys: Tuple[str, ...] = ()
+        self._small_slices: Dict[str, Tuple[int, int, Tuple[int, ...]]] = {}
+        self._pixel_keys: Tuple[str, ...] = ()
+        self._write = None
+        self._gather = None
+        self._amend = None
+
+    # ------------------------------------------------------------- properties
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def full(self) -> Sequence[bool]:
+        return tuple(bool(f) for f in self._full)
+
+    @property
+    def empty(self) -> Sequence[bool]:
+        return tuple(not f and p == 0 for f, p in zip(self._full, self._pos))
+
+    @property
+    def is_memmap(self) -> Sequence[bool]:
+        return tuple(False for _ in range(self._n_envs))
+
+    @property
+    def device(self) -> Optional[jax.Device]:
+        return self._device
+
+    def __len__(self) -> int:
+        return self._buffer_size
+
+    # ------------------------------------------------------------- allocation
+    def _allocate(self, data: Dict[str, np.ndarray]) -> None:
+        cap1 = self._buffer_size + 1
+        smalls: List[str] = []
+        pixels: List[str] = []
+        bufs: Dict[str, jax.Array] = {}
+        for k in sorted(data):
+            v = np.asarray(data[k])
+            item = tuple(v.shape[2:])
+            if _is_pixel(v):
+                pixels.append(k)
+                dtype = jnp.uint8
+            else:
+                smalls.append(k)
+                dtype = jnp.float32
+            shape = (self._n_envs, cap1, *item)
+            bufs[k] = jax.device_put(jnp.zeros(shape, dtype), self._device)
+        offset = 0
+        for k in smalls:
+            item = tuple(np.asarray(data[k]).shape[2:])
+            width = int(np.prod(item)) if item else 1
+            self._small_slices[k] = (offset, offset + width, item)
+            offset += width
+        self._small_keys = tuple(smalls)
+        self._pixel_keys = tuple(pixels)
+        self._bufs = bufs
+        self._build_kernels()
+
+    def _build_kernels(self) -> None:
+        n_envs = self._n_envs
+        small_slices = dict(self._small_slices)
+        pixel_keys = self._pixel_keys
+        small_keys = self._small_keys
+
+        def write(bufs, pixels, smalls, pos):
+            env_ids = jnp.arange(n_envs)
+            out = dict(bufs)
+            for k in pixel_keys:
+                out[k] = out[k].at[env_ids, pos].set(pixels[k])
+            for k in small_keys:
+                o0, o1, item = small_slices[k]
+                seg = smalls[:, o0:o1].reshape((n_envs, *item) if item else (n_envs,))
+                out[k] = out[k].at[env_ids, pos].set(seg)
+            return out
+
+        def gather(bufs, env_idx, time_idx):
+            # env_idx [B], time_idx [B, T] -> values [T, B, ...] (time-major,
+            # the layout the fused train steps consume)
+            out = {}
+            for k, b in bufs.items():
+                g = b[env_idx[:, None], time_idx]  # [B, T, ...]
+                out[k] = jnp.swapaxes(g, 0, 1)
+            return out
+
+        def amend(bufs, env_i, slot, terminated, truncated, is_first):
+            out = dict(bufs)
+            for k, v in (("terminated", terminated), ("truncated", truncated), ("is_first", is_first)):
+                if k in out:
+                    out[k] = out[k].at[env_i, slot].set(
+                        jnp.full(out[k].shape[2:], v, out[k].dtype)
+                    )
+            return out
+
+        import os
+
+        if os.environ.get("SHEEPRL_TPU_RING_NO_DONATE"):
+            # debug switch: in-place aliasing off — every write copies the ring
+            self._write = jax.jit(write)
+            self._amend = jax.jit(amend)
+        else:
+            self._write = jax.jit(write, donate_argnums=0)
+            self._amend = jax.jit(amend, donate_argnums=0)
+        self._gather = jax.jit(gather)
+
+    # ------------------------------------------------------------------ write
+    def add(
+        self,
+        data: Dict[str, np.ndarray],
+        indices: Optional[Sequence[int]] = None,
+        validate_args: bool = False,
+    ) -> None:
+        """Append one time step for the given envs (all envs when ``indices``
+        is None). ``data`` values are ``[1, len(indices), ...]`` host arrays —
+        the same step-dict contract as ``EnvIndependentReplayBuffer.add``."""
+        if not isinstance(data, dict):
+            raise ValueError(f"'data' must be a dictionary, got {type(data)}")
+        first = np.asarray(next(iter(data.values())))
+        if first.shape[0] != 1:
+            raise ValueError(
+                f"DeviceReplayBuffer.add stores one step per call; got a [{first.shape[0]}, ...] block"
+            )
+        if indices is None:
+            indices = range(self._n_envs)
+        indices = list(indices)
+        if validate_args and len(indices) != first.shape[1]:
+            raise ValueError(
+                f"The length of 'indices' ({len(indices)}) must be equal to the second dimension of the "
+                f"arrays in 'data' ({first.shape[1]})"
+            )
+        if self._bufs is None:
+            self._allocate(data)
+        if set(data) != set(self._bufs):
+            raise ValueError(
+                f"add() keys {sorted(data)} do not match the allocated keys {sorted(self._bufs)}"
+            )
+
+        # scatter targets: the env's cursor, or the scratch slot for envs not
+        # in this (partial) add. Staging arrays are allocated once and
+        # overwritten in place — rows of envs excluded from a partial add
+        # keep stale bytes, which land harmlessly in the scratch slot
+        if not hasattr(self, "_stage_pos"):
+            width = sum(s[1] - s[0] for s in self._small_slices.values())
+            self._stage_pos = np.empty((self._n_envs,), np.int32)
+            self._stage_smalls = np.zeros((self._n_envs, width), np.float32)
+            self._stage_pixels = {
+                k: np.zeros((self._n_envs, *self._bufs[k].shape[2:]), np.uint8)
+                for k in self._pixel_keys
+            }
+        pos, pixels, smalls = self._stage_pos, self._stage_pixels, self._stage_smalls
+        pos.fill(self._buffer_size)
+        for col, env in enumerate(indices):
+            pos[env] = self._pos[env]
+            for k in self._pixel_keys:
+                pixels[k][env] = data[k][0, col]
+            for k in self._small_keys:
+                o0, o1, _ = self._small_slices[k]
+                smalls[env, o0:o1] = np.asarray(data[k][0, col], np.float32).reshape(-1)
+
+        dev_args = jax.device_put((pixels, smalls, jnp.asarray(pos)), self._device)
+        self._bufs = self._write(self._bufs, *dev_args)
+        for env in indices:
+            self._pos[env] += 1
+            if self._pos[env] >= self._buffer_size:
+                self._pos[env] = 0
+                self._full[env] = True
+
+    def amend_last(self, env_idx: int, terminated: float, truncated: float, is_first: float) -> None:
+        """Rewrite the done/first flags of the most recent step of one env —
+        the failure-recovery patch path (``RestartOnException`` buffer fixup,
+        reference ``dreamer_v3.py:591-604``)."""
+        if self._bufs is None:
+            return
+        slot = int((self._pos[env_idx] - 1) % self._buffer_size)
+        self._bufs = self._amend(
+            self._bufs,
+            jnp.int32(env_idx),
+            jnp.int32(slot),
+            jnp.float32(terminated),
+            jnp.float32(truncated),
+            jnp.float32(is_first),
+        )
+
+    # ----------------------------------------------------------------- sample
+    def _valid_starts(self, env: int, span: int) -> np.ndarray:
+        """Window starts for one env that do not straddle its write cursor —
+        the same validity rule as ``SequentialReplayBuffer.sample``
+        (``data/buffers.py:341-354``)."""
+        pos = int(self._pos[env])
+        if self._full[env]:
+            first_end = pos - span + 1
+            second_end = self._buffer_size if first_end >= 0 else self._buffer_size + first_end
+            return np.concatenate(
+                [np.arange(0, max(first_end, 0)), np.arange(pos, second_end)]
+            ).astype(np.intp)
+        if pos - span + 1 < 1:
+            return np.empty((0,), np.intp)
+        return np.arange(0, pos - span + 1, dtype=np.intp)
+
+    def draw_indices(
+        self, batch_size: int, sequence_length: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Draw ``(env_idx [B], start [B])`` with the stock sampling
+        distribution: multinomial env split, then uniform over each env's
+        valid windows."""
+        if batch_size <= 0:
+            raise ValueError(f"'batch_size' ({batch_size}) must be greater than 0")
+        if self._bufs is None:
+            raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
+        env_idx = self._rng.integers(0, self._n_envs, (batch_size,), dtype=np.intp)
+        starts = np.empty((batch_size,), np.intp)
+        for env in np.unique(env_idx):
+            valid = self._valid_starts(int(env), sequence_length)
+            if len(valid) == 0:
+                raise ValueError(
+                    f"Cannot sample a sequence of length {sequence_length} from env {env}. "
+                    f"Data added so far: {self._pos[env]}"
+                )
+            rows = np.nonzero(env_idx == env)[0]
+            starts[rows] = valid[self._rng.integers(0, len(valid), size=(len(rows),), dtype=np.intp)]
+        return env_idx, starts
+
+    def sample_batches(
+        self, batch_size: int, sequence_length: int, n_samples: int
+    ) -> Iterator[Dict[str, jax.Array]]:
+        """Yield ``n_samples`` device-resident ``[T, B, ...]`` batches.
+
+        Per batch, only ``B * (T + 1)`` int32 indices cross the host→device
+        link; the pixel bytes move HBM→HBM inside one jitted gather."""
+        if n_samples <= 0:
+            raise ValueError(f"'n_samples' ({n_samples}) must be greater than 0")
+        offsets = np.arange(sequence_length, dtype=np.int64)
+        for _ in range(n_samples):
+            env_idx, starts = self.draw_indices(batch_size, sequence_length)
+            time_idx = (starts[:, None] + offsets[None, :]) % self._buffer_size
+            ei, ti = jax.device_put(
+                (env_idx.astype(np.int32), time_idx.astype(np.int32)), self._device
+            )
+            yield self._gather(self._bufs, ei, ti)
+
+    def flag_last_truncated(self) -> Optional[np.ndarray]:
+        """Set ``truncated=1`` on every env's most recent step (checkpoint
+        self-consistency — reference ``callback.py:87-142``) and return the
+        clobbered values for :meth:`restore_last_truncated`."""
+        if self._bufs is None or "truncated" not in self._bufs:
+            return None
+        slots = ((self._pos - 1) % self._buffer_size).astype(np.int32)
+        env_ids = np.arange(self._n_envs, dtype=np.int32)
+        saved = np.asarray(jax.device_get(self._bufs["truncated"][env_ids, slots]))
+        self._bufs = dict(self._bufs)
+        self._bufs["truncated"] = (
+            self._bufs["truncated"].at[env_ids, slots].set(jnp.ones_like(saved))
+        )
+        return saved
+
+    def restore_last_truncated(self, saved: Optional[np.ndarray]) -> None:
+        if saved is None or self._bufs is None:
+            return
+        slots = ((self._pos - 1) % self._buffer_size).astype(np.int32)
+        env_ids = np.arange(self._n_envs, dtype=np.int32)
+        self._bufs = dict(self._bufs)
+        self._bufs["truncated"] = self._bufs["truncated"].at[env_ids, slots].set(jnp.asarray(saved))
+
+    # ------------------------------------------------------------- checkpoint
+    def host_arrays(self) -> Dict[str, np.ndarray]:
+        """Fetch the ring (without the scratch slot) as ``[E, cap, ...]``
+        numpy arrays — one bulk transfer per key."""
+        if self._bufs is None:
+            return dict(self._pending_arrays or {})
+        return {k: np.asarray(jax.device_get(v))[:, : self._buffer_size] for k, v in self._bufs.items()}
+
+    def __getstate__(self) -> Dict[str, Any]:
+        state = {
+            "buffer_size": self._buffer_size,
+            "n_envs": self._n_envs,
+            "obs_keys": self._obs_keys,
+            "rng": self._rng,
+            "pos": self._pos,
+            "full": self._full,
+            "small_slices": self._small_slices,
+            "small_keys": self._small_keys,
+            "pixel_keys": self._pixel_keys,
+            "arrays": self.host_arrays(),
+        }
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self._buffer_size = state["buffer_size"]
+        self._n_envs = state["n_envs"]
+        self._obs_keys = tuple(state["obs_keys"])
+        self._rng = state["rng"]
+        self._pos = state["pos"]
+        self._full = state["full"]
+        self._small_slices = state["small_slices"]
+        self._small_keys = state["small_keys"]
+        self._pixel_keys = state["pixel_keys"]
+        self._device = None  # re-pinned by the restoring process
+        self._bufs = None
+        self._write = self._gather = self._amend = None
+        self._pending_arrays = state["arrays"]
+
+    def restore_to_device(self, device: Optional[jax.Device] = None) -> "DeviceReplayBuffer":
+        """Upload a restored (unpickled) ring back to ``device``."""
+        self._device = device
+        arrays = getattr(self, "_pending_arrays", None)
+        if arrays:
+            cap1 = self._buffer_size + 1
+            bufs = {}
+            for k, v in arrays.items():
+                padded = np.zeros((self._n_envs, cap1, *v.shape[2:]), v.dtype)
+                padded[:, : self._buffer_size] = v
+                bufs[k] = jax.device_put(padded, device)
+            self._bufs = bufs
+            self._build_kernels()
+            self._pending_arrays = None
+        return self
+
+    @classmethod
+    def from_host_buffer(
+        cls, host_rb: Any, device: Optional[jax.Device] = None, seed: Optional[int] = None
+    ) -> "DeviceReplayBuffer":
+        """Bulk-load an ``EnvIndependentReplayBuffer`` (e.g. from a resumed
+        checkpoint) into HBM."""
+        subs = host_rb.buffer
+        n_envs = len(subs)
+        out = cls(host_rb.buffer_size, n_envs=n_envs, obs_keys=subs[0]._obs_keys, device=device, seed=seed)
+        keys = list(subs[0].buffer.keys())
+        arrays = {
+            k: np.stack([np.asarray(sub.buffer[k])[:, 0] for sub in subs]) for k in keys
+        }
+        out._pos = np.array([sub._pos for sub in subs], np.int64)
+        out._full = np.array([sub.full for sub in subs], bool)
+        out._pending_arrays = {
+            k: (v if v.dtype == np.uint8 else v.astype(np.float32)) for k, v in arrays.items()
+        }
+        # _pending_arrays carries [E, cap, ...]; reuse the restore path
+        out._small_slices = {}
+        smalls = [k for k in sorted(keys) if arrays[k].dtype != np.uint8]
+        offset = 0
+        for k in smalls:
+            item = tuple(arrays[k].shape[2:])
+            width = int(np.prod(item)) if item else 1
+            out._small_slices[k] = (offset, offset + width, item)
+            offset += width
+        out._small_keys = tuple(smalls)
+        out._pixel_keys = tuple(k for k in sorted(keys) if arrays[k].dtype == np.uint8)
+        out.restore_to_device(device)
+        return out
+
+    def ring_bytes(self) -> int:
+        """Current HBM footprint of the allocated ring."""
+        if self._bufs is None:
+            return 0
+        return sum(int(np.prod(v.shape)) * v.dtype.itemsize for v in self._bufs.values())
+
+    def to_host_buffer(self, memmap: bool = False, memmap_dir: Any = None) -> Any:
+        """Materialize as a stock ``EnvIndependentReplayBuffer`` (host RAM),
+        e.g. to hand a checkpoint to a host-buffer run."""
+        from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+
+        host = EnvIndependentReplayBuffer(
+            self._buffer_size,
+            n_envs=self._n_envs,
+            obs_keys=self._obs_keys,
+            memmap=memmap,
+            memmap_dir=memmap_dir,
+            buffer_cls=SequentialReplayBuffer,
+        )
+        arrays = self.host_arrays()
+        for env, sub in enumerate(host.buffer):
+            # prime allocation with a single step, then overwrite wholesale
+            step = {k: v[env : env + 1, 0:1].swapaxes(0, 1) for k, v in arrays.items()}
+            sub.add(step)
+            for k, v in arrays.items():
+                sub[k] = v[env][:, None]
+            sub._pos = int(self._pos[env])
+            sub._full = bool(self._full[env])
+        return host
+
+
+def estimate_ring_bytes(
+    obs_space: Any, actions_dim: Sequence[int], buffer_size: int, n_envs: int
+) -> int:
+    """Upper-bound estimate of the HBM ring footprint for a Dreamer-style
+    step dict (obs keys + actions + 4 scalar flags), used by the ``auto``
+    device-buffer decision before any data exists."""
+    per_step = 0
+    for k in obs_space.spaces:
+        space = obs_space[k]
+        itemsize = 1 if np.issubdtype(space.dtype, np.uint8) else 4
+        per_step += int(np.prod(space.shape)) * itemsize
+    per_step += (int(np.sum(actions_dim)) + 4) * 4
+    return per_step * int(buffer_size) * int(n_envs)
+
+
+def resolve_device_buffer(
+    cfg: Any, fabric: Any, obs_space: Any, actions_dim: Sequence[int], buffer_size: int, n_envs: int
+) -> bool:
+    """Decide whether this run keeps replay in HBM.
+
+    ``buffer.device`` true/false forces the choice (true still requires a
+    single-process single-device run — the ring is not sharded); ``auto``
+    additionally requires a non-CPU backend and an estimated footprint under
+    ``buffer.device_max_bytes``.
+    """
+    spec = cfg.buffer.get("device", "auto")
+    supported = fabric.world_size == 1 and fabric.num_processes == 1
+    if spec in (True, "true", "True"):
+        if not supported:
+            raise ValueError(
+                "buffer.device=true needs a single-process, single-device run; "
+                f"got world_size={fabric.world_size}, num_processes={fabric.num_processes}"
+            )
+        return True
+    if spec in (False, "false", "False", None):
+        return False
+    if spec != "auto":
+        raise ValueError(f"unknown buffer.device spec {spec!r}; use auto/true/false")
+    if not supported or jax.default_backend() == "cpu":
+        return False
+    est = estimate_ring_bytes(obs_space, actions_dim, buffer_size, n_envs)
+    return est <= int(cfg.buffer.get("device_max_bytes", 8_000_000_000))
+
+
+def make_sequential_replay(
+    cfg: Any,
+    fabric: Any,
+    obs_space: Any,
+    actions_dim: Sequence[int],
+    buffer_size: int,
+    num_envs: int,
+    obs_keys: Sequence[str],
+    memmap_dir: Any,
+    seed: Optional[int],
+) -> Any:
+    """Construct the sequential replay for a Dreamer-family loop: the HBM
+    ring when :func:`resolve_device_buffer` allows it, else the stock
+    host ``EnvIndependentReplayBuffer``."""
+    from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+
+    if resolve_device_buffer(cfg, fabric, obs_space, actions_dim, buffer_size, num_envs):
+        return DeviceReplayBuffer(buffer_size, n_envs=num_envs, obs_keys=obs_keys, seed=seed)
+    return EnvIndependentReplayBuffer(
+        buffer_size,
+        n_envs=num_envs,
+        obs_keys=obs_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=memmap_dir,
+        buffer_cls=SequentialReplayBuffer,
+        seed=seed,
+    )
+
+
+def adapt_restored_buffer(rb: Any, want_device: bool, seed: Optional[int] = None) -> Any:
+    """Convert a checkpoint-restored replay buffer to this run's mode —
+    checkpoints from either buffer mode resume into either."""
+    from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer
+
+    if isinstance(rb, DeviceReplayBuffer):
+        return rb.restore_to_device() if want_device else rb.to_host_buffer()
+    if want_device and isinstance(rb, EnvIndependentReplayBuffer):
+        return DeviceReplayBuffer.from_host_buffer(rb, seed=seed)
+    return rb

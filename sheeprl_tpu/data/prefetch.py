@@ -41,7 +41,17 @@ def sampled_batches(
     (``rb.sample_tensors(..., device=...)``, dreamer_v3.py:659-666).
     Multi-host runs keep host staging so each process can contribute its
     block to the mesh-global array. ``prefetch`` is the pipeline depth
-    (0 disables; 2 = double buffering)."""
+    (0 disables; 2 = double buffering).
+
+    An HBM-resident ring (:class:`~sheeprl_tpu.data.device_buffer.DeviceReplayBuffer`)
+    needs neither staging nor prefetch — sampling is an on-chip gather — so it
+    short-circuits here and every Dreamer-family loop picks it up for free."""
+    from sheeprl_tpu.data.device_buffer import DeviceReplayBuffer
+
+    if isinstance(rb, DeviceReplayBuffer):
+        yield from rb.sample_batches(batch_size, sequence_length, n_samples)
+        return
+
     cnn_keys = set(cnn_keys)
 
     def stage(sample: Dict[str, np.ndarray], i: int) -> Dict[str, np.ndarray]:

@@ -227,9 +227,17 @@ def fused_recurrent_step(
 def resolve_backend(mode: Any, in_dim: int, dense_units: int, hidden: int) -> Tuple[bool, bool]:
     """Map a config flag to ``(use_pallas, interpret)``.
 
-    ``mode``: ``"auto"`` (pallas iff running on TPU and sizes fit VMEM),
+    ``mode``: ``"auto"`` (currently the flax cell — see below),
     ``True``/``"pallas"`` (force; interpreter off-TPU — for tests),
     ``False``/``"flax"`` (never).
+
+    ``auto`` resolves to the flax cell: the round-3 on-chip A/B
+    (``benchmarks/pallas_gru_ab.py``, TPU v5e) measured the kernel at parity
+    with XLA's own fusion at the XS scale (1.01–1.03x) and SLOWER at S
+    (0.62x forward) — XLA already fuses the Dense→LN→SiLU→GRU body well, and
+    the hand-written kernel's VMEM tiling loses to the compiler's scheduling
+    as the weights grow. The kernel stays available behind ``"pallas"`` for
+    future re-evaluation on other TPU generations.
     """
     if mode in (False, None, "flax", "off"):
         return False, False
@@ -247,5 +255,5 @@ def resolve_backend(mode: Any, in_dim: int, dense_units: int, hidden: int) -> Tu
             )
         return fits, not on_tpu
     if str(mode).lower() == "auto":
-        return on_tpu and fits, False
+        return False, False  # measured: XLA fusion ties/wins (docstring)
     raise ValueError(f"unknown fused-recurrent mode {mode!r}")

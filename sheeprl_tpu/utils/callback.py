@@ -9,9 +9,11 @@ self-consistent without the live env state: the last stored step of every
 env is flagged TRUNCATED for the save and restored right after (reference
 ``_ckpt_rb`` / ``_experiment_consistent_rb``, callback.py:87-142); open
 episodes of an ``EpisodeBuffer`` are dropped the same way. On multi-host
-runs every process's buffer is gathered over the host-object plane and the
-checkpoint stores one buffer per process (reference gloo ``gather_object``,
-callback.py:40-51; restore with ``checkpoint.select_buffer``).
+runs the pickle backend gathers every process's buffer over the host-object
+plane into a one-per-process list (reference gloo ``gather_object``,
+callback.py:40-51); the orbax backend skips the gather — each process writes
+its own buffer sidecar next to the sharded array store. Both restore through
+``checkpoint.select_buffer``.
 """
 
 from __future__ import annotations
@@ -41,26 +43,32 @@ class CheckpointCallback:
         rb_state = None
         if replay_buffer is not None:
             rb_state = self._ckpt_rb(replay_buffer)
-            rb_to_save: Any = replay_buffer
-            if gather_buffers and fabric.num_processes > 1:
-                from sheeprl_tpu.parallel.collectives import gather_object
-
-                gathered = gather_object(replay_buffer, dst=0)
-                rb_to_save = gathered if fabric.is_global_zero else replay_buffer
-            state = {**state, "rb": rb_to_save}
         from sheeprl_tpu.utils.checkpoint import save_checkpoint
 
-        # the orbax store coordinates its own multi-process write barriers, so
-        # EVERY process must enter save_checkpoint with the SAME directory
-        # (per-rank paths would break the collective commit); the pickle
-        # backend writes once
-        if backend == "orbax" and fabric.num_processes > 1:
-            import re
+        if backend == "orbax":
+            # the orbax store coordinates its own multi-process write
+            # barriers, so EVERY process must enter save_checkpoint with the
+            # SAME directory (per-rank paths would break the collective
+            # commit). Buffers skip the object-plane gather entirely: each
+            # process writes its own objects_rank_{i}.pkl sidecar
+            path = ckpt_path
+            if fabric.num_processes > 1:
+                import re
 
-            shared = re.sub(r"_\d+(\.ckpt)$", r"_0\1", ckpt_path)
-            save_checkpoint(shared, state, backend=backend)
-        elif fabric.is_global_zero:
-            save_checkpoint(ckpt_path, state, backend=backend)
+                path = re.sub(r"_\d+(\.ckpt)$", r"_0\1", ckpt_path)
+            per_proc = {"rb": replay_buffer} if replay_buffer is not None else None
+            save_checkpoint(path, state, backend=backend, per_process_state=per_proc)
+        else:
+            if replay_buffer is not None:
+                rb_to_save: Any = replay_buffer
+                if gather_buffers and fabric.num_processes > 1:
+                    from sheeprl_tpu.parallel.collectives import gather_object
+
+                    gathered = gather_object(replay_buffer, dst=0)
+                    rb_to_save = gathered if fabric.is_global_zero else replay_buffer
+                state = {**state, "rb": rb_to_save}
+            if fabric.is_global_zero:
+                save_checkpoint(ckpt_path, state, backend=backend)
         if replay_buffer is not None:
             self._experiment_consistent_rb(replay_buffer, rb_state)
         if fabric.is_global_zero and self.keep_last:
@@ -94,6 +102,8 @@ class CheckpointCallback:
         """Make the stored buffer self-consistent: the env state is not
         checkpointed, so the last stored step must end its episode. Returns
         the clobbered values for the undo."""
+        if hasattr(rb, "flag_last_truncated"):  # DeviceReplayBuffer (HBM ring)
+            return rb.flag_last_truncated()
         if isinstance(rb, EnvIndependentReplayBuffer):
             saved: List[Any] = []
             for b in rb.buffer:
@@ -113,7 +123,9 @@ class CheckpointCallback:
     @staticmethod
     def _experiment_consistent_rb(rb: Any, saved: Any) -> None:
         """Undo :meth:`_ckpt_rb` so the live run continues unchanged."""
-        if isinstance(rb, EnvIndependentReplayBuffer):
+        if hasattr(rb, "restore_last_truncated"):  # DeviceReplayBuffer
+            rb.restore_last_truncated(saved)
+        elif isinstance(rb, EnvIndependentReplayBuffer):
             for b, s in zip(rb.buffer, saved):
                 b["truncated"][(b._pos - 1) % b.buffer_size, :] = s
         elif isinstance(rb, ReplayBuffer):

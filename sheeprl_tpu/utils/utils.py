@@ -192,3 +192,47 @@ def print_config(
         rich.print(tree)
     except Exception:
         print(yaml.safe_dump(cfg.to_dict() if isinstance(cfg, dotdict) else dict(cfg), sort_keys=False))
+
+
+class SteadyStateProbe:
+    """The ``SHEEPRL_TPU_BENCH_JSON`` steady-state throughput contract, in
+    one place (consumed by ``bench.py``; producers are the training loops).
+
+    A loop constructs one probe, calls :meth:`mark` once it considers itself
+    warm (compiles done — each loop picks its own rule), and :meth:`finish`
+    after its final update with a zero-arg ``sync`` callable that genuinely
+    waits for the device (a materializing fetch — ``block_until_ready`` is
+    advisory on remote-attached chips)."""
+
+    def __init__(self) -> None:
+        import os
+
+        self.path = os.environ.get("SHEEPRL_TPU_BENCH_JSON")
+        self._t0: float | None = None
+        self._step0 = 0
+
+    @property
+    def active(self) -> bool:
+        return self.path is not None
+
+    def mark(self, step: int) -> None:
+        if self.path is None or self._t0 is not None:
+            return
+        import time
+
+        self._t0, self._step0 = time.perf_counter(), step
+
+    def finish(self, step: int, sync=None) -> None:
+        if self.path is None or self._t0 is None:
+            return
+        import json
+        import time
+
+        import jax
+
+        if sync is not None:
+            sync()
+        if jax.process_index() != 0:  # one writer on multi-process runs
+            return
+        with open(self.path, "w") as f:
+            json.dump({"steps": step - self._step0, "seconds": time.perf_counter() - self._t0}, f)

@@ -1,0 +1,128 @@
+"""HBM-resident replay ring (data/device_buffer.py): semantic parity with
+the EnvIndependent/Sequential host pair, on-device add/gather, checkpoint
+round trips, and mode conversion."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.device_buffer import (
+    DeviceReplayBuffer,
+    adapt_restored_buffer,
+    estimate_ring_bytes,
+)
+
+
+def _step(rb, t, envs=None, n_envs=3):
+    n = n_envs if envs is None else len(envs)
+    rb.add(
+        {
+            "rgb": np.full((1, n, 8, 8, 3), t % 256, np.uint8),
+            "actions": np.full((1, n, 2), t, np.float32),
+            "rewards": np.full((1, n, 1), t, np.float32),
+            "terminated": np.zeros((1, n, 1), np.float32),
+            "truncated": np.zeros((1, n, 1), np.float32),
+            "is_first": np.zeros((1, n, 1), np.float32),
+        },
+        envs,
+    )
+
+
+def _fresh(cap=16, n_envs=3, seed=0):
+    return DeviceReplayBuffer(cap, n_envs=n_envs, obs_keys=("rgb",), seed=seed)
+
+
+def test_add_and_sample_layout_and_dtypes():
+    rb = _fresh()
+    for t in range(10):
+        _step(rb, t)
+    (batch,) = rb.sample_batches(batch_size=5, sequence_length=4, n_samples=1)
+    assert batch["rgb"].shape == (4, 5, 8, 8, 3) and str(batch["rgb"].dtype) == "uint8"
+    assert batch["actions"].shape == (4, 5, 2) and str(batch["actions"].dtype) == "float32"
+
+
+def test_sampled_windows_are_contiguous_and_never_straddle_the_cursor():
+    rb = _fresh()
+    for t in range(10):
+        _step(rb, t)
+    # wrap the ring: cursor sits mid-ring with old data behind it
+    for t in range(20, 40):
+        _step(rb, t)
+    assert all(rb.full)
+    for batch in rb.sample_batches(batch_size=8, sequence_length=6, n_samples=4):
+        rewards = np.asarray(batch["rewards"])[..., 0]  # [T, B] step counters
+        assert np.all(np.diff(rewards, axis=0) == 1), rewards.T
+    # amend flags of the newest step (failure-recovery patch path)
+    rb.amend_last(1, terminated=0.0, truncated=1.0, is_first=0.0)
+    arrs = rb.host_arrays()
+    slot = (rb._pos[1] - 1) % rb.buffer_size
+    assert arrs["truncated"][1, slot] == 1.0 and arrs["terminated"][1, slot] == 0.0
+
+
+def test_partial_add_advances_only_those_envs():
+    rb = _fresh()
+    for t in range(5):
+        _step(rb, t)
+    _step(rb, 99, envs=[1])
+    assert rb._pos.tolist() == [5, 6, 5]
+    arrs = rb.host_arrays()
+    assert arrs["rewards"][1, 5, 0] == 99.0
+    # the other envs' slot 5 is untouched (zeros)
+    assert arrs["rewards"][0, 5, 0] == 0.0
+
+
+def test_too_short_history_raises_like_host_buffer():
+    rb = _fresh()
+    for t in range(3):
+        _step(rb, t)
+    with pytest.raises(ValueError, match="Cannot sample a sequence"):
+        list(rb.sample_batches(batch_size=2, sequence_length=8, n_samples=1))
+
+
+def test_checkpoint_flag_fixup_roundtrip():
+    rb = _fresh()
+    for t in range(6):
+        _step(rb, t)
+    saved = rb.flag_last_truncated()
+    arrs = rb.host_arrays()
+    slots = (rb._pos - 1) % rb.buffer_size
+    assert all(arrs["truncated"][e, slots[e]] == 1.0 for e in range(3))
+    rb.restore_last_truncated(saved)
+    arrs = rb.host_arrays()
+    assert all(arrs["truncated"][e, slots[e]] == 0.0 for e in range(3))
+
+
+def test_pickle_and_mode_conversion_roundtrips():
+    import pickle
+
+    rb = _fresh()
+    for t in range(12):
+        _step(rb, t)
+    clone = pickle.loads(pickle.dumps(rb)).restore_to_device()
+    assert np.array_equal(clone.host_arrays()["rewards"], rb.host_arrays()["rewards"])
+
+    host = rb.to_host_buffer()
+    assert [b._pos for b in host.buffer] == rb._pos.tolist()
+    back = DeviceReplayBuffer.from_host_buffer(host)
+    assert np.array_equal(back.host_arrays()["rgb"], rb.host_arrays()["rgb"])
+
+    # adapt_restored_buffer covers all four (restored, wanted) combinations
+    assert adapt_restored_buffer(host, want_device=False) is host
+    assert isinstance(adapt_restored_buffer(host, want_device=True), DeviceReplayBuffer)
+    unrestored = pickle.loads(pickle.dumps(rb))
+    assert isinstance(adapt_restored_buffer(unrestored, want_device=True), DeviceReplayBuffer)
+    host2 = adapt_restored_buffer(pickle.loads(pickle.dumps(rb)), want_device=False)
+    assert np.array_equal(host2.buffer[0]["rewards"][:, 0], rb.host_arrays()["rewards"][0])
+
+
+def test_estimate_ring_bytes():
+    import gymnasium as gym
+
+    space = gym.spaces.Dict(
+        {
+            "rgb": gym.spaces.Box(0, 255, (64, 64, 3), np.uint8),
+            "state": gym.spaces.Box(-1, 1, (7,), np.float32),
+        }
+    )
+    est = estimate_ring_bytes(space, actions_dim=(4,), buffer_size=100, n_envs=2)
+    per_step = 64 * 64 * 3 + 7 * 4 + (4 + 4) * 4
+    assert est == per_step * 100 * 2

@@ -48,8 +48,70 @@ def test_resolve_cpu_on_cpu_backend_is_none():
 
 def test_resolve_auto_on_cpu_backend_is_none():
     assert resolve_player_device("auto") is None
-    # conv policies always stay on the training backend under auto
-    assert resolve_player_device("auto", has_cnn=True) is None
+    # conv policies too: auto depends only on the measured link latency
+    # (a host pixel forward is ~ms, far under a remote chip's round trip)
+    assert resolve_player_device("auto") is None
+
+
+def test_param_streamer_single_byte_dtypes_roundtrip():
+    """int8/bool/uint8 leaves survive packing next to wider leaves (the
+    round-2 advisor finding: concatenating raw int8 with uint8 segments
+    type-promoted and broke the byte layout)."""
+    dev = jax.devices("cpu")[0]
+    tree = {
+        "i8": jnp.array([-3, 0, 127, -128], jnp.int8),
+        "u8": jnp.array([0, 255, 7], jnp.uint8),
+        "b": jnp.array([True, False, True]),
+        "f": jnp.ones((4,), jnp.float32) * 2.5,
+    }
+    s = _ParamStreamer(tree, dev)
+    out = s(tree)
+    for k in tree:
+        assert out[k].dtype == tree[k].dtype, k
+        assert np.array_equal(np.asarray(out[k]), np.asarray(tree[k])), k
+
+
+def test_param_streamer_begin_finish_deferred():
+    dev = jax.devices("cpu")[0]
+    tree = {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4), "b": jnp.ones(3, jnp.bfloat16)}
+    s = _ParamStreamer(tree, dev)
+    handle = s.begin(tree)
+    out = s.finish(handle)
+    for k in tree:
+        assert np.array_equal(np.asarray(out[k], np.float32), np.asarray(tree[k], np.float32))
+
+
+def test_stream_pipe_applies_newest_after_age_gate(monkeypatch):
+    from sheeprl_tpu.parallel import fabric as fabric_mod
+    from sheeprl_tpu.parallel.fabric import _StreamPipe
+
+    dev = jax.devices("cpu")[0]
+    tree1 = {"w": jnp.zeros((4,), jnp.float32)}
+    tree2 = {"w": jnp.ones((4,), jnp.float32)}
+    s = _ParamStreamer(tree1, dev)
+    pipe = _StreamPipe(s)
+    monkeypatch.setitem(fabric_mod._rtt_cache, "rtt", 0.0)  # age gate -> 20 ms floor
+
+    import time
+
+    pipe.offer(tree1)
+    time.sleep(0.05)
+    assert pipe.poll() is not None  # tree1 lands once past the age gate
+    pipe.offer(tree2)
+    time.sleep(0.05)
+    out = pipe.poll()
+    assert out is not None and np.asarray(out["w"]).sum() == 4.0
+
+
+def test_dispatch_fence_bounds_inflight_markers():
+    from sheeprl_tpu.parallel.fabric import DispatchFence
+
+    fence = DispatchFence(depth=2)
+    for i in range(6):
+        fence.push(jnp.full((3, 3), i, jnp.float32))
+        assert len(fence._pending) <= 2
+    fence.drain()
+    assert len(fence._pending) == 0
 
 
 def test_resolve_unknown_spec_raises():
