@@ -1,7 +1,11 @@
-"""Crafter adapter (reference: sheeprl/envs/crafter.py:17-66).
+"""Crafter adapter (behavioral parity: sheeprl/envs/crafter.py:17-66).
 
-Wraps ``crafter.Env`` (old gym API) into a gymnasium env with a Dict
-observation space holding the pixel stream under ``rgb``."""
+Crafter (danijar/crafter) is an old-gym survival game; this adapter rides the
+shared :class:`~sheeprl_tpu.envs.legacy.LegacyGymAdapter` bridge and only
+supplies the two Crafter-specific facts: which of the two registered variants
+carries rewards, and how Crafter signals a time-limit cutoff (through the
+``discount`` it reports alongside ``done``).
+"""
 
 from __future__ import annotations
 
@@ -15,57 +19,50 @@ if not _IS_CRAFTER_AVAILABLE:
 from typing import Any, Dict, Optional, Sequence, Tuple, Union
 
 import crafter
-import gymnasium as gym
 import numpy as np
 from gymnasium import spaces
 
+from sheeprl_tpu.envs.legacy import LegacyGymAdapter, box_like, scalar_action
 
-class CrafterWrapper(gym.Wrapper):
-    def __init__(self, id: str, screen_size: Union[Sequence[int], int], seed: Optional[int] = None) -> None:
-        if id not in {"crafter_reward", "crafter_nonreward"}:
-            raise ValueError(f"unknown crafter id {id!r}")
-        if isinstance(screen_size, int):
-            screen_size = (screen_size, screen_size)
+# variant name -> does the env emit achievement rewards
+_VARIANTS = {"crafter_reward": True, "crafter_nonreward": False}
 
-        env = crafter.Env(size=tuple(screen_size), seed=seed, reward=(id == "crafter_reward"))
-        super().__init__(env)
-        self.observation_space = spaces.Dict(
-            {
-                "rgb": spaces.Box(
-                    self.env.observation_space.low,
-                    self.env.observation_space.high,
-                    self.env.observation_space.shape,
-                    self.env.observation_space.dtype,
-                )
-            }
+
+class CrafterWrapper(LegacyGymAdapter):
+    def __init__(
+        self, id: str, screen_size: Union[Sequence[int], int], seed: Optional[int] = None
+    ) -> None:
+        try:
+            rewarded = _VARIANTS[id]
+        except KeyError:
+            raise ValueError(f"unknown crafter id {id!r}; expected one of {sorted(_VARIANTS)}")
+        size = (screen_size, screen_size) if isinstance(screen_size, int) else tuple(screen_size)
+        raw = crafter.Env(size=size, seed=seed, reward=rewarded)
+        super().__init__(
+            raw,
+            observation_space=spaces.Dict({"rgb": box_like(raw.observation_space)}),
+            action_space=spaces.Discrete(raw.action_space.n),
+            seed=seed,
         )
-        self.action_space = spaces.Discrete(self.env.action_space.n)
-        self.reward_range = self.env.reward_range or (-np.inf, np.inf)
-        self.observation_space.seed(seed)
-        self.action_space.seed(seed)
-        self._render_mode = "rgb_array"
-        self._metadata = {"render_fps": 30}
+        self.reward_range = raw.reward_range or (-np.inf, np.inf)
 
-    @property
-    def render_mode(self) -> Optional[str]:
-        return self._render_mode
+    def _pack_observation(self, raw_obs: Any) -> Dict[str, np.ndarray]:
+        return {"rgb": raw_obs}
 
-    def step(self, action: Any) -> Tuple[Any, float, bool, bool, Dict[str, Any]]:
-        obs, reward, done, info = self.env.step(action)
-        # crafter signals time-limit ends with a non-zero discount
-        terminated = done and info["discount"] == 0
-        truncated = done and info["discount"] != 0
-        return {"rgb": obs}, reward, terminated, truncated, info
+    def _translate_action(self, action: Any) -> Any:
+        return scalar_action(action)
 
-    def reset(
-        self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
-    ) -> Tuple[Any, Dict[str, Any]]:
-        self.env._seed = seed
-        obs = self.env.reset()
-        return {"rgb": obs}, {}
+    def _end_of_episode(self, done: bool, info: Dict[str, Any]) -> Tuple[bool, bool]:
+        # a zero discount marks a real death; any other episode end is the
+        # built-in day limit running out
+        if not done:
+            return False, False
+        died = info["discount"] == 0
+        return bool(died), not died
 
-    def render(self):
-        return self.env.render()
+    def _on_reset(self, seed: Optional[int]) -> None:
+        # crafter reseeds through a plain attribute, not a reset argument
+        self.raw._seed = seed
 
-    def close(self) -> None:
+    def close(self) -> None:  # crafter.Env has no close()
         return

@@ -1,12 +1,19 @@
-"""DIAMBRA arcade adapter (reference: sheeprl/envs/diambra.py:22-145).
+"""DIAMBRA arcade adapter (behavioral parity: sheeprl/envs/diambra.py:22-145).
 
-Normalizes the DIAMBRA Dict observation (Discrete/MultiDiscrete sub-spaces
-become int32 Boxes) and routes the frame resizing through the engine when
-``increase_performance`` is set."""
+DIAMBRA is already gymnasium-native, so no legacy bridge is needed; the work
+here is normalization. The engine emits a Dict observation mixing Box,
+Discrete and MultiDiscrete sub-spaces — the encoder stack only eats Boxes, so
+the discrete sub-spaces are re-expressed as int32 Boxes through a small
+per-type conversion table. Frame sizing is pushed into the engine itself
+(``increase_performance``) or into the arena wrapper stack, and a few engine
+settings the adapter owns (frame shape, player count, the flattening wrapper)
+are stripped from user-supplied settings with a warning.
+"""
 
 from __future__ import annotations
 
 import warnings
+from typing import Any, Dict, Optional, Tuple, Union
 
 from sheeprl_tpu.utils.imports import _IS_DIAMBRA_AVAILABLE
 
@@ -15,16 +22,63 @@ if not _IS_DIAMBRA_AVAILABLE:
         "diambra / diambra-arena are not installed; install them to use the DIAMBRA environments"
     )
 
-from typing import Any, Dict, Optional, Tuple, Union
-
-import diambra
 import diambra.arena
 import gymnasium as gym
 import numpy as np
 from diambra.arena import EnvironmentSettings, WrappersSettings
 
+_ACTION_KINDS = ("DISCRETE", "MULTI_DISCRETE")
 
-class DiambraWrapper(gym.Wrapper):
+
+def _as_box(space: gym.spaces.Space) -> gym.spaces.Box:
+    """Normalize one observation sub-space to a Box the encoders accept."""
+    if isinstance(space, gym.spaces.Box):
+        return space
+    if isinstance(space, gym.spaces.Discrete):
+        return gym.spaces.Box(0, space.n - 1, (1,), np.int32)
+    if isinstance(space, gym.spaces.MultiDiscrete):
+        lows = np.zeros_like(space.nvec)
+        return gym.spaces.Box(lows, space.nvec - 1, (len(space.nvec),), np.int32)
+    raise RuntimeError(f"Invalid observation space, got: {type(space)}")
+
+
+def _drop_managed(options: Dict[str, Any], managed: Tuple[str, ...], kind: str) -> None:
+    for key in managed:
+        if options.pop(key, None) is not None:
+            warnings.warn(f"The DIAMBRA {key} {kind} is managed by the wrapper")
+
+
+def _engine_settings(
+    game_id: str,
+    action_space: str,
+    role: Optional[str],
+    render_mode: str,
+    repeat_action: int,
+    user: Dict[str, Any],
+) -> EnvironmentSettings:
+    if action_space not in _ACTION_KINDS:
+        raise ValueError(f"action_space must be 'DISCRETE' or 'MULTI_DISCRETE', got {action_space}")
+    if role is not None and role not in {"P1", "P2"}:
+        raise ValueError(f"role must be 'P1', 'P2' or None, got {role}")
+    merged = {
+        **user,
+        "game_id": game_id,
+        "action_space": getattr(diambra.arena.SpaceTypes, action_space, diambra.arena.SpaceTypes.DISCRETE),
+        "n_players": 1,
+        "role": None if role is None else getattr(diambra.arena.Roles, role, diambra.arena.Roles.P1),
+        "render_mode": render_mode,
+    }
+    settings = EnvironmentSettings(**merged)
+    if repeat_action > 1:
+        # the wrapper stack repeats actions itself; engine-side frame skipping
+        # would compound with it
+        if "step_ratio" not in settings or settings["step_ratio"] > 1:
+            warnings.warn(f"step_ratio forced to 1 because action repeat is active ({repeat_action})")
+        settings["step_ratio"] = 1
+    return settings
+
+
+class DiambraWrapper(gym.Env):
     def __init__(
         self,
         id: str,
@@ -41,84 +95,55 @@ class DiambraWrapper(gym.Wrapper):
     ) -> None:
         if isinstance(screen_size, int):
             screen_size = (screen_size, screen_size)
-        diambra_settings = dict(diambra_settings or {})
-        diambra_wrappers = dict(diambra_wrappers or {})
-        for blocked in ("frame_shape", "n_players"):
-            if diambra_settings.pop(blocked, None) is not None:
-                warnings.warn(f"The DIAMBRA {blocked} setting is managed by the wrapper")
-        role = diambra_settings.pop("role", None)
-        if action_space not in {"DISCRETE", "MULTI_DISCRETE"}:
-            raise ValueError(
-                f"action_space must be 'DISCRETE' or 'MULTI_DISCRETE', got {action_space}"
-            )
-        if role is not None and role not in {"P1", "P2"}:
-            raise ValueError(f"role must be 'P1', 'P2' or None, got {role}")
-        self._action_type = action_space.lower()
-        settings = EnvironmentSettings(
-            **{
-                **diambra_settings,
-                "game_id": id,
-                "action_space": getattr(diambra.arena.SpaceTypes, action_space, diambra.arena.SpaceTypes.DISCRETE),
-                "n_players": 1,
-                "role": getattr(diambra.arena.Roles, role, diambra.arena.Roles.P1) if role is not None else None,
-                "render_mode": render_mode,
-            }
-        )
-        if repeat_action > 1:
-            if "step_ratio" not in settings or settings["step_ratio"] > 1:
-                warnings.warn(
-                    f"step_ratio forced to 1 because action repeat is active ({repeat_action})"
-                )
-            settings["step_ratio"] = 1
-        for blocked in ("frame_shape", "stack_frames", "dilation", "flatten"):
-            if diambra_wrappers.pop(blocked, None) is not None:
-                warnings.warn(f"The DIAMBRA {blocked} wrapper is managed by the wrapper")
-        wrappers = WrappersSettings(
-            **{**diambra_wrappers, "flatten": True, "repeat_action": repeat_action}
-        )
+        frame_shape = tuple(screen_size) + (int(grayscale),)
+
+        user_settings = dict(diambra_settings or {})
+        _drop_managed(user_settings, ("frame_shape", "n_players"), "setting")
+        role = user_settings.pop("role", None)
+        settings = _engine_settings(id, action_space, role, render_mode, repeat_action, user_settings)
+
+        user_wrappers = dict(diambra_wrappers or {})
+        _drop_managed(user_wrappers, ("frame_shape", "stack_frames", "dilation", "flatten"), "wrapper")
+        wrappers = WrappersSettings(**{**user_wrappers, "flatten": True, "repeat_action": repeat_action})
+
+        # resizing inside the engine is cheaper than a python-side resize of
+        # full-resolution frames, at the price of engine-version coupling
         if increase_performance:
-            settings.frame_shape = tuple(screen_size) + (int(grayscale),)
+            settings.frame_shape = frame_shape
         else:
-            wrappers.frame_shape = tuple(screen_size) + (int(grayscale),)
-        env = diambra.arena.make(id, settings, wrappers, rank=rank, render_mode=render_mode, log_level=log_level)
-        super().__init__(env)
+            wrappers.frame_shape = frame_shape
 
-        self.action_space = self.env.action_space
-        obs: Dict[str, gym.spaces.Space] = {}
-        for k, space in self.env.observation_space.spaces.items():
-            if isinstance(space, gym.spaces.Box):
-                obs[k] = space
-            elif isinstance(space, gym.spaces.Discrete):
-                obs[k] = gym.spaces.Box(0, space.n - 1, (1,), np.int32)
-            elif isinstance(space, gym.spaces.MultiDiscrete):
-                obs[k] = gym.spaces.Box(np.zeros_like(space.nvec), space.nvec - 1, (len(space.nvec),), np.int32)
-            else:
-                raise RuntimeError(f"Invalid observation space, got: {type(space)}")
-        self.observation_space = gym.spaces.Dict(obs)
-        self._render_mode = render_mode
+        self._engine = diambra.arena.make(
+            id, settings, wrappers, rank=rank, render_mode=render_mode, log_level=log_level
+        )
+        self._discrete_actions = action_space == "DISCRETE"
+        self.render_mode = render_mode
+        self.action_space = self._engine.action_space
+        self.observation_space = gym.spaces.Dict(
+            {k: _as_box(v) for k, v in self._engine.observation_space.spaces.items()}
+        )
 
-    @property
-    def render_mode(self) -> Optional[str]:
-        return self._render_mode
-
-    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
-        return {
-            k: np.asarray(v).reshape(self.observation_space[k].shape) for k, v in obs.items()
-        }
+    def _normalize(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v).reshape(self.observation_space[k].shape) for k, v in obs.items()}
 
     def step(self, action: Any) -> Tuple[Any, float, bool, bool, Dict[str, Any]]:
-        if self._action_type == "discrete" and isinstance(action, np.ndarray):
-            action = action.squeeze().item()
-        obs, reward, terminated, truncated, infos = self.env.step(action)
-        infos["env_domain"] = "DIAMBRA"
-        return self._convert_obs(obs), reward, terminated or infos.get("env_done", False), truncated, infos
-
-    def render(self, mode: str = "rgb_array", **kwargs):
-        return self.env.render()
+        if self._discrete_actions and isinstance(action, np.ndarray):
+            action = action.reshape(()).item()
+        obs, reward, terminated, truncated, info = self._engine.step(action)
+        info["env_domain"] = "DIAMBRA"
+        # the engine reports the end of the full game run separately
+        terminated = terminated or bool(info.get("env_done", False))
+        return self._normalize(obs), reward, terminated, truncated, info
 
     def reset(
         self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
     ) -> Tuple[Any, Dict[str, Any]]:
-        obs, infos = self.env.reset(seed=seed, options=options)
-        infos["env_domain"] = "DIAMBRA"
-        return self._convert_obs(obs), infos
+        obs, info = self._engine.reset(seed=seed, options=options)
+        info["env_domain"] = "DIAMBRA"
+        return self._normalize(obs), info
+
+    def render(self, mode: str = "rgb_array", **kwargs: Any) -> Any:
+        return self._engine.render()
+
+    def close(self) -> None:
+        self._engine.close()

@@ -1,0 +1,104 @@
+"""Shared bridge from pre-gymnasium ("legacy gym") environments to the
+framework's gymnasium contract.
+
+Every third-party game wrapped here (crafter, nes-py Super Mario, MineRL,
+MineDojo) still speaks the old gym API: 4-tuple ``step``, bare ``reset``
+return, no terminated/truncated split, ad-hoc seeding. The reference
+re-implements that bridge separately inside each of its adapters
+(``sheeprl/envs/crafter.py``, ``super_mario_bros.py``, ``minerl.py``,
+``minedojo.py``); here it lives once, and each adapter only supplies the
+game-specific pieces through four hooks:
+
+- :meth:`_pack_observation` — raw observation → framework Dict obs
+- :meth:`_translate_action` — framework action → raw env action
+- :meth:`_end_of_episode` — (done, info) → (terminated, truncated)
+- :meth:`_on_reset` — per-episode state re-initialization
+
+Subclasses construct their raw env and spaces, then call
+``super().__init__(raw_env, obs_space, act_space, seed)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+
+def box_like(space: Any) -> spaces.Box:
+    """Clone a legacy Box-ish space (anything with low/high/shape/dtype)
+    into a gymnasium ``Box``."""
+    return spaces.Box(space.low, space.high, space.shape, space.dtype)
+
+
+def pixel_space(height: int, width: int, channels: int = 3) -> spaces.Box:
+    """The framework-wide pixel contract: NHWC uint8 in [0, 255]."""
+    return spaces.Box(0, 255, (height, width, channels), np.uint8)
+
+
+class LegacyGymAdapter(gym.Env):
+    """gymnasium facade over an old-gym environment (see module docstring)."""
+
+    metadata = {"render_modes": ["rgb_array"], "render_fps": 30}
+
+    def __init__(
+        self,
+        raw_env: Any,
+        observation_space: spaces.Space,
+        action_space: spaces.Space,
+        seed: Optional[int] = None,
+        render_mode: str = "rgb_array",
+    ) -> None:
+        self.raw = raw_env
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.render_mode = render_mode
+        if seed is not None:
+            self.observation_space.seed(seed)
+            self.action_space.seed(seed)
+
+    # ------------------------------------------------------------- hooks
+    def _pack_observation(self, raw_obs: Any) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def _translate_action(self, action: Any) -> Any:
+        return action
+
+    def _end_of_episode(self, done: bool, info: Dict[str, Any]) -> Tuple[bool, bool]:
+        """Split the legacy ``done`` flag. Default: every end is a true
+        termination (no time limit inside the raw env)."""
+        return done, False
+
+    def _on_reset(self, seed: Optional[int]) -> None:
+        pass
+
+    # ---------------------------------------------------- gymnasium API
+    def step(self, action: Any) -> Tuple[Dict[str, np.ndarray], float, bool, bool, Dict[str, Any]]:
+        raw_obs, reward, done, info = self.raw.step(self._translate_action(action))
+        terminated, truncated = self._end_of_episode(bool(done), info)
+        return self._pack_observation(raw_obs), float(reward), terminated, truncated, info
+
+    def reset(
+        self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
+    ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        self._on_reset(seed)
+        raw_obs = self.raw.reset()
+        return self._pack_observation(raw_obs), {}
+
+    def render(self) -> Any:
+        return self.raw.render()
+
+    def close(self) -> None:
+        close = getattr(self.raw, "close", None)
+        if callable(close):
+            close()
+
+
+def scalar_action(action: Any) -> Any:
+    """Vectorized policies emit 0-d / length-1 arrays for Discrete spaces;
+    legacy envs want plain ints."""
+    if isinstance(action, np.ndarray):
+        return action.reshape(()).item()
+    return action

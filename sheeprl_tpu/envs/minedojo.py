@@ -1,10 +1,15 @@
-"""MineDojo adapter (reference: sheeprl/envs/minedojo.py:55-303).
+"""MineDojo adapter (behavioral parity: sheeprl/envs/minedojo.py:55-303).
 
-Exposes a 3-head MultiDiscrete action space (action-type, craft-item,
-equip/place/destroy-item) over MineDojo's 8-slot functional action array,
-plus per-head ACTION MASKS in the observation dict — the mask keys are
-consumed by the Dreamer ``MinedojoActor`` (algos/dreamer_v3/agent.py).
-Sticky attack/jump and pitch limiting follow the reference."""
+MineDojo's native action interface is an 8-slot functional array; the agent
+instead sees a 3-head MultiDiscrete — a menu of 19 composite moves plus a
+craft argument and an inventory-item argument — and per-head ACTION MASKS in
+the observation dict (consumed by the Dreamer ``MinedojoActor``,
+``algos/dreamer_v3/agent.py``). The adapter rides the shared
+:class:`~sheeprl_tpu.envs.legacy.LegacyGymAdapter` bridge and keeps the
+Minecraft-specific machinery here: composite-action decoding with sticky
+attack/jump, pitch clamping, and the item-table re-encoding of inventories,
+equipment and masks.
+"""
 
 from __future__ import annotations
 
@@ -23,35 +28,94 @@ import minedojo
 import numpy as np
 from minedojo.sim import ALL_CRAFT_SMELT_ITEMS, ALL_ITEMS
 
+from sheeprl_tpu.envs.legacy import LegacyGymAdapter
+
 N_ALL_ITEMS = len(ALL_ITEMS)
-# 19 composite actions over MineDojo's 8-slot array: [move_fb, move_lr,
-# jump/sneak/sprint, pitch, yaw, functional, craft-arg, inventory-arg]
-ACTION_MAP = {
-    0: np.array([0, 0, 0, 12, 12, 0, 0, 0]),  # no-op
-    1: np.array([1, 0, 0, 12, 12, 0, 0, 0]),  # forward
-    2: np.array([2, 0, 0, 12, 12, 0, 0, 0]),  # back
-    3: np.array([0, 1, 0, 12, 12, 0, 0, 0]),  # left
-    4: np.array([0, 2, 0, 12, 12, 0, 0, 0]),  # right
-    5: np.array([1, 0, 1, 12, 12, 0, 0, 0]),  # jump + forward
-    6: np.array([1, 0, 2, 12, 12, 0, 0, 0]),  # sneak + forward
-    7: np.array([1, 0, 3, 12, 12, 0, 0, 0]),  # sprint + forward
-    8: np.array([0, 0, 0, 11, 12, 0, 0, 0]),  # pitch down (-15)
-    9: np.array([0, 0, 0, 13, 12, 0, 0, 0]),  # pitch up (+15)
-    10: np.array([0, 0, 0, 12, 11, 0, 0, 0]),  # yaw down (-15)
-    11: np.array([0, 0, 0, 12, 13, 0, 0, 0]),  # yaw up (+15)
-    12: np.array([0, 0, 0, 12, 12, 1, 0, 0]),  # use
-    13: np.array([0, 0, 0, 12, 12, 2, 0, 0]),  # drop
-    14: np.array([0, 0, 0, 12, 12, 3, 0, 0]),  # attack
-    15: np.array([0, 0, 0, 12, 12, 4, 0, 0]),  # craft
-    16: np.array([0, 0, 0, 12, 12, 5, 0, 0]),  # equip
-    17: np.array([0, 0, 0, 12, 12, 6, 0, 0]),  # place
-    18: np.array([0, 0, 0, 12, 12, 7, 0, 0]),  # destroy
-}
+
+# slots of MineDojo's raw 8-element action array
+_FB, _LR, _BODY, _PITCH, _YAW, _FN, _CRAFT_ARG, _SLOT_ARG = range(8)
+# values of the functional slot
+_FN_NOOP, _FN_USE, _FN_DROP, _FN_ATTACK, _FN_CRAFT, _FN_EQUIP, _FN_PLACE, _FN_DESTROY = range(8)
+_CAMERA_NOOP = 12  # camera slots are 24-step discretized; 12 = hold
+
+# the 19-move composite menu (head 0), as (slot, value) edits of a no-op row
+_MOVES = (
+    (),  # 0: no-op
+    ((_FB, 1),),  # 1: forward
+    ((_FB, 2),),  # 2: back
+    ((_LR, 1),),  # 3: left
+    ((_LR, 2),),  # 4: right
+    ((_FB, 1), (_BODY, 1)),  # 5: jump + forward
+    ((_FB, 1), (_BODY, 2)),  # 6: sneak + forward
+    ((_FB, 1), (_BODY, 3)),  # 7: sprint + forward
+    ((_PITCH, _CAMERA_NOOP - 1),),  # 8: pitch down (-15 deg)
+    ((_PITCH, _CAMERA_NOOP + 1),),  # 9: pitch up (+15 deg)
+    ((_YAW, _CAMERA_NOOP - 1),),  # 10: yaw down (-15 deg)
+    ((_YAW, _CAMERA_NOOP + 1),),  # 11: yaw up (+15 deg)
+    ((_FN, _FN_USE),),  # 12
+    ((_FN, _FN_DROP),),  # 13
+    ((_FN, _FN_ATTACK),),  # 14
+    ((_FN, _FN_CRAFT),),  # 15
+    ((_FN, _FN_EQUIP),),  # 16
+    ((_FN, _FN_PLACE),),  # 17
+    ((_FN, _FN_DESTROY),),  # 18
+)
+# index of the first functional move whose mask row depends on the inventory
+_EQUIP_MOVES = slice(5, 7)  # mask rows 5..6 of masks["action_type"][1:] (equip/place)
+_DESTROY_MOVE = 7
+
 ITEM_ID_TO_NAME = dict(enumerate(ALL_ITEMS))
-ITEM_NAME_TO_ID = dict(zip(ALL_ITEMS, range(N_ALL_ITEMS)))
+ITEM_NAME_TO_ID = {name: i for i, name in enumerate(ALL_ITEMS)}
 
 
-class MineDojoWrapper(gym.Wrapper):
+def _canonical(item: str) -> str:
+    return "_".join(item.split(" "))
+
+
+def _decode_move(move: int) -> np.ndarray:
+    row = np.zeros(8, np.int32)
+    row[_PITCH] = row[_YAW] = _CAMERA_NOOP
+    for slot, value in _MOVES[move]:
+        row[slot] = value
+    return row
+
+
+class _StickyKeys:
+    """Hold attack/jump down for a few frames after the agent releases them
+    (the reference's sticky-action scheme, minedojo.py:119-141)."""
+
+    def __init__(self, attack_frames: int, jump_frames: int) -> None:
+        self.attack_frames = attack_frames
+        self.jump_frames = jump_frames
+        self.attack_left = 0
+        self.jump_left = 0
+
+    def reset(self) -> None:
+        self.attack_left = 0
+        self.jump_left = 0
+
+    def apply(self, row: np.ndarray) -> None:
+        if self.attack_frames:
+            if row[_FN] == _FN_ATTACK:
+                self.attack_left = self.attack_frames - 1
+            if self.attack_left > 0 and row[_FN] == _FN_NOOP:
+                row[_FN] = _FN_ATTACK
+                self.attack_left -= 1
+            elif row[_FN] != _FN_ATTACK:
+                self.attack_left = 0
+        if self.jump_frames:
+            if row[_BODY] == 1:
+                self.jump_left = self.jump_frames - 1
+            if self.jump_left > 0 and row[_FB] == 0:
+                row[_BODY] = 1
+                if row[_FB] == 0 and row[_LR] == 0:
+                    row[_FB] = 1  # keep momentum while the sticky jump plays out
+                self.jump_left -= 1
+            elif row[_BODY] != 1:
+                self.jump_left = 0
+
+
+class MineDojoWrapper(LegacyGymAdapter):
     def __init__(
         self,
         id: str,
@@ -63,205 +127,195 @@ class MineDojoWrapper(gym.Wrapper):
         sticky_jump: Optional[int] = 10,
         **kwargs: Any,
     ):
-        self._height = height
-        self._width = width
         self._pitch_limits = pitch_limits
-        self._pos = kwargs.get("start_position", None)
-        self._break_speed_multiplier = kwargs.get("break_speed_multiplier", 100)
-        self._start_pos = copy.deepcopy(self._pos)
-        # sticky attack is pointless with a high break-speed multiplier
-        self._sticky_attack = 0 if self._break_speed_multiplier > 1 else sticky_attack
-        self._sticky_jump = sticky_jump
-        self._sticky_attack_counter = 0
-        self._sticky_jump_counter = 0
-        if self._pos is not None and not (pitch_limits[0] <= self._pos["pitch"] <= pitch_limits[1]):
+        self._position: Optional[Dict[str, float]] = kwargs.get("start_position", None)
+        break_speed = kwargs.get("break_speed_multiplier", 100)
+        if self._position is not None and not (
+            pitch_limits[0] <= self._position["pitch"] <= pitch_limits[1]
+        ):
             raise ValueError(
-                f"The initial position must respect the pitch limits {pitch_limits}, given {self._pos['pitch']}"
+                f"The initial position must respect the pitch limits {pitch_limits}, "
+                f"given {self._position['pitch']}"
             )
+        # a super-human break speed makes held attacks redundant
+        self._sticky = _StickyKeys(
+            attack_frames=0 if break_speed > 1 else (sticky_attack or 0),
+            jump_frames=sticky_jump or 0,
+        )
 
-        env = minedojo.make(
+        raw = minedojo.make(
             task_id=id, image_size=(height, width), world_seed=seed, fast_reset=True, **kwargs
         )
-        super().__init__(env)
-        self._inventory: Dict[str, Any] = {}
-        self._inventory_names: Optional[np.ndarray] = None
-        self._inventory_max = np.zeros(N_ALL_ITEMS)
-        self.action_space = gym.spaces.MultiDiscrete(
-            np.array([len(ACTION_MAP), len(ALL_CRAFT_SMELT_ITEMS), N_ALL_ITEMS])
+        item_box = lambda low, high, dtype=np.float32: gym.spaces.Box(  # noqa: E731
+            low, high, (N_ALL_ITEMS,), dtype
         )
-        self.observation_space = gym.spaces.Dict(
-            {
-                "rgb": gym.spaces.Box(0, 255, self.env.observation_space["rgb"].shape, np.uint8),
-                "inventory": gym.spaces.Box(0.0, np.inf, (N_ALL_ITEMS,), np.float32),
-                "inventory_max": gym.spaces.Box(0.0, np.inf, (N_ALL_ITEMS,), np.float32),
-                "inventory_delta": gym.spaces.Box(-np.inf, np.inf, (N_ALL_ITEMS,), np.float32),
-                "equipment": gym.spaces.Box(0.0, 1.0, (N_ALL_ITEMS,), np.int32),
-                "life_stats": gym.spaces.Box(0.0, np.array([20.0, 20.0, 300.0]), (3,), np.float32),
-                "mask_action_type": gym.spaces.Box(0, 1, (len(ACTION_MAP),), bool),
-                "mask_equip_place": gym.spaces.Box(0, 1, (N_ALL_ITEMS,), bool),
-                "mask_destroy": gym.spaces.Box(0, 1, (N_ALL_ITEMS,), bool),
-                "mask_craft_smelt": gym.spaces.Box(0, 1, (len(ALL_CRAFT_SMELT_ITEMS),), bool),
-            }
+        super().__init__(
+            raw,
+            observation_space=gym.spaces.Dict(
+                {
+                    # mirror the simulator's native pixel layout untouched
+                    "rgb": gym.spaces.Box(0, 255, raw.observation_space["rgb"].shape, np.uint8),
+                    "inventory": item_box(0.0, np.inf),
+                    "inventory_max": item_box(0.0, np.inf),
+                    "inventory_delta": item_box(-np.inf, np.inf),
+                    "equipment": item_box(0.0, 1.0, np.int32),
+                    "life_stats": gym.spaces.Box(0.0, np.array([20.0, 20.0, 300.0]), (3,), np.float32),
+                    "mask_action_type": gym.spaces.Box(0, 1, (len(_MOVES),), bool),
+                    "mask_equip_place": gym.spaces.Box(0, 1, (N_ALL_ITEMS,), bool),
+                    "mask_destroy": gym.spaces.Box(0, 1, (N_ALL_ITEMS,), bool),
+                    "mask_craft_smelt": gym.spaces.Box(0, 1, (len(ALL_CRAFT_SMELT_ITEMS),), bool),
+                }
+            ),
+            action_space=gym.spaces.MultiDiscrete(
+                np.array([len(_MOVES), len(ALL_CRAFT_SMELT_ITEMS), N_ALL_ITEMS])
+            ),
+            seed=seed,
         )
-        self._render_mode = "rgb_array"
-        self.seed(seed=seed)
+        self._slots_by_item: Dict[str, list] = {}
+        self._slot_item_names: Optional[np.ndarray] = None
+        self._inventory_high = np.zeros(N_ALL_ITEMS)
 
-    @property
-    def render_mode(self) -> Optional[str]:
-        return self._render_mode
+    # MineDojo task attributes (task_prompt, task_guidance, ...) pass through
+    def __getattr__(self, name: str) -> Any:
+        if name == "raw":  # not yet bound during __init__
+            raise AttributeError(name)
+        return getattr(self.raw, name)
 
-    def __getattr__(self, name):
-        return getattr(self.env, name)
-
-    def _convert_inventory(self, inventory: Dict[str, Any]) -> np.ndarray:
+    # ------------------------------------------------------------ observation
+    def _count_inventory(self, inventory: Dict[str, Any]) -> np.ndarray:
         counts = np.zeros(N_ALL_ITEMS)
-        self._inventory = {}
-        self._inventory_names = np.array(
-            ["_".join(item.split(" ")) for item in inventory["name"].copy().tolist()]
-        )
-        for i, (item, quantity) in enumerate(zip(inventory["name"], inventory["quantity"])):
-            item = "_".join(item.split(" "))
-            self._inventory.setdefault(item, []).append(i)
-            counts[ITEM_NAME_TO_ID[item]] += 1 if item == "air" else quantity
-        self._inventory_max = np.maximum(counts, self._inventory_max)
+        self._slots_by_item = {}
+        names = [_canonical(item) for item in inventory["name"].tolist()]
+        self._slot_item_names = np.array(names)
+        for slot, (item, qty) in enumerate(zip(names, inventory["quantity"])):
+            self._slots_by_item.setdefault(item, []).append(slot)
+            counts[ITEM_NAME_TO_ID[item]] += 1 if item == "air" else qty
+        self._inventory_high = np.maximum(counts, self._inventory_high)
         return counts
 
-    def _convert_inventory_delta(self, delta: Dict[str, Any]) -> np.ndarray:
+    def _sum_inventory_delta(self, delta: Dict[str, Any]) -> np.ndarray:
         out = np.zeros(N_ALL_ITEMS)
-        for names, quantities, sign in (
-            (delta["inc_name_by_craft"], delta["inc_quantity_by_craft"], +1),
-            (delta["dec_name_by_craft"], delta["dec_quantity_by_craft"], -1),
-            (delta["inc_name_by_other"], delta["inc_quantity_by_other"], +1),
-            (delta["dec_name_by_other"], delta["dec_quantity_by_other"], -1),
-        ):
-            for item, quantity in zip(names, quantities):
-                out[ITEM_NAME_TO_ID["_".join(item.split(" "))]] += sign * quantity
+        for prefix, sign in (("inc", +1), ("dec", -1)):
+            for source in ("craft", "other"):
+                names = delta[f"{prefix}_name_by_{source}"]
+                quantities = delta[f"{prefix}_quantity_by_{source}"]
+                for item, qty in zip(names, quantities):
+                    out[ITEM_NAME_TO_ID[_canonical(item)]] += sign * qty
         return out
 
-    def _convert_equipment(self, equipment: Dict[str, Any]) -> np.ndarray:
-        equip = np.zeros(N_ALL_ITEMS, dtype=np.int32)
-        equip[ITEM_NAME_TO_ID["_".join(equipment["name"][0].split(" "))]] = 1
-        return equip
-
-    def _convert_masks(self, masks: Dict[str, Any]) -> Dict[str, np.ndarray]:
-        equip_mask = np.zeros(N_ALL_ITEMS, dtype=bool)
-        destroy_mask = np.zeros(N_ALL_ITEMS, dtype=bool)
-        for item, eqp, dst in zip(self._inventory_names, masks["equip"], masks["destroy"]):
-            idx = ITEM_NAME_TO_ID[item]
-            equip_mask[idx] = eqp
-            destroy_mask[idx] = dst
-        # equip/place (composite actions 16-17) need an equippable item,
-        # destroy (18) a destroyable one
-        masks["action_type"][5:7] *= np.any(equip_mask).item()
-        masks["action_type"][7] *= np.any(destroy_mask).item()
+    def _item_masks(self, masks: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        equip_ok = np.zeros(N_ALL_ITEMS, dtype=bool)
+        destroy_ok = np.zeros(N_ALL_ITEMS, dtype=bool)
+        for item, can_equip, can_destroy in zip(
+            self._slot_item_names, masks["equip"], masks["destroy"]
+        ):
+            item_id = ITEM_NAME_TO_ID[item]
+            equip_ok[item_id] = can_equip
+            destroy_ok[item_id] = can_destroy
+        # functional moves needing an item argument are only legal when some
+        # item qualifies
+        fn_mask = np.asarray(masks["action_type"]).copy()
+        fn_mask[_EQUIP_MOVES] *= bool(equip_ok.any())
+        fn_mask[_DESTROY_MOVE] *= bool(destroy_ok.any())
+        move_mask = np.ones(len(_MOVES), dtype=bool)
+        move_mask[12:] = fn_mask[1:]  # moves 0-11 (movement/camera) are always legal
         return {
-            "mask_action_type": np.concatenate((np.array([True] * 12), masks["action_type"][1:])),
-            "mask_equip_place": equip_mask,
-            "mask_destroy": destroy_mask,
+            "mask_action_type": move_mask,
+            "mask_equip_place": equip_ok,
+            "mask_destroy": destroy_ok,
             "mask_craft_smelt": masks["craft_smelt"],
         }
 
-    def _convert_action(self, action: np.ndarray) -> np.ndarray:
-        converted = ACTION_MAP[int(action[0])].copy()
-        if self._sticky_attack:
-            if converted[5] == 3:  # attack selected: arm the sticky counter
-                self._sticky_attack_counter = self._sticky_attack - 1
-            if self._sticky_attack_counter > 0 and converted[5] == 0:
-                converted[5] = 3
-                self._sticky_attack_counter -= 1
-            elif converted[5] != 3:
-                self._sticky_attack_counter = 0
-        if self._sticky_jump:
-            if converted[2] == 1:  # jump selected: arm the sticky counter
-                self._sticky_jump_counter = self._sticky_jump - 1
-            if self._sticky_jump_counter > 0 and converted[0] == 0:
-                converted[2] = 1
-                # keep moving forward while sticky-jumping unless the agent
-                # chose another movement
-                if converted[0] == converted[1] == 0:
-                    converted[0] = 1
-                self._sticky_jump_counter -= 1
-            elif converted[2] != 1:
-                self._sticky_jump_counter = 0
-        # craft takes the second head as its argument
-        converted[6] = int(action[1]) if converted[5] == 4 else 0
-        # equip/place/destroy take an inventory slot resolved from the item id
-        if converted[5] in {5, 6, 7}:
-            converted[7] = self._inventory[ITEM_ID_TO_NAME[int(action[2])]][0]
-        else:
-            converted[7] = 0
-        return converted
+    def _life_stats(self, obs: Dict[str, Any]) -> np.ndarray:
+        stats = obs["life_stats"]
+        return np.concatenate((stats["life"], stats["food"], stats["oxygen"])).astype(np.float32)
 
-    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    def _pack_observation(self, raw_obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
         return {
-            "rgb": obs["rgb"].copy(),
-            "inventory": self._convert_inventory(obs["inventory"]),
-            "inventory_max": self._inventory_max,
-            "inventory_delta": self._convert_inventory_delta(obs["delta_inv"]),
-            "equipment": self._convert_equipment(obs["equipment"]),
-            "life_stats": np.concatenate(
-                (obs["life_stats"]["life"], obs["life_stats"]["food"], obs["life_stats"]["oxygen"])
-            ),
-            **self._convert_masks(obs["masks"]),
+            "rgb": raw_obs["rgb"].copy(),
+            "inventory": self._count_inventory(raw_obs["inventory"]),
+            "inventory_max": self._inventory_high,
+            "inventory_delta": self._sum_inventory_delta(raw_obs["delta_inv"]),
+            "equipment": self._equipment_onehot(raw_obs["equipment"]),
+            "life_stats": self._life_stats(raw_obs),
+            **self._item_masks(raw_obs["masks"]),
         }
 
-    def _update_pos(self, obs: Dict[str, Any]) -> None:
-        self._pos = {
-            "x": float(obs["location_stats"]["pos"][0]),
-            "y": float(obs["location_stats"]["pos"][1]),
-            "z": float(obs["location_stats"]["pos"][2]),
-            "pitch": float(obs["location_stats"]["pitch"].item()),
-            "yaw": float(obs["location_stats"]["yaw"].item()),
+    def _equipment_onehot(self, equipment: Dict[str, Any]) -> np.ndarray:
+        onehot = np.zeros(N_ALL_ITEMS, dtype=np.int32)
+        onehot[ITEM_NAME_TO_ID[_canonical(equipment["name"][0])]] = 1
+        return onehot
+
+    # ----------------------------------------------------------------- action
+    def _translate_action(self, action: np.ndarray) -> np.ndarray:
+        move, craft_arg, item_arg = (int(a) for a in np.asarray(action).reshape(3))
+        row = _decode_move(move)
+        self._sticky.apply(row)
+        row[_CRAFT_ARG] = craft_arg if row[_FN] == _FN_CRAFT else 0
+        if row[_FN] in (_FN_EQUIP, _FN_PLACE, _FN_DESTROY):
+            # the raw interface wants an inventory slot, the agent names an item
+            row[_SLOT_ARG] = self._slots_by_item[ITEM_ID_TO_NAME[item_arg]][0]
+        else:
+            row[_SLOT_ARG] = 0
+        # clamp the camera rather than let the agent wrap its neck
+        next_pitch = self._position["pitch"] + (row[_PITCH] - _CAMERA_NOOP) * 15
+        if not (self._pitch_limits[0] <= next_pitch <= self._pitch_limits[1]):
+            row[_PITCH] = _CAMERA_NOOP
+        return row
+
+    # ------------------------------------------------------------- transitions
+    def _read_position(self, raw_obs: Dict[str, Any]) -> Dict[str, float]:
+        loc = raw_obs["location_stats"]
+        return {
+            "x": float(loc["pos"][0]),
+            "y": float(loc["pos"][1]),
+            "z": float(loc["pos"][2]),
+            "pitch": float(loc["pitch"].item()),
+            "yaw": float(loc["yaw"].item()),
         }
 
-    def seed(self, seed: Optional[int] = None) -> None:
-        self.observation_space.seed(seed)
-        self.action_space.seed(seed)
+    def _info(self, raw_obs: Dict[str, Any]) -> Dict[str, Any]:
+        stats = raw_obs["life_stats"]
+        return {
+            "life_stats": {
+                "life": float(stats["life"].item()),
+                "oxygen": float(stats["oxygen"].item()),
+                "food": float(stats["food"].item()),
+            },
+            "location_stats": copy.deepcopy(self._position),
+            "biomeid": float(raw_obs["location_stats"]["biome_id"].item()),
+        }
 
     def step(self, action: np.ndarray) -> Tuple[Any, float, bool, bool, Dict[str, Any]]:
-        raw = action
-        action = self._convert_action(action)
-        next_pitch = self._pos["pitch"] + (action[3] - 12) * 15
-        if not (self._pitch_limits[0] <= next_pitch <= self._pitch_limits[1]):
-            action[3] = 12  # clamp: replace the pitch action with a no-op
-
-        obs, reward, done, info = self.env.step(action)
-        is_timelimit = info.get("TimeLimit.truncated", False)
-        self._update_pos(obs)
-        info.update(
-            {
-                "life_stats": {
-                    "life": float(obs["life_stats"]["life"].item()),
-                    "oxygen": float(obs["life_stats"]["oxygen"].item()),
-                    "food": float(obs["life_stats"]["food"].item()),
-                },
-                "location_stats": copy.deepcopy(self._pos),
-                "action": raw.tolist(),
-                "biomeid": float(obs["location_stats"]["biome_id"].item()),
-            }
+        row = self._translate_action(action)
+        raw_obs, reward, done, info = self.raw.step(row)
+        self._position = self._read_position(raw_obs)
+        timed_out = bool(info.get("TimeLimit.truncated", False))
+        info.update(self._info(raw_obs))
+        info["action"] = np.asarray(action).tolist()
+        return (
+            self._pack_observation(raw_obs),
+            float(reward),
+            done and not timed_out,
+            done and timed_out,
+            info,
         )
-        return self._convert_obs(obs), reward, done and not is_timelimit, done and is_timelimit, info
 
     def reset(
         self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
     ) -> Tuple[Any, Dict[str, Any]]:
-        obs = self.env.reset()
-        self._update_pos(obs)
-        self._sticky_jump_counter = 0
-        self._sticky_attack_counter = 0
-        self._inventory_max = np.zeros(N_ALL_ITEMS)
-        return self._convert_obs(obs), {
-            "life_stats": {
-                "life": float(obs["life_stats"]["life"].item()),
-                "oxygen": float(obs["life_stats"]["oxygen"].item()),
-                "food": float(obs["life_stats"]["food"].item()),
-            },
-            "location_stats": copy.deepcopy(self._pos),
-            "biomeid": float(obs["location_stats"]["biome_id"].item()),
-        }
+        raw_obs = self.raw.reset()
+        self._position = self._read_position(raw_obs)
+        self._sticky.reset()
+        self._inventory_high = np.zeros(N_ALL_ITEMS)
+        return self._pack_observation(raw_obs), self._info(raw_obs)
 
-    def render(self):
+    def render(self) -> Any:
         if self.render_mode == "rgb_array":
-            prev = self.env.unwrapped._prev_obs
+            prev = self.raw.unwrapped._prev_obs
             return None if prev is None else prev["rgb"]
-        return super().render()
+        return None
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)

@@ -1,9 +1,14 @@
-"""MineRL 0.4.4 adapter (reference: sheeprl/envs/minerl.py:48-322).
+"""MineRL 0.4.4 adapter (behavioral parity: sheeprl/envs/minerl.py:48-322).
 
-Flattens MineRL's dict action space into one Discrete head (built dynamically
-from the env spec's actionables, with jump/sneak/sprint implying forward),
-multi-hot inventories, sticky attack/jump, and pitch limiting. Unlike the
-reference (which emits CHW pixels for torch), ``rgb`` stays NHWC."""
+MineRL tasks expose a dict action space (keyboard keys, camera deltas, enum
+handlers like craft/place/equip); the agent sees a single Discrete menu built
+at construction time by enumerating the task's actionables — one entry per
+enum value, one per keyboard key (jump/sneak/sprint implying forward), four
+fixed ±15° camera moves. Observations are re-encoded against the global
+Minecraft item table (multi-hot inventories) or against the task's own item
+lists. Rides :class:`~sheeprl_tpu.envs.legacy.LegacyGymAdapter`; pixels stay
+NHWC (the reference transposes to CHW for torch).
+"""
 
 from __future__ import annotations
 
@@ -16,10 +21,10 @@ import copy
 from typing import Any, Dict, Optional, Tuple
 
 import gymnasium as gym
-import minerl
 import numpy as np
-from minerl.herobraine.hero import mc
+from minerl.herobraine.hero import mc, spaces as minerl_spaces
 
+from sheeprl_tpu.envs.legacy import LegacyGymAdapter, pixel_space
 from sheeprl_tpu.envs.minerl_envs.navigate import CustomNavigate
 from sheeprl_tpu.envs.minerl_envs.obtain import CustomObtainDiamond, CustomObtainIronPickaxe
 
@@ -30,6 +35,10 @@ CUSTOM_ENVS = {
 }
 
 N_ALL_ITEMS = len(mc.ALL_ITEMS)
+ITEM_ID_TO_NAME = dict(enumerate(mc.ALL_ITEMS))
+ITEM_NAME_TO_ID = {name: i for i, name in enumerate(mc.ALL_ITEMS)}
+
+# a full no-op command dict; menu entries overlay onto a copy of this
 NOOP = {
     "camera": (0, 0),
     "forward": 0,
@@ -46,11 +55,76 @@ NOOP = {
     "place": "none",
     "equip": "none",
 }
-ITEM_ID_TO_NAME = dict(enumerate(mc.ALL_ITEMS))
-ITEM_NAME_TO_ID = dict(zip(mc.ALL_ITEMS, range(N_ALL_ITEMS)))
+
+_CAMERA_MOVES = (
+    np.array([-15, 0]),
+    np.array([15, 0]),
+    np.array([0, -15]),
+    np.array([0, 15]),
+)
+_IMPLY_FORWARD = frozenset({"jump", "sneak", "sprint"})
 
 
-class MineRLWrapper(gym.Wrapper):
+def _build_action_menu(action_space: Any) -> Dict[int, Dict[str, Any]]:
+    """Flatten a MineRL dict action space into a Discrete menu.
+
+    Entry 0 is the no-op. Each actionable then contributes: every non-"none"
+    value for enum handlers, the four ±15° moves for the camera, or a single
+    key-press for keyboard commands — with jump/sneak/sprint also pressing
+    forward so the agent does not hop in place. Enum values are SORTED so the
+    menu numbering is deterministic across runs (the reference iterates a set
+    — hash-order — for the same values; the menu contents are identical)."""
+    menu: Dict[int, Dict[str, Any]] = {0: {}}
+    for name in action_space:
+        handler_space = action_space[name]
+        if isinstance(handler_space, minerl_spaces.Enum):
+            values = sorted(set(handler_space.values.tolist()) - {"none"})
+        elif name == "camera":
+            values = list(_CAMERA_MOVES)
+        else:
+            values = [1]
+        base = len(menu)
+        for offset, value in enumerate(values):
+            entry = {name: value}
+            if offset == 0 and name in _IMPLY_FORWARD:
+                entry["forward"] = 1
+            menu[base + offset] = entry
+    return menu
+
+
+class _HeldKeys:
+    """Keep attack/jump pressed for a few extra frames (the reference's
+    sticky actions, minerl.py:175-200). A held attack also suppresses jump;
+    a held jump keeps forward pressed."""
+
+    def __init__(self, attack_frames: int, jump_frames: int) -> None:
+        self.attack_frames = attack_frames
+        self.jump_frames = jump_frames
+        self.attack_left = 0
+        self.jump_left = 0
+
+    def reset(self) -> None:
+        self.attack_left = 0
+        self.jump_left = 0
+
+    def apply(self, command: Dict[str, Any]) -> None:
+        if self.attack_frames:
+            if command["attack"]:
+                self.attack_left = self.attack_frames
+            if self.attack_left > 0:
+                command["attack"] = 1
+                command["jump"] = 0
+                self.attack_left -= 1
+        if self.jump_frames:
+            if command["jump"]:
+                self.jump_left = self.jump_frames
+            if self.jump_left > 0:
+                command["jump"] = 1
+                command["forward"] = 1
+                self.jump_left -= 1
+
+
+class MineRLWrapper(LegacyGymAdapter):
     def __init__(
         self,
         id: str,
@@ -64,183 +138,138 @@ class MineRLWrapper(gym.Wrapper):
         multihot_inventory: bool = True,
         **kwargs: Any,
     ):
-        self._height = height
-        self._width = width
         self._pitch_limits = pitch_limits
-        self._sticky_attack = 0 if break_speed_multiplier > 1 else sticky_attack
-        self._sticky_jump = sticky_jump
-        self._sticky_attack_counter = 0
-        self._sticky_jump_counter = 0
-        self._break_speed_multiplier = break_speed_multiplier
-        self._multihot_inventory = multihot_inventory
+        self._multihot = multihot_inventory
+        # super-human break speed makes held attacks pointless
+        self._held = _HeldKeys(
+            attack_frames=0 if break_speed_multiplier > 1 else (sticky_attack or 0),
+            jump_frames=sticky_jump or 0,
+        )
         if "navigate" not in id.lower():
             kwargs.pop("extreme", None)
+        raw = CUSTOM_ENVS[id.lower()](break_speed=break_speed_multiplier, **kwargs).make()
 
-        env = CUSTOM_ENVS[id.lower()](break_speed=break_speed_multiplier, **kwargs).make()
-        super().__init__(env)
+        self.action_menu = _build_action_menu(raw.action_space)
+        obs_space, self._item_ids, self._equip_ids = self._build_obs_space(
+            raw.observation_space, height, width, multihot_inventory
+        )
+        super().__init__(
+            raw,
+            observation_space=obs_space,
+            action_space=gym.spaces.Discrete(len(self.action_menu)),
+            seed=seed,
+        )
+        self._camera = {"pitch": 0.0, "yaw": 0.0}
+        self._inventory_high = np.zeros(len(self._item_ids))
 
-        # one Discrete action per (actionable, value): enums contribute one
-        # action per value, keyboard actions one, the camera four +-15 deg
-        # pitch/yaw moves; jump/sneak/sprint imply forward (reference :103-138)
-        self.ACTIONS_MAP: Dict[int, Dict[str, Any]] = {0: {}}
-        act_idx = 1
-        for act in self.env.action_space:
-            if isinstance(self.env.action_space[act], minerl.herobraine.hero.spaces.Enum):
-                act_val = set(self.env.action_space[act].values.tolist()) - {"none"}
-                act_len = len(act_val)
-            elif act != "camera":
-                act_len = 1
-                act_val = [1]
-            else:
-                act_len = 4
-                act_val = [
-                    np.array([-15, 0]),
-                    np.array([15, 0]),
-                    np.array([0, -15]),
-                    np.array([0, 15]),
-                ]
-            action = dict(zip((np.arange(act_len) + act_idx).tolist(), [{act: v} for v in act_val]))
-            if act in {"jump", "sneak", "sprint"}:
-                action[act_idx]["forward"] = 1
-            self.ACTIONS_MAP.update(action)
-            act_idx += act_len
-
-        self.action_space = gym.spaces.Discrete(len(self.ACTIONS_MAP))
-
-        obs_space: Dict[str, gym.spaces.Space] = {
-            "rgb": gym.spaces.Box(0, 255, (height, width, 3), np.uint8),
-            "life_stats": gym.spaces.Box(0.0, np.array([20.0, 20.0, 300.0]), (3,), np.float32),
-            "inventory": (
-                gym.spaces.Box(0.0, np.inf, (N_ALL_ITEMS,), np.float32)
-                if multihot_inventory
-                else gym.spaces.Box(0.0, np.inf, (len(self.env.observation_space["inventory"]),), np.float32)
-            ),
-            "max_inventory": (
-                gym.spaces.Box(0.0, np.inf, (N_ALL_ITEMS,), np.float32)
-                if multihot_inventory
-                else gym.spaces.Box(0.0, np.inf, (len(self.env.observation_space["inventory"]),), np.float32)
-            ),
-        }
-        if "compass" in self.env.observation_space.spaces:
-            obs_space["compass"] = gym.spaces.Box(-180, 180, (1,), np.float32)
-        if "equipped_items" in self.env.observation_space.spaces:
-            obs_space["equipment"] = (
-                gym.spaces.Box(0.0, 1.0, (N_ALL_ITEMS,), np.int32)
-                if multihot_inventory
-                else gym.spaces.Box(
-                    0.0,
-                    1.0,
-                    (len(self.env.observation_space["equipped_items"]["mainhand"]["type"].values.tolist()),),
-                    np.int32,
-                )
-            )
-
-        if multihot_inventory:
-            self.inventory_item_to_id = ITEM_NAME_TO_ID
-            self.inventory_size = N_ALL_ITEMS
-            if "equipment" in obs_space:
-                self.equip_item_to_id = ITEM_NAME_TO_ID
-                self.equip_size = N_ALL_ITEMS
-        else:
-            self.inventory_size = obs_space["inventory"].shape[0]
-            self.inventory_item_to_id = dict(
-                zip(self.env.observation_space["inventory"], range(self.inventory_size))
-            )
-            if "equipment" in obs_space:
-                self.equip_size = obs_space["equipment"].shape[0]
-                self.equip_item_to_id = dict(
-                    zip(
-                        self.env.observation_space["equipped_items"]["mainhand"]["type"].values.tolist(),
-                        range(self.equip_size),
-                    )
-                )
-        self.observation_space = gym.spaces.Dict(obs_space)
-        self._pos = {"pitch": 0.0, "yaw": 0.0}
-        self._max_inventory = np.zeros(self.inventory_size)
-        self._render_mode = "rgb_array"
-        self.seed(seed=seed)
-
+    # keep reference-compatible aliases used by configs/tests
     @property
-    def render_mode(self) -> Optional[str]:
-        return self._render_mode
+    def ACTIONS_MAP(self) -> Dict[int, Dict[str, Any]]:
+        return self.action_menu
 
-    def __getattr__(self, name):
-        return getattr(self.env, name)
+    def __getattr__(self, name: str) -> Any:
+        if name == "raw":  # not yet bound during __init__
+            raise AttributeError(name)
+        return getattr(self.raw, name)
 
-    def _convert_actions(self, action: np.ndarray) -> Dict[str, Any]:
-        converted = copy.deepcopy(NOOP)
-        converted.update(self.ACTIONS_MAP[action.item()])
-        if self._sticky_attack:
-            if converted["attack"]:
-                self._sticky_attack_counter = self._sticky_attack
-            if self._sticky_attack_counter > 0:
-                converted["attack"] = 1
-                converted["jump"] = 0
-                self._sticky_attack_counter -= 1
-        if self._sticky_jump:
-            if converted["jump"]:
-                self._sticky_jump_counter = self._sticky_jump
-            if self._sticky_jump_counter > 0:
-                converted["jump"] = 1
-                converted["forward"] = 1
-                self._sticky_jump_counter -= 1
-        return converted
+    @staticmethod
+    def _build_obs_space(
+        raw_space: Any, height: int, width: int, multihot: bool
+    ) -> Tuple[gym.spaces.Dict, Dict[str, int], Optional[Dict[str, int]]]:
+        """Build the Dict obs space plus the item→index tables.
 
-    def _convert_equipment(self, equipment: Dict[str, Any]) -> np.ndarray:
-        equip = np.zeros(self.equip_size, dtype=np.int32)
-        try:
-            equip[self.equip_item_to_id[equipment["mainhand"]["type"]]] = 1
-        except KeyError:
-            equip[self.equip_item_to_id["air"]] = 1
-        return equip
+        Multi-hot mode indexes inventories/equipment by the global Minecraft
+        item table; otherwise by the task's own declared item lists."""
+        if multihot:
+            item_ids: Dict[str, int] = ITEM_NAME_TO_ID
+            inv_size = N_ALL_ITEMS
+        else:
+            names = list(raw_space["inventory"])
+            item_ids = {name: i for i, name in enumerate(names)}
+            inv_size = len(names)
 
-    def _convert_inventory(self, inventory: Dict[str, Any]) -> Dict[str, np.ndarray]:
-        counts = np.zeros(self.inventory_size)
-        for item, quantity in inventory.items():
-            counts[self.inventory_item_to_id[item]] += 1 if item == "air" else quantity
-        self._max_inventory = np.maximum(counts, self._max_inventory)
-        return {"inventory": counts, "max_inventory": self._max_inventory.copy()}
-
-    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
-        converted = {
-            "rgb": obs["pov"].copy(),  # NHWC (the reference transposes to CHW)
-            "life_stats": np.array(
-                [obs["life_stats"]["life"], obs["life_stats"]["food"], obs["life_stats"]["air"]],
-                dtype=np.float32,
-            ),
-            **self._convert_inventory(obs["inventory"]),
+        entries: Dict[str, gym.spaces.Space] = {
+            "rgb": pixel_space(height, width, 3),
+            "life_stats": gym.spaces.Box(0.0, np.array([20.0, 20.0, 300.0]), (3,), np.float32),
+            "inventory": gym.spaces.Box(0.0, np.inf, (inv_size,), np.float32),
+            "max_inventory": gym.spaces.Box(0.0, np.inf, (inv_size,), np.float32),
         }
-        if "equipment" in self.observation_space.spaces:
-            converted["equipment"] = self._convert_equipment(obs["equipped_items"])
-        if "compass" in self.observation_space.spaces:
-            converted["compass"] = obs["compass"]["angle"].reshape(-1)
-        return converted
+        if "compass" in raw_space.spaces:
+            entries["compass"] = gym.spaces.Box(-180, 180, (1,), np.float32)
 
-    def seed(self, seed: Optional[int] = None) -> None:
-        self.observation_space.seed(seed)
-        self.action_space.seed(seed)
+        equip_ids: Optional[Dict[str, int]] = None
+        if "equipped_items" in raw_space.spaces:
+            if multihot:
+                equip_ids = ITEM_NAME_TO_ID
+                equip_size = N_ALL_ITEMS
+            else:
+                equippable = raw_space["equipped_items"]["mainhand"]["type"].values.tolist()
+                equip_ids = {name: i for i, name in enumerate(equippable)}
+                equip_size = len(equippable)
+            entries["equipment"] = gym.spaces.Box(0.0, 1.0, (equip_size,), np.int32)
+        return gym.spaces.Dict(entries), item_ids, equip_ids
 
-    def step(self, actions: np.ndarray) -> Tuple[Dict[str, Any], float, bool, bool, Dict[str, Any]]:
-        converted = self._convert_actions(actions)
-        next_pitch = self._pos["pitch"] + converted["camera"][0]
-        next_yaw = ((self._pos["yaw"] + converted["camera"][1]) + 180) % 360 - 180
+    # ----------------------------------------------------------------- action
+    def _translate_action(self, action: np.ndarray) -> Dict[str, Any]:
+        command = copy.deepcopy(NOOP)
+        command.update(self.action_menu[int(np.asarray(action).reshape(()).item())])
+        self._held.apply(command)
+        # camera clamping: refuse pitch moves that would leave the limits,
+        # track yaw wrapped to (-180, 180]
+        d_pitch, d_yaw = command["camera"]
+        next_pitch = self._camera["pitch"] + d_pitch
         if not (self._pitch_limits[0] <= next_pitch <= self._pitch_limits[1]):
-            converted["camera"] = np.array([0, converted["camera"][1]])
-            next_pitch = self._pos["pitch"]
+            command["camera"] = np.array([0, d_yaw])
+            next_pitch = self._camera["pitch"]
+        self._pending_camera = {
+            "pitch": next_pitch,
+            "yaw": (self._camera["yaw"] + d_yaw + 180) % 360 - 180,
+        }
+        return command
 
-        obs, reward, done, info = self.env.step(converted)
-        self._pos = {"pitch": next_pitch, "yaw": next_yaw}
-        return self._convert_obs(obs), reward, done, False, info
+    # ------------------------------------------------------------ observation
+    def _pack_observation(self, raw_obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        stats = raw_obs["life_stats"]
+        counts = np.zeros(len(self._item_ids))
+        for item, qty in raw_obs["inventory"].items():
+            counts[self._item_ids[item]] += 1 if item == "air" else qty
+        self._inventory_high = np.maximum(counts, self._inventory_high)
+        packed = {
+            "rgb": raw_obs["pov"].copy(),  # NHWC
+            "life_stats": np.array([stats["life"], stats["food"], stats["air"]], np.float32),
+            "inventory": counts,
+            "max_inventory": self._inventory_high.copy(),
+        }
+        if self._equip_ids is not None and "equipment" in self.observation_space.spaces:
+            onehot = np.zeros(len(self._equip_ids), np.int32)
+            held = raw_obs["equipped_items"]["mainhand"]["type"]
+            onehot[self._equip_ids.get(held, self._equip_ids["air"])] = 1
+            packed["equipment"] = onehot
+        if "compass" in self.observation_space.spaces:
+            packed["compass"] = raw_obs["compass"]["angle"].reshape(-1)
+        return packed
+
+    # -------------------------------------------------------------- lifecycle
+    def step(self, action: np.ndarray) -> Tuple[Dict[str, Any], float, bool, bool, Dict[str, Any]]:
+        command = self._translate_action(action)
+        raw_obs, reward, done, info = self.raw.step(command)
+        self._camera = self._pending_camera
+        # the time limit lives in the gym wrapper stack, so done is always a
+        # true termination here
+        return self._pack_observation(raw_obs), float(reward), bool(done), False, info
 
     def reset(
         self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
     ) -> Tuple[Any, Dict[str, Any]]:
-        obs = self.env.reset()
-        self._max_inventory = np.zeros(self.inventory_size)
-        self._sticky_attack_counter = 0
-        self._sticky_jump_counter = 0
-        self._pos = {"pitch": 0.0, "yaw": 0.0}
-        return self._convert_obs(obs), {}
+        raw_obs = self.raw.reset()
+        self._camera = {"pitch": 0.0, "yaw": 0.0}
+        self._held.reset()
+        self._inventory_high = np.zeros(len(self._item_ids))
+        return self._pack_observation(raw_obs), {}
 
-    def render(self, mode: Optional[str] = "rgb_array"):
-        return self.env.render(self.render_mode)
+    def render(self) -> Any:
+        return self.raw.render(self.render_mode)
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)

@@ -1,5 +1,14 @@
-"""Custom MineRL env-spec base (reference: sheeprl/envs/minerl_envs/backend.py,
-itself adapted from github.com/minerllabs/minerl)."""
+"""Base spec for the custom MineRL tasks (behavioral parity:
+sheeprl/envs/minerl_envs/backend.py, in turn derived from
+github.com/minerllabs/minerl).
+
+``minerl.herobraine.env_spec.EnvSpec`` is a template-method API: each task
+overrides a fixed set of ``create_*`` factories. Rather than re-implementing
+every factory in every task (the upstream pattern), the shared server-side
+plumbing lives here once, driven by declarative class knobs
+(``world_time``, ``time_passes``, ``weather``, ``spawning`` …) that concrete
+tasks simply override.
+"""
 
 from __future__ import annotations
 
@@ -9,53 +18,87 @@ if not _IS_MINERL_AVAILABLE:
     raise ModuleNotFoundError("minerl==0.4.4 is not installed; install it to use the MineRL environments")
 
 from abc import ABC
-from typing import List
+from typing import Any, List, Optional
 
 from minerl.herobraine.env_spec import EnvSpec
 from minerl.herobraine.hero import handler, handlers
-from minerl.herobraine.hero.handlers.translation import TranslationHandler
 from minerl.herobraine.hero.mc import INVERSE_KEYMAP
 
+# movement/combat keys every custom task exposes
 SIMPLE_KEYBOARD_ACTION = ["forward", "back", "left", "right", "jump", "sneak", "sprint", "attack"]
 
 
+class BreakSpeedMultiplier(handler.Handler):
+    """Mission-XML handler scaling block-breaking speed (after
+    danijar/diamond_env)."""
+
+    def __init__(self, multiplier: float = 1.0) -> None:
+        self.multiplier = multiplier
+
+    def to_string(self) -> str:
+        return f"break_speed({self.multiplier})"
+
+    def xml_template(self) -> str:
+        return "<BreakSpeedMultiplier>{{multiplier}}</BreakSpeedMultiplier>"
+
+
 class CustomSimpleEmbodimentEnvSpec(EnvSpec, ABC):
-    """Base spec with POV/location/life-stats observables, basic keyboard +
-    camera actions, and a block break-speed multiplier."""
+    """POV + location + life-stats observables, keyboard + camera actions,
+    and table-driven server conditions (see class attributes)."""
+
+    # server-side knobs, overridden per task
+    world_time: int = 6000
+    time_passes: bool = True
+    weather: Optional[str] = None
+    spawning: Any = True  # passed through to SpawningInitialCondition verbatim
 
     def __init__(self, name, *args, resolution=(64, 64), break_speed: int = 100, **kwargs):
         self.resolution = resolution
         self.break_speed = break_speed
         super().__init__(name, *args, **kwargs)
 
-    def create_agent_start(self):
+    # ------------------------------------------------------------ agent side
+    def create_agent_start(self) -> List[handler.Handler]:
         return [BreakSpeedMultiplier(self.break_speed)]
 
-    def create_observables(self) -> List[TranslationHandler]:
+    def create_observables(self) -> List[handler.Handler]:
         return [
             handlers.POVObservation(self.resolution),
             handlers.ObservationFromCurrentLocation(),
             handlers.ObservationFromLifeStats(),
         ]
 
-    def create_actionables(self) -> List[TranslationHandler]:
-        return [
-            handlers.KeybasedCommandAction(k, v) for k, v in INVERSE_KEYMAP.items() if k in SIMPLE_KEYBOARD_ACTION
-        ] + [handlers.CameraAction()]
+    def create_actionables(self) -> List[handler.Handler]:
+        # iterate INVERSE_KEYMAP (not SIMPLE_KEYBOARD_ACTION) so handler
+        # registration order — and therefore the wrapper's Discrete action
+        # numbering — matches upstream minerl exactly
+        keyboard = [
+            handlers.KeybasedCommandAction(key, keycode)
+            for key, keycode in INVERSE_KEYMAP.items()
+            if key in SIMPLE_KEYBOARD_ACTION
+        ]
+        return keyboard + [handlers.CameraAction()]
 
-    def create_monitors(self) -> List[TranslationHandler]:
+    def create_monitors(self) -> List[handler.Handler]:
         return []
 
+    # ----------------------------------------------------------- server side
+    def create_server_initial_conditions(self) -> List[handler.Handler]:
+        conditions: List[handler.Handler] = [
+            handlers.TimeInitialCondition(
+                allow_passage_of_time=self.time_passes, start_time=self.world_time
+            )
+        ]
+        if self.weather is not None:
+            conditions.append(handlers.WeatherInitialCondition(self.weather))
+        conditions.append(handlers.SpawningInitialCondition(self.spawning))
+        return conditions
 
-class BreakSpeedMultiplier(handler.Handler):
-    """Malmo mission handler raising the block-breaking speed (adapted from
-    github.com/danijar/diamond_env via the reference)."""
+    def create_server_quit_producers(self) -> List[handler.Handler]:
+        return [handlers.ServerQuitWhenAnyAgentFinishes()]
 
-    def __init__(self, multiplier=1.0):
-        self.multiplier = multiplier
+    def create_server_world_generators(self) -> List[handler.Handler]:
+        return [handlers.DefaultWorldGenerator(force_reset=True)]
 
-    def to_string(self):
-        return f"break_speed({self.multiplier})"
-
-    def xml_template(self):
-        return "<BreakSpeedMultiplier>{{multiplier}}</BreakSpeedMultiplier>"
+    def create_server_decorators(self) -> List[handler.Handler]:
+        return []

@@ -1,5 +1,11 @@
-"""Custom MineRL Navigate spec (reference: sheeprl/envs/minerl_envs/navigate.py,
-adapted from github.com/minerllabs/minerl)."""
+"""Custom MineRL Navigate task (behavioral parity:
+sheeprl/envs/minerl_envs/navigate.py, derived from minerllabs/minerl).
+
+Reach a diamond block buried near a randomized compass target: +100 on
+touch, optional per-block dense shaping. Server-side world conditions come
+from the declarative knobs on the base spec; only the task-specific
+handlers live here.
+"""
 
 from __future__ import annotations
 
@@ -17,22 +23,47 @@ from sheeprl_tpu.envs.minerl_envs.backend import CustomSimpleEmbodimentEnvSpec
 
 NAVIGATE_STEPS = 6000
 
+_TARGET_BLOCK = "diamond_block"
+_TOUCH_REWARD = 100.0
+_DENSE_REWARD_PER_BLOCK = 1.0
+# compass target placement (the upstream task's randomization envelope)
+_PLACEMENT = dict(
+    max_randomized_radius=64,
+    min_randomized_radius=64,
+    block=_TARGET_BLOCK,
+    placement="surface",
+    max_radius=8,
+    min_radius=0,
+    max_randomized_distance=8,
+    min_randomized_distance=0,
+    randomize_compass_location=True,
+)
+
+_MOUNTAIN_BIOME = 3  # "extreme hills"
+
 
 class CustomNavigate(CustomSimpleEmbodimentEnvSpec):
-    """Reach the diamond block guided by a compass; +100 sparse reward (plus
-    per-block shaping when ``dense``)."""
+    # frozen world clock at noon, clear skies, no mob spawning
+    time_passes = False
+    weather = "clear"
+    spawning = "false"
 
     def __init__(self, dense, extreme, *args, **kwargs):
-        suffix = ("Extreme" if extreme else "") + ("Dense" if dense else "")
-        self.dense, self.extreme = dense, extreme
-        # the time limit is enforced by the gym wrapper so truncation can be
-        # told apart from termination
+        self.dense = dense
+        self.extreme = extreme
+        variant = ("Extreme" if extreme else "") + ("Dense" if dense else "")
+        # the episode step cap belongs to the gym wrapper, where a cutoff is
+        # reported as truncation instead of termination
         kwargs.pop("max_episode_steps", None)
-        super().__init__(f"CustomMineRLNavigate{suffix}-v0", *args, max_episode_steps=None, **kwargs)
+        super().__init__(f"CustomMineRLNavigate{variant}-v0", *args, max_episode_steps=None, **kwargs)
 
     def is_from_folder(self, folder: str) -> bool:
         return folder == ("navigateextreme" if self.extreme else "navigate")
 
+    def get_docstring(self) -> str:
+        return "Navigate to the diamond block marked by the compass target."
+
+    # ------------------------------------------------------------ agent side
     def create_observables(self) -> List[Handler]:
         return super().create_observables() + [
             handlers.CompassObservation(angle=True, distance=False),
@@ -40,61 +71,38 @@ class CustomNavigate(CustomSimpleEmbodimentEnvSpec):
         ]
 
     def create_actionables(self) -> List[Handler]:
-        return super().create_actionables() + [
-            handlers.PlaceBlock(["none", "dirt"], _other="none", _default="none")
-        ]
-
-    def create_rewardables(self) -> List[Handler]:
-        rewards: List[Handler] = [
-            handlers.RewardForTouchingBlockType(
-                [{"type": "diamond_block", "behaviour": "onceOnly", "reward": 100.0}]
-            )
-        ]
-        if self.dense:
-            rewards.append(handlers.RewardForDistanceTraveledToCompassTarget(reward_per_block=1.0))
-        return rewards
+        place_dirt = handlers.PlaceBlock(["none", "dirt"], _other="none", _default="none")
+        return super().create_actionables() + [place_dirt]
 
     def create_agent_start(self) -> List[Handler]:
-        return super().create_agent_start() + [
-            handlers.SimpleInventoryAgentStart([dict(type="compass", quantity="1")])
-        ]
+        compass = handlers.SimpleInventoryAgentStart([dict(type="compass", quantity="1")])
+        return super().create_agent_start() + [compass]
 
     def create_agent_handlers(self) -> List[Handler]:
-        return [handlers.AgentQuitFromTouchingBlockType(["diamond_block"])]
+        return [handlers.AgentQuitFromTouchingBlockType([_TARGET_BLOCK])]
 
+    def create_rewardables(self) -> List[Handler]:
+        on_touch = handlers.RewardForTouchingBlockType(
+            [dict(type=_TARGET_BLOCK, behaviour="onceOnly", reward=_TOUCH_REWARD)]
+        )
+        shaped: List[Handler] = [on_touch]
+        if self.dense:
+            shaped.append(
+                handlers.RewardForDistanceTraveledToCompassTarget(
+                    reward_per_block=_DENSE_REWARD_PER_BLOCK
+                )
+            )
+        return shaped
+
+    # ----------------------------------------------------------- server side
     def create_server_world_generators(self) -> List[Handler]:
         if self.extreme:
-            return [handlers.BiomeGenerator(biome=3, force_reset=True)]
-        return [handlers.DefaultWorldGenerator(force_reset=True)]
-
-    def create_server_quit_producers(self) -> List[Handler]:
-        return [handlers.ServerQuitWhenAnyAgentFinishes()]
+            return [handlers.BiomeGenerator(biome=_MOUNTAIN_BIOME, force_reset=True)]
+        return super().create_server_world_generators()
 
     def create_server_decorators(self) -> List[Handler]:
-        return [
-            handlers.NavigationDecorator(
-                max_randomized_radius=64,
-                min_randomized_radius=64,
-                block="diamond_block",
-                placement="surface",
-                max_radius=8,
-                min_radius=0,
-                max_randomized_distance=8,
-                min_randomized_distance=0,
-                randomize_compass_location=True,
-            )
-        ]
-
-    def create_server_initial_conditions(self) -> List[Handler]:
-        return [
-            handlers.TimeInitialCondition(allow_passage_of_time=False, start_time=6000),
-            handlers.WeatherInitialCondition("clear"),
-            handlers.SpawningInitialCondition("false"),
-        ]
-
-    def get_docstring(self):
-        return "Navigate to the diamond block marked by the compass target."
+        return [handlers.NavigationDecorator(**_PLACEMENT)]
 
     def determine_success_from_rewards(self, rewards: list) -> bool:
-        threshold = 100.0 + (60 if self.dense else 0)
-        return sum(rewards) >= threshold
+        needed = _TOUCH_REWARD + (60 if self.dense else 0)
+        return sum(rewards) >= needed

@@ -1,5 +1,11 @@
-"""Custom MineRL Obtain specs (reference: sheeprl/envs/minerl_envs/obtain.py,
-adapted from github.com/minerllabs/minerl)."""
+"""Custom MineRL Obtain tasks (behavioral parity:
+sheeprl/envs/minerl_envs/obtain.py, derived from minerllabs/minerl).
+
+Tool-progression tasks on a fresh survival world: the agent is rewarded
+along the wood → stone → iron item hierarchy toward a target item. The item
+lists and the reward ladder are declarative tables; the spec methods just
+wire them into minerl handlers.
+"""
 
 from __future__ import annotations
 
@@ -18,6 +24,24 @@ from sheeprl_tpu.envs.minerl_envs.backend import CustomSimpleEmbodimentEnvSpec
 none = "none"
 other = "other"
 
+# ---------------------------------------------------------------- item tables
+# observable inventory slots
+_TRACKED_ITEMS = [
+    "dirt", "coal", "torch", "log", "planks", "stick", "crafting_table",
+    "wooden_axe", "wooden_pickaxe", "stone", "cobblestone", "furnace",
+    "stone_axe", "stone_pickaxe", "iron_ore", "iron_ingot", "iron_axe",
+    "iron_pickaxe",
+]
+_TOOLS = [
+    "wooden_axe", "wooden_pickaxe", "stone_axe", "stone_pickaxe", "iron_axe",
+    "iron_pickaxe",
+]
+_PLACEABLE = [none, "dirt", "stone", "cobblestone", "crafting_table", "furnace", "torch"]
+_HAND_CRAFTABLE = [none, "torch", "stick", "planks", "crafting_table"]
+_TABLE_CRAFTABLE = [none] + _TOOLS + ["furnace"]
+_SMELTABLE = [none, "iron_ingot", "coal"]
+
+# the tool-progression reward ladder (doubles at every tier)
 _OBTAIN_REWARD_SCHEDULE = [
     dict(type="log", amount=1, reward=1),
     dict(type="planks", amount=1, reward=2),
@@ -33,18 +57,22 @@ _OBTAIN_REWARD_SCHEDULE = [
 ]
 
 
-def _snake_to_camel(word: str) -> str:
-    return "".join(x.capitalize() or "_" for x in word.split("_"))
+def _camel(name: str) -> str:
+    return "".join(part.capitalize() or "_" for part in name.split("_"))
 
 
 class CustomObtain(CustomSimpleEmbodimentEnvSpec):
-    """Item-hierarchy task: the agent is rewarded along the tool progression
-    toward ``target_item`` (dense = every collection, sparse = first only)."""
+    """Shared machinery of the obtain tasks; concrete tasks pick the target
+    item, the reward ladder and the quit condition."""
+
+    # survival defaults: day cycle runs, mobs spawn
+    time_passes = True
+    spawning = True
 
     def __init__(
         self,
-        target_item,
-        dense,
+        target_item: str,
+        dense: bool,
         reward_schedule: List[Dict[str, Union[str, int, float]]],
         *args,
         max_episode_steps=None,
@@ -53,129 +81,65 @@ class CustomObtain(CustomSimpleEmbodimentEnvSpec):
         self.target_item = target_item
         self.dense = dense
         self.reward_schedule = reward_schedule
-        suffix = _snake_to_camel(target_item) + ("Dense" if dense else "")
+        variant = _camel(target_item) + ("Dense" if dense else "")
         super().__init__(
-            *args, name=f"CustomMineRLObtain{suffix}-v0", max_episode_steps=max_episode_steps, **kwargs
+            *args, name=f"CustomMineRLObtain{variant}-v0", max_episode_steps=max_episode_steps, **kwargs
         )
 
+    def is_from_folder(self, folder: str) -> bool:
+        return folder == f"o_{self.target_item}"
+
+    def get_docstring(self) -> str:
+        return f"Obtain {self.target_item} through the item hierarchy."
+
+    # ------------------------------------------------------------ agent side
     def create_observables(self) -> List[Handler]:
         return super().create_observables() + [
-            handlers.FlatInventoryObservation(
-                [
-                    "dirt",
-                    "coal",
-                    "torch",
-                    "log",
-                    "planks",
-                    "stick",
-                    "crafting_table",
-                    "wooden_axe",
-                    "wooden_pickaxe",
-                    "stone",
-                    "cobblestone",
-                    "furnace",
-                    "stone_axe",
-                    "stone_pickaxe",
-                    "iron_ore",
-                    "iron_ingot",
-                    "iron_axe",
-                    "iron_pickaxe",
-                ]
-            ),
+            handlers.FlatInventoryObservation(list(_TRACKED_ITEMS)),
             handlers.EquippedItemObservation(
-                items=[
-                    "air",
-                    "wooden_axe",
-                    "wooden_pickaxe",
-                    "stone_axe",
-                    "stone_pickaxe",
-                    "iron_axe",
-                    "iron_pickaxe",
-                    other,
-                ],
-                _default="air",
-                _other=other,
+                items=["air"] + _TOOLS + [other], _default="air", _other=other
             ),
         ]
 
-    def create_actionables(self):
+    def create_actionables(self) -> List[Handler]:
+        def enum(handler_cls, values):
+            return handler_cls(list(values), _other=none, _default=none)
+
         return super().create_actionables() + [
-            handlers.PlaceBlock(
-                [none, "dirt", "stone", "cobblestone", "crafting_table", "furnace", "torch"],
-                _other=none,
-                _default=none,
-            ),
-            handlers.EquipAction(
-                [none, "air", "wooden_axe", "wooden_pickaxe", "stone_axe", "stone_pickaxe", "iron_axe", "iron_pickaxe"],
-                _other=none,
-                _default=none,
-            ),
-            handlers.CraftAction([none, "torch", "stick", "planks", "crafting_table"], _other=none, _default=none),
-            handlers.CraftNearbyAction(
-                [
-                    none,
-                    "wooden_axe",
-                    "wooden_pickaxe",
-                    "stone_axe",
-                    "stone_pickaxe",
-                    "iron_axe",
-                    "iron_pickaxe",
-                    "furnace",
-                ],
-                _other=none,
-                _default=none,
-            ),
-            handlers.SmeltItemNearby([none, "iron_ingot", "coal"], _other=none, _default=none),
+            enum(handlers.PlaceBlock, _PLACEABLE),
+            handlers.EquipAction([none, "air"] + _TOOLS, _other=none, _default=none),
+            enum(handlers.CraftAction, _HAND_CRAFTABLE),
+            enum(handlers.CraftNearbyAction, _TABLE_CRAFTABLE),
+            enum(handlers.SmeltItemNearby, _SMELTABLE),
         ]
 
     def create_rewardables(self) -> List[Handler]:
-        reward_handler = handlers.RewardForCollectingItems if self.dense else handlers.RewardForCollectingItemsOnce
-        return [reward_handler(self.reward_schedule if self.reward_schedule else {self.target_item: 1})]
-
-    def create_agent_start(self) -> List[Handler]:
-        return super().create_agent_start()
+        ladder = self.reward_schedule if self.reward_schedule else {self.target_item: 1}
+        once = not self.dense  # dense pays on every collection, sparse once
+        cls = handlers.RewardForCollectingItemsOnce if once else handlers.RewardForCollectingItems
+        return [cls(ladder)]
 
     def create_agent_handlers(self) -> List[Handler]:
         return [handlers.AgentQuitFromPossessingItem([dict(type="diamond", amount=1)])]
 
-    def create_server_world_generators(self) -> List[Handler]:
-        return [handlers.DefaultWorldGenerator(force_reset=True)]
-
-    def create_server_quit_producers(self) -> List[Handler]:
-        return [handlers.ServerQuitWhenAnyAgentFinishes()]
-
-    def create_server_decorators(self) -> List[Handler]:
-        return []
-
-    def create_server_initial_conditions(self) -> List[Handler]:
-        return [
-            handlers.TimeInitialCondition(start_time=6000, allow_passage_of_time=True),
-            handlers.SpawningInitialCondition(allow_spawning=True),
-        ]
-
-    def is_from_folder(self, folder: str):
-        return folder == f"o_{self.target_item}"
-
-    def get_docstring(self):
-        return f"Obtain {self.target_item} through the item hierarchy."
-
     def determine_success_from_rewards(self, rewards: list) -> bool:
-        rewards = set(rewards)
-        max_missing = round(len(self.reward_schedule) * 0.1)
-        reward_values = [s["reward"] for s in self.reward_schedule]
-        return len(rewards.intersection(reward_values)) >= len(reward_values) - max_missing
+        # success = hitting (almost) every rung of the ladder; 10% slack
+        ladder_values = [rung["reward"] for rung in self.reward_schedule]
+        slack = round(len(self.reward_schedule) * 0.1)
+        hit = set(rewards).intersection(ladder_values)
+        return len(hit) >= len(ladder_values) - slack
 
 
 class CustomObtainDiamond(CustomObtain):
     def __init__(self, dense, *args, **kwargs):
-        # the time limit is enforced by the gym wrapper (truncation vs
-        # termination must stay distinguishable)
+        # the step cap lives in the gym wrapper (truncation vs termination)
         kwargs.pop("max_episode_steps", None)
+        diamond_ladder = _OBTAIN_REWARD_SCHEDULE + [dict(type="diamond", amount=1, reward=1024)]
         super().__init__(
             *args,
             target_item="diamond",
             dense=dense,
-            reward_schedule=_OBTAIN_REWARD_SCHEDULE + [dict(type="diamond", amount=1, reward=1024)],
+            reward_schedule=diamond_ladder,
             max_episode_steps=None,
             **kwargs,
         )
@@ -183,7 +147,7 @@ class CustomObtainDiamond(CustomObtain):
     def is_from_folder(self, folder: str) -> bool:
         return folder == "o_dia"
 
-    def get_docstring(self):
+    def get_docstring(self) -> str:
         return "Obtain a diamond from scratch on a random survival map."
 
 
@@ -199,11 +163,11 @@ class CustomObtainIronPickaxe(CustomObtain):
             **kwargs,
         )
 
-    def create_agent_handlers(self):
+    def create_agent_handlers(self) -> List[Handler]:
         return [handlers.AgentQuitFromCraftingItem([dict(type="iron_pickaxe", amount=1)])]
 
     def is_from_folder(self, folder: str) -> bool:
         return folder == "o_iron"
 
-    def get_docstring(self):
+    def get_docstring(self) -> str:
         return "Craft an iron pickaxe from scratch on a random survival map."

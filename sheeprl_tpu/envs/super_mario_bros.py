@@ -1,7 +1,11 @@
-"""Super Mario Bros adapter (reference: sheeprl/envs/super_mario_bros.py).
+"""Super Mario Bros adapter (behavioral parity: sheeprl/envs/super_mario_bros.py).
 
-Wraps the nes-py gym env into gymnasium with a ``rgb`` Dict observation and a
-configurable joypad action set."""
+gym-super-mario-bros is a nes-py emulator env with the old gym API; the
+shared :class:`~sheeprl_tpu.envs.legacy.LegacyGymAdapter` supplies the
+gymnasium contract, and this file contributes the NES specifics: the joypad
+button-combo menu the agent picks from, and reading the in-game clock to
+tell a timeout death from a real one.
+"""
 
 from __future__ import annotations
 
@@ -12,63 +16,57 @@ if not _IS_SUPER_MARIO_AVAILABLE:
         "gym-super-mario-bros is not installed; install it to use the Super Mario environments"
     )
 
-from typing import Any, Dict, Optional, Tuple, Union
+from typing import Any, Dict, Optional, Tuple
 
-import gym_super_mario_bros as gsmb
-import gymnasium as gym
+import gym_super_mario_bros
 import numpy as np
-from gym_super_mario_bros.actions import COMPLEX_MOVEMENT, RIGHT_ONLY, SIMPLE_MOVEMENT
+from gym_super_mario_bros import actions as joypad_menus
+from gymnasium import spaces
 from nes_py.wrappers import JoypadSpace
 
-ACTIONS_SPACE_MAP = {"simple": SIMPLE_MOVEMENT, "right_only": RIGHT_ONLY, "complex": COMPLEX_MOVEMENT}
+from sheeprl_tpu.envs.legacy import LegacyGymAdapter, box_like, scalar_action
+
+# button-combo menus shipped by gym-super-mario-bros
+ACTIONS_SPACE_MAP = {
+    "right_only": joypad_menus.RIGHT_ONLY,
+    "simple": joypad_menus.SIMPLE_MOVEMENT,
+    "complex": joypad_menus.COMPLEX_MOVEMENT,
+}
 
 
-class _JoypadSpaceResetCompat(JoypadSpace):
-    """nes-py's JoypadSpace swallows reset kwargs; forward them."""
-
-    def reset(self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
-        return self.env.reset(seed=seed, options=options)
-
-
-class SuperMarioBrosWrapper(gym.Wrapper):
+class SuperMarioBrosWrapper(LegacyGymAdapter):
     def __init__(self, id: str, action_space: str = "simple", render_mode: str = "rgb_array"):
-        env = gsmb.make(id)
-        env = _JoypadSpaceResetCompat(env, ACTIONS_SPACE_MAP[action_space])
-        super().__init__(env)
-        self._render_mode = render_mode
-        self.observation_space = gym.spaces.Dict(
-            {
-                "rgb": gym.spaces.Box(
-                    env.observation_space.low,
-                    env.observation_space.high,
-                    env.observation_space.shape,
-                    env.observation_space.dtype,
-                )
-            }
+        menu = ACTIONS_SPACE_MAP[action_space]
+        raw = JoypadSpace(gym_super_mario_bros.make(id), menu)
+        super().__init__(
+            raw,
+            observation_space=spaces.Dict({"rgb": box_like(raw.observation_space)}),
+            action_space=spaces.Discrete(len(menu)),
+            render_mode=render_mode,
         )
-        self.action_space = gym.spaces.Discrete(env.action_space.n)
 
-    @property
-    def render_mode(self) -> str:
-        return self._render_mode
+    def _pack_observation(self, raw_obs: Any) -> Dict[str, np.ndarray]:
+        return {"rgb": np.asarray(raw_obs).copy()}
 
-    @render_mode.setter
-    def render_mode(self, render_mode: str):
-        self._render_mode = render_mode
+    def _translate_action(self, action: Any) -> Any:
+        return scalar_action(action)
 
-    def step(self, action: Union[np.ndarray, int]) -> Tuple[Any, float, bool, bool, Dict[str, Any]]:
-        if isinstance(action, np.ndarray):
-            action = action.squeeze().item()
-        obs, reward, done, info = self.env.step(action)
-        is_timelimit = info.get("time", False)
-        return {"rgb": obs.copy()}, reward, done and not is_timelimit, done and is_timelimit, info
+    def _end_of_episode(self, done: bool, info: Dict[str, Any]) -> Tuple[bool, bool]:
+        # reference parity (sheeprl/envs/super_mario_bros.py): an episode
+        # ending with a NONZERO in-game clock reports as truncated, one with
+        # the clock at zero as terminated
+        clock_running = bool(info.get("time", False))
+        return done and not clock_running, done and clock_running
 
-    def render(self):
-        frame = self.env.render(mode=self.render_mode)
+    def reset(
+        self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
+    ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        # bypass JoypadSpace.reset: nes-py swallows the seed/options kwargs
+        raw_obs = self.raw.env.reset(seed=seed, options=options)
+        return self._pack_observation(raw_obs), {}
+
+    def render(self) -> Any:
+        frame = self.raw.render(mode=self.render_mode)
         if self.render_mode == "rgb_array" and frame is not None:
             return frame.copy()
         return None
-
-    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
-        obs = self.env.reset(seed=seed, options=options)
-        return {"rgb": obs.copy()}, {}
