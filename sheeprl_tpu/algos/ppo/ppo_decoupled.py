@@ -61,13 +61,14 @@ def _trainer_devices():
     return devs
 
 
-def _ckpt_schedule(cfg, num_updates, policy_steps_per_update):
+def _ckpt_schedule(cfg, num_updates, policy_steps_per_update, start_update=1, last_checkpoint=0):
     """The (deterministic) set of updates that checkpoint — shared by both
-    roles so the opt-state shipping lines up."""
+    roles so the opt-state shipping lines up. On resume the walk restarts
+    from the checkpointed update with the saved step accounting."""
     do = set()
-    last = 0
-    step = 0
-    for update in range(1, num_updates + 1):
+    last = last_checkpoint
+    step = (start_update - 1) * policy_steps_per_update
+    for update in range(start_update, num_updates + 1):
         step += policy_steps_per_update
         if (cfg.checkpoint.every > 0 and step - last >= cfg.checkpoint.every) or (
             update == num_updates and cfg.checkpoint.save_last
@@ -84,12 +85,13 @@ def main(fabric, cfg: Dict[str, Any]):
             "ppo_decoupled requires at least 2 processes: one player and one or more trainers "
             "(reference ppo_decoupled.py:627-631)"
         )
-    if cfg.checkpoint.resume_from:
-        raise ValueError("resume is not supported by the decoupled PPO (reference parity)")
+    # every process reads the checkpoint itself (reference
+    # ppo_decoupled.py:45-46,104-116: both roles restore from the same file)
+    state = fabric.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
     if jax.process_index() == 0:
-        _player(fabric, cfg)
+        _player(fabric, cfg, state)
     else:
-        _trainer(fabric, cfg)
+        _trainer(fabric, cfg, state)
 
 
 def _common_setup(fabric, cfg):
@@ -107,7 +109,7 @@ def _common_setup(fabric, cfg):
     return num_envs, rollout_steps, trainer_devs, n_global, policy_steps_per_update, num_updates
 
 
-def _player(fabric, cfg):
+def _player(fabric, cfg, state=None):
     log_dir = get_log_dir(cfg)
     logger = get_logger(cfg, log_dir)
     fabric.logger = logger
@@ -117,7 +119,14 @@ def _player(fabric, cfg):
     num_envs, rollout_steps, trainer_devs, n_global, policy_steps_per_update, num_updates = _common_setup(
         fabric, cfg
     )
-    ckpt_updates = _ckpt_schedule(cfg, num_updates, policy_steps_per_update)
+    start_update = state["update"] + 1 if state else 1
+    ckpt_updates = _ckpt_schedule(
+        cfg,
+        num_updates,
+        policy_steps_per_update,
+        start_update=start_update,
+        last_checkpoint=state["last_checkpoint"] if state else 0,
+    )
 
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
     envs = vectorized_env(
@@ -142,13 +151,19 @@ def _player(fabric, cfg):
     )
 
     # identical deterministic init on every process replaces the reference's
-    # startup param broadcast (:126-130)
-    agent, params = build_agent(LocalFabric(fabric), actions_dim, is_continuous, cfg, observation_space, None)
-    from sheeprl_tpu.parallel.fabric import resolve_player_device
+    # startup param broadcast (:126-130); on resume all roles restore the
+    # same checkpointed params instead
+    agent, params = build_agent(
+        LocalFabric(fabric), actions_dim, is_continuous, cfg, observation_space, state["agent"] if state else None
+    )
+    from sheeprl_tpu.parallel.fabric import _ParamStreamer, resolve_player_device
 
     player = PPOPlayer(
-        agent, params, device=resolve_player_device(cfg.algo.get("player_device", "auto"), has_cnn=bool(cnn_keys))
+        agent, params, device=resolve_player_device(cfg.algo.get("player_device", "auto"))
     )
+    # flat-vector receive lane: the trainer ships ONE uint8 array; the split
+    # back into the param tree runs on the player's own device
+    unpack_lane = _ParamStreamer(jax.device_get(params), player.device or jax.devices()[0])
 
     if fabric.is_global_zero:
         save_configs(cfg, log_dir)
@@ -159,18 +174,23 @@ def _player(fabric, cfg):
 
     gae_fn = jax.jit(partial(gae, gamma=float(cfg.algo.gamma), gae_lambda=float(cfg.algo.gae_lambda)))
 
-    policy_step = 0
-    last_log = 0
+    policy_step = (start_update - 1) * policy_steps_per_update
+    last_log = state["last_log"] if state else 0
     key = jax.random.PRNGKey(int(cfg.seed))
+    if state and "rng_key" in state:
+        key = jnp.asarray(state["rng_key"])
     # action keys live on the player's device so a host-pinned player
     # never blocks on a chip round trip per env step
     from sheeprl_tpu.parallel.fabric import put_tree as _put_tree
 
     player_key = _put_tree(jax.random.fold_in(key, 1), player.device)
+    if state and "player_rng_key" in state:
+        # continue the pre-resume action-sampling stream
+        player_key = _put_tree(jnp.asarray(state["player_rng_key"]), player.device)
     next_obs, _ = envs.reset(seed=cfg.seed)
     next_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=num_envs)
 
-    for update in range(1, num_updates + 1):
+    for update in range(start_update, num_updates + 1):
         rollout = {k: [] for k in (*obs_keys, "dones", "values", "actions", "logprobs", "rewards")}
         with timer("Time/env_interaction_time"):
             for _ in range(rollout_steps):
@@ -235,11 +255,12 @@ def _player(fabric, cfg):
         # ship the rollout to the trainers (reference scatter, :297-302)
         broadcast_object(flat, src=0)
         # receive the updated params (+ metrics, + opt state when
-        # checkpointing) back from trainer rank 1 (reference :304-308)
+        # checkpointing) back from trainer rank 1 (reference :304-308). The
+        # params ride as ONE flat byte vector — one device transfer on each
+        # side instead of one per leaf (parallel.fabric._ParamStreamer)
         payload = broadcast_object(None, src=1)
-        # pre-upload once so per-step action sampling doesn't re-stage host
-        # arrays (device=None places on the default backend)
-        player.params = jax.device_put(payload["params"], player.device)
+        new_params = unpack_lane.finish(payload["params_flat"])
+        player.params = new_params
 
         if cfg.metric.log_level > 0:
             aggregator.update("Loss/policy_loss", float(payload["metrics"][0]))
@@ -253,13 +274,14 @@ def _player(fabric, cfg):
 
         if update in ckpt_updates:
             ckpt_state = {
-                "agent": payload["params"],
+                "agent": jax.device_get(new_params),
                 "opt_state": payload["opt_state"],
                 "update": update,
                 "batch_size": int(cfg.algo.per_rank_batch_size) * len(trainer_devs),
                 "last_log": last_log,
                 "last_checkpoint": policy_step,
                 "rng_key": jax.device_get(key),
+                "player_rng_key": jax.device_get(player_key),
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_0.ckpt")
             fabric.call("on_checkpoint_player", ckpt_path=ckpt_path, state=ckpt_state)
@@ -270,7 +292,7 @@ def _player(fabric, cfg):
     logger.finalize()
 
 
-def _trainer(fabric, cfg):
+def _trainer(fabric, cfg, state=None):
     # join the player's log-dir broadcast (utils/logger.py get_log_dir is a
     # collective over every process — the reference's rank-wide log-dir
     # broadcast, logger.py:83-88)
@@ -278,7 +300,14 @@ def _trainer(fabric, cfg):
     num_envs, rollout_steps, trainer_devs, n_global, policy_steps_per_update, num_updates = _common_setup(
         fabric, cfg
     )
-    ckpt_updates = _ckpt_schedule(cfg, num_updates, policy_steps_per_update)
+    start_update = state["update"] + 1 if state else 1
+    ckpt_updates = _ckpt_schedule(
+        cfg,
+        num_updates,
+        policy_steps_per_update,
+        start_update=start_update,
+        last_checkpoint=state["last_checkpoint"] if state else 0,
+    )
     tfabric = SubMeshFabric(fabric, trainer_devs)
     n_local = n_global // tfabric.world_size
 
@@ -294,7 +323,14 @@ def _trainer(fabric, cfg):
         else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
     )
 
-    agent, params = build_agent(tfabric, actions_dim, is_continuous, cfg, observation_space, None)
+    agent, params = build_agent(
+        tfabric, actions_dim, is_continuous, cfg, observation_space, state["agent"] if state else None
+    )
+    from sheeprl_tpu.parallel.fabric import _ParamStreamer
+
+    # flat-vector send lane: one on-device pack + ONE device->host fetch per
+    # update replaces a per-leaf device_get of the whole tree
+    pack_lane = _ParamStreamer(jax.device_get(params), trainer_devs[0])
 
     num_minibatches = max(1, n_local // int(cfg.algo.per_rank_batch_size))
     opt_cfg = dict(cfg.algo.optimizer.to_dict() if hasattr(cfg.algo.optimizer, "to_dict") else cfg.algo.optimizer)
@@ -306,7 +342,10 @@ def _trainer(fabric, cfg):
             float(opt_cfg.get("lr", 1e-3)), 0.0, num_updates * steps_per_update
         )
     tx = instantiate(opt_cfg)
-    opt_state = tfabric.replicate(tx.init(jax.device_get(params)))
+    if state and state.get("opt_state") is not None:
+        opt_state = tfabric.replicate(jax.tree.map(jnp.asarray, state["opt_state"]))
+    else:
+        opt_state = tfabric.replicate(tx.init(jax.device_get(params)))
 
     train_fn = make_train_fn(tfabric, agent, tx, cfg, obs_keys, n_local)
 
@@ -319,7 +358,7 @@ def _trainer(fabric, cfg):
     # devices it hosts (reference chunk scatter, :297-302)
     my_dev_idx = [i for i, d in enumerate(trainer_devs) if d.process_index == jax.process_index()]
 
-    for update in range(1, num_updates + 1):
+    for update in range(start_update, num_updates + 1):
         flat = broadcast_object(None, src=0)
         local_rows = np.concatenate([np.arange(i * n_local, (i + 1) * n_local) for i in my_dev_idx])
         local_flat = {k: v[local_rows] for k, v in flat.items()}
@@ -339,7 +378,8 @@ def _trainer(fabric, cfg):
 
         payload = None
         if jax.process_index() == 1:
-            payload = {"params": jax.device_get(params), "metrics": metrics, "opt_state": None}
+            flat_params = np.asarray(pack_lane.begin(params))  # one fetch
+            payload = {"params_flat": flat_params, "metrics": metrics, "opt_state": None}
             if update in ckpt_updates:
                 payload["opt_state"] = jax.device_get(opt_state)
         broadcast_object(payload, src=1)

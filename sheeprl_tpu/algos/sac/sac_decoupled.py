@@ -50,14 +50,15 @@ def main(fabric, cfg: Dict[str, Any]):
             "sac_decoupled requires at least 2 processes: one player and one or more trainers "
             "(reference sac_decoupled.py:552-556)"
         )
-    if cfg.checkpoint.resume_from:
-        raise ValueError("resume is not supported by the decoupled SAC (reference parity)")
+    # every process restores from the same checkpoint file (reference
+    # sac_decoupled.py resume; see also ppo_decoupled.py:45-46,104-116)
+    state = fabric.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
     if len(cfg.algo.cnn_keys.encoder) > 0:
         cfg.algo.cnn_keys.encoder = []
     if jax.process_index() == 0:
-        _player(fabric, cfg)
+        _player(fabric, cfg, state)
     else:
-        _trainer(fabric, cfg)
+        _trainer(fabric, cfg, state)
 
 
 def _counters(cfg, num_envs):
@@ -67,7 +68,7 @@ def _counters(cfg, num_envs):
     return policy_steps_per_update, num_updates, learning_starts
 
 
-def _player(fabric, cfg):
+def _player(fabric, cfg, state=None):
     log_dir = get_log_dir(cfg)
     logger = get_logger(cfg, log_dir)
     fabric.logger = logger
@@ -77,7 +78,14 @@ def _player(fabric, cfg):
     num_envs = int(cfg.env.num_envs)
     trainer_devs = _trainer_devices()
     policy_steps_per_update, num_updates, learning_starts = _counters(cfg, num_envs)
-    ckpt_updates = _ckpt_schedule(cfg, num_updates, policy_steps_per_update)
+    start_update = state["update"] + 1 if state else 1
+    ckpt_updates = _ckpt_schedule(
+        cfg,
+        num_updates,
+        policy_steps_per_update,
+        start_update=start_update,
+        last_checkpoint=state["last_checkpoint"] if state else 0,
+    )
     per_rank_batch_size = int(cfg.algo.per_rank_batch_size)
 
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
@@ -94,7 +102,9 @@ def _player(fabric, cfg):
         raise ValueError("Only continuous action space is supported for the SAC agent")
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
 
-    agent, player = build_agent(LocalFabric(fabric), cfg, observation_space, action_space, None)
+    agent, player = build_agent(
+        LocalFabric(fabric), cfg, observation_space, action_space, state["agent"] if state else None
+    )
 
     if fabric.is_global_zero:
         save_configs(cfg, log_dir)
@@ -112,22 +122,41 @@ def _player(fabric, cfg):
         memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
         seed=cfg.seed,
     )
+    if state:
+        if cfg.buffer.checkpoint and "rb" in state:
+            from sheeprl_tpu.utils.checkpoint import select_buffer
+
+            rb = select_buffer(state["rb"], 0, 1)
+        else:
+            # without the buffer, refill before training resumes
+            learning_starts += start_update
 
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state and "ratio" in state:
+        ratio.load_state_dict(state["ratio"])
     key = jax.random.PRNGKey(int(cfg.seed))
     # action keys live on the player's device so a host-pinned player
     # never blocks on a chip round trip per env step
     from sheeprl_tpu.parallel.fabric import put_tree as _put_tree
 
-    player_key = _put_tree(jax.random.fold_in(key, 1), player.device)
+    from sheeprl_tpu.parallel.fabric import _ParamStreamer
 
-    policy_step = 0
-    last_log = 0
+    # flat-vector receive lane matching the trainer's actor pack
+    actor_lane_player = _ParamStreamer(
+        jax.device_get(player.params), player.device or jax.devices()[0]
+    )
+    player_key = _put_tree(jax.random.fold_in(key, 1), player.device)
+    if state and "player_rng_key" in state:
+        # continue the pre-resume action-sampling stream
+        player_key = _put_tree(jnp.asarray(state["player_rng_key"]), player.device)
+
+    policy_step = (start_update - 1) * num_envs
+    last_log = state["last_log"] if state else 0
     obs, _ = envs.reset(seed=cfg.seed)
     step_data: Dict[str, np.ndarray] = {}
     cumulative_per_rank_gradient_steps = 0
 
-    for update in range(1, num_updates + 1):
+    for update in range(start_update, num_updates + 1):
         policy_step += num_envs
 
         with timer("Time/env_interaction_time"):
@@ -184,7 +213,7 @@ def _player(fabric, cfg):
         broadcast_object(data, src=0)
         payload = broadcast_object(None, src=1)
         if payload is not None:
-            player.params = jax.device_put(payload["actor"], player.device)
+            player.params = actor_lane_player.finish(payload["actor_flat"])
             if cfg.metric.log_level > 0:
                 aggregator.update("Loss/value_loss", float(payload["metrics"][0]))
                 aggregator.update("Loss/policy_loss", float(payload["metrics"][1]))
@@ -208,6 +237,8 @@ def _player(fabric, cfg):
                 "last_log": last_log,
                 "last_checkpoint": policy_step,
                 "ratio": ratio.state_dict(),
+                "rng_key": jax.device_get(key),
+                "player_rng_key": jax.device_get(player_key),
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_0.ckpt")
             fabric.call(
@@ -223,17 +254,26 @@ def _player(fabric, cfg):
     logger.finalize()
 
 
-def _trainer(fabric, cfg):
+def _trainer(fabric, cfg, state=None):
     get_log_dir(cfg)  # join the player's log-dir broadcast
     num_envs = int(cfg.env.num_envs)
     trainer_devs = _trainer_devices()
     tfabric = SubMeshFabric(fabric, trainer_devs)
     policy_steps_per_update, num_updates, learning_starts = _counters(cfg, num_envs)
-    ckpt_updates = _ckpt_schedule(cfg, num_updates, policy_steps_per_update)
+    start_update = state["update"] + 1 if state else 1
+    ckpt_updates = _ckpt_schedule(
+        cfg,
+        num_updates,
+        policy_steps_per_update,
+        start_update=start_update,
+        last_checkpoint=state["last_checkpoint"] if state else 0,
+    )
     per_rank_batch_size = int(cfg.algo.per_rank_batch_size)
 
     observation_space, action_space = probe_spaces(cfg)
-    agent, _player_handle = build_agent(tfabric, cfg, observation_space, action_space, None)
+    agent, _player_handle = build_agent(
+        tfabric, cfg, observation_space, action_space, state["agent"] if state else None
+    )
 
     def build_tx(opt_cfg):
         return instantiate(dict(opt_cfg.to_dict() if hasattr(opt_cfg, "to_dict") else opt_cfg))
@@ -241,9 +281,14 @@ def _trainer(fabric, cfg):
     critic_tx = build_tx(cfg.algo.critic.optimizer)
     actor_tx = build_tx(cfg.algo.actor.optimizer)
     alpha_tx = build_tx(cfg.algo.alpha.optimizer)
-    critic_opt = tfabric.replicate(critic_tx.init(jax.device_get(agent.critic_params)))
-    actor_opt = tfabric.replicate(actor_tx.init(jax.device_get(agent.actor_params)))
-    alpha_opt = tfabric.replicate(alpha_tx.init(jax.device_get(agent.log_alpha)))
+    if state:
+        critic_opt = tfabric.replicate(jax.tree.map(jnp.asarray, state["qf_optimizer"]))
+        actor_opt = tfabric.replicate(jax.tree.map(jnp.asarray, state["actor_optimizer"]))
+        alpha_opt = tfabric.replicate(jax.tree.map(jnp.asarray, state["alpha_optimizer"]))
+    else:
+        critic_opt = tfabric.replicate(critic_tx.init(jax.device_get(agent.critic_params)))
+        actor_opt = tfabric.replicate(actor_tx.init(jax.device_get(agent.actor_params)))
+        alpha_opt = tfabric.replicate(alpha_tx.init(jax.device_get(agent.log_alpha)))
 
     # the fused SAC update over the trainer-only mesh (reference trainer DDP
     # over optimization_pg, :352-542)
@@ -253,7 +298,12 @@ def _trainer(fabric, cfg):
     grad_counter = jnp.zeros((), jnp.int32)
     my_dev_idx = [i for i, d in enumerate(trainer_devs) if d.process_index == jax.process_index()]
 
-    for update in range(1, num_updates + 1):
+    from sheeprl_tpu.parallel.fabric import _ParamStreamer
+
+    # flat-vector send lane for the per-update actor refresh
+    actor_lane = _ParamStreamer(jax.device_get(agent.actor_params), trainer_devs[0])
+
+    for update in range(start_update, num_updates + 1):
         data = broadcast_object(None, src=0)
         payload = None
         if data is not None:
@@ -289,7 +339,7 @@ def _trainer(fabric, cfg):
             )
             if jax.process_index() == 1:
                 payload = {
-                    "actor": jax.device_get(agent.actor_params),
+                    "actor_flat": np.asarray(actor_lane.begin(agent.actor_params)),
                     "metrics": np.asarray(jax.device_get(metrics)),
                     "state": None,
                 }

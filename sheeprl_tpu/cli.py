@@ -199,8 +199,6 @@ def evaluation(args: Optional[List[str]] = None) -> None:
     with open(cfg_path) as f:
         cfg = dotdict(yaml.safe_load(f))
     cfg.checkpoint_path = ckpt_path
-    cfg.env.num_envs = 1
-    cfg.env.capture_video = kv.get("env.capture_video", "False").lower() in ("1", "true")
     for k, v in kv.items():
         if k in ("checkpoint_path", "env.capture_video"):
             continue
@@ -220,9 +218,12 @@ def evaluation(args: Optional[List[str]] = None) -> None:
     from sheeprl_tpu.config.compose import resolve
 
     cfg = dotdict(resolve(cfg))
-    # evaluation always runs single-device (reference cli.py:363-387) — after
-    # the overrides so a group re-selection cannot undo it
+    # evaluation always runs single-device and single-env (reference
+    # cli.py:363-387) — (re)applied after the overrides so a group
+    # re-selection like `env=dmc` cannot undo it
     cfg.fabric["devices"] = 1
+    cfg.env.num_envs = 1
+    cfg.env.capture_video = kv.get("env.capture_video", "False").lower() in ("1", "true")
     eval_algorithm(cfg)
 
 

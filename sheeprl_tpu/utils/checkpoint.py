@@ -4,15 +4,16 @@ Two backends:
 
 - ``pickle`` — one atomically-written file. Fine for single-host runs.
 - ``orbax`` — the pod-grade path: the checkpoint becomes a DIRECTORY in which
-  every array leaf is written through orbax's parallel OCDBT store (sharded,
-  multi-host-aware I/O) while non-array state (Ratio dicts, counters, replay
-  buffers) rides a pickle sidecar. This replaces the reference's gloo-gather
-  + single torch.save with storage that scales to pod-sized param trees.
+  every array leaf is written through orbax's parallel OCDBT store.
+  ``jax.Array`` leaves keep their shardings — on multi-host runs each process
+  writes only the shards it owns (no host-dense gather) — while non-array
+  state (Ratio dicts, counters) rides a shared pickle sidecar and per-process
+  state (replay buffers) rides one ``objects_rank_{i}.pkl`` per process. This
+  replaces the reference's gloo-gather + single torch.save with storage that
+  scales to pod-sized param trees.
 
-State trees mix jax array pytrees (params, optimizer state), plain Python
-state dicts and optionally replay-buffer objects; arrays are pulled to host
-first so checkpoints restore across process counts (sharded arrays saved
-dense; the trainer re-places them under its own mesh on load).
+Restore materializes arrays to host numpy so checkpoints reload across
+process counts; the loading run re-places them under its own mesh.
 """
 
 from __future__ import annotations
@@ -38,10 +39,12 @@ def _to_host(tree: Any) -> Any:
     return jax.tree.map(leaf, tree)
 
 
-def _split_arrays(tree: Any) -> Tuple[Any, Dict[str, np.ndarray]]:
-    """Replace every ndarray leaf with a sentinel key and collect the arrays
-    into one flat dict for the orbax store."""
-    arrays: Dict[str, np.ndarray] = {}
+def _split_arrays(tree: Any) -> Tuple[Any, Dict[str, Any]]:
+    """Replace every array leaf with a sentinel key and collect the arrays
+    into one flat dict for the orbax store. ``jax.Array`` leaves are kept AS
+    IS — sharded device arrays ride orbax's distributed write path without a
+    host-dense copy; numpy leaves pass through unchanged."""
+    arrays: Dict[str, Any] = {}
 
     def walk(node: Any) -> Any:
         if isinstance(node, dict):
@@ -49,7 +52,7 @@ def _split_arrays(tree: Any) -> Tuple[Any, Dict[str, np.ndarray]]:
         if isinstance(node, (list, tuple)):
             out = [walk(v) for v in node]
             return type(node)(*out) if hasattr(node, "_fields") else type(node)(out)
-        if isinstance(node, np.ndarray):
+        if isinstance(node, (np.ndarray, jax.Array)):
             key = f"k{len(arrays)}"
             arrays[key] = node
             return _ARRAY_SENTINEL + key
@@ -72,17 +75,28 @@ def _join_arrays(tree: Any, arrays: Dict[str, np.ndarray]) -> Any:
     return walk(tree)
 
 
-def save_checkpoint(path: str, state: Dict[str, Any], backend: str = "pickle") -> None:
+def save_checkpoint(
+    path: str,
+    state: Dict[str, Any],
+    backend: str = "pickle",
+    per_process_state: Dict[str, Any] | None = None,
+) -> None:
     """Write ``state`` to ``path`` (atomic for the pickle backend; the orbax
-    backend writes ``path`` as a directory)."""
-    host_state = _to_host(state)
+    backend writes ``path`` as a directory).
+
+    Orbax path: ``jax.Array`` leaves are handed to the OCDBT store with
+    their shardings intact — on multi-host runs every process writes only
+    its own shards (no host-dense gather). ``per_process_state`` (e.g. this
+    process's replay buffer) is written as ``objects_rank_{i}.pkl`` by every
+    process; :func:`load_checkpoint` reassembles the per-rank values into
+    lists for :func:`select_buffer`."""
     if backend == "orbax":
         import orbax.checkpoint as ocp
 
-        skeleton, arrays = _split_arrays(host_state)
+        skeleton, arrays = _split_arrays(state)
         # every process must reach the orbax save (it runs its own process
         # barriers on multi-host); only process 0 touches the directory and
-        # the object sidecar
+        # the shared object sidecar
         if jax.process_index() == 0:
             if os.path.isdir(path):
                 shutil.rmtree(path)
@@ -93,9 +107,16 @@ def save_checkpoint(path: str, state: Dict[str, Any], backend: str = "pickle") -
         if jax.process_index() == 0:
             with open(os.path.join(path, "objects.pkl"), "wb") as f:
                 pickle.dump(skeleton, f, protocol=pickle.HIGHEST_PROTOCOL)
+        if per_process_state is not None:
+            rank_path = os.path.join(path, f"objects_rank_{jax.process_index()}.pkl")
+            with open(rank_path, "wb") as f:
+                pickle.dump(_to_host(per_process_state), f, protocol=pickle.HIGHEST_PROTOCOL)
         return
     if backend != "pickle":
         raise ValueError(f"unknown checkpoint backend {backend!r} (choose 'pickle' or 'orbax')")
+    host_state = _to_host(state)
+    if per_process_state is not None:
+        host_state = {**host_state, **_to_host(per_process_state)}
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     d = os.path.dirname(os.path.abspath(path))
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
@@ -110,15 +131,32 @@ def save_checkpoint(path: str, state: Dict[str, Any], backend: str = "pickle") -
 
 
 def load_checkpoint(path: str) -> Dict[str, Any]:
-    """Load either backend (directories are orbax checkpoints)."""
+    """Load either backend (directories are orbax checkpoints). Per-rank
+    sidecars (``objects_rank_{i}.pkl``) are reassembled into lists keyed like
+    the original ``per_process_state`` — :func:`select_buffer` then picks the
+    restoring process's entry."""
     if os.path.isdir(path):
+        import glob as _glob
+
         import orbax.checkpoint as ocp
 
         with open(os.path.join(path, "objects.pkl"), "rb") as f:
             skeleton = pickle.load(f)
         ckptr = ocp.StandardCheckpointer()
         arrays = ckptr.restore(os.path.abspath(os.path.join(path, "arrays")))
-        return _join_arrays(skeleton, dict(arrays))
+        state = _join_arrays(skeleton, dict(arrays))
+        rank_files = sorted(
+            _glob.glob(os.path.join(path, "objects_rank_*.pkl")),
+            key=lambda p: int(p.rsplit("_", 1)[1].split(".")[0]),
+        )
+        if rank_files:
+            per_rank = []
+            for rf in rank_files:
+                with open(rf, "rb") as f:
+                    per_rank.append(pickle.load(f))
+            for key in per_rank[0]:
+                state[key] = [p[key] for p in per_rank]
+        return state
     with open(path, "rb") as f:
         return pickle.load(f)
 

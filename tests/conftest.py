@@ -26,12 +26,13 @@ def tmp_logdir(tmp_path):
     return str(tmp_path / "logs")
 
 
-def run_two_process(code: str, argv=(), cwd=None, extra_env=None, timeout=540):
-    """Launch ``code`` in two real ``jax.distributed`` CPU processes
-    (TEST_COORD/TEST_NPROC/TEST_PID env contract) and return their outputs,
-    asserting both exit 0. Workers are killed on failure/timeout so a wedged
-    pair cannot leak into later tests. Shared by the decoupled-topology and
-    collective-plane tests."""
+def run_multi_process(code: str, argv=(), cwd=None, extra_env=None, timeout=540, nproc=2, device_count=2):
+    """Launch ``code`` in ``nproc`` real ``jax.distributed`` CPU processes
+    (TEST_COORD/TEST_NPROC/TEST_PID env contract), each with ``device_count``
+    virtual CPU devices, and return their outputs, asserting all exit 0.
+    Workers are killed on failure/timeout so a wedged group cannot leak into
+    later tests. Shared by the decoupled-topology and collective-plane
+    tests."""
     import socket
     import subprocess
     import sys
@@ -43,15 +44,15 @@ def run_two_process(code: str, argv=(), cwd=None, extra_env=None, timeout=540):
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     procs = []
     try:
-        for pid in range(2):
+        for pid in range(nproc):
             env = dict(os.environ)
             env.pop("SHEEPRL_TPU_COORDINATOR", None)
             env.pop("SHEEPRL_TPU_NUM_PROCESSES", None)
             env.pop("SHEEPRL_TPU_PROCESS_ID", None)
             env["JAX_PLATFORMS"] = "cpu"
-            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+            env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={device_count}"
             env["TEST_COORD"] = f"127.0.0.1:{port}"
-            env["TEST_NPROC"] = "2"
+            env["TEST_NPROC"] = str(nproc)
             env["TEST_PID"] = str(pid)
             env["PYTHONPATH"] = os.pathsep.join(p for p in (repo_root, env.get("PYTHONPATH")) if p)
             env.update(extra_env or {})
@@ -74,6 +75,10 @@ def run_two_process(code: str, argv=(), cwd=None, extra_env=None, timeout=540):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
     return outs
+
+
+def run_two_process(code: str, argv=(), cwd=None, extra_env=None, timeout=540):
+    return run_multi_process(code, argv=argv, cwd=cwd, extra_env=extra_env, timeout=timeout, nproc=2)
 
 
 @pytest.fixture(autouse=True)
@@ -100,3 +105,35 @@ def _reset_observability_switches():
     yield
     MetricAggregator.disabled = agg_disabled
     timer.disabled = timer_disabled
+
+
+def pytest_unconfigure(config):
+    """Exit without CPython finalization (two rounds of `free(): invalid
+    pointer` AFTER the test summary — the axon TPU-client plugin's C++
+    teardown races interpreter shutdown; not reproducible from plain
+    imports, only after a full session). By this hook the report is written
+    and every fixture finalized, so `os._exit` with pytest's own status
+    makes the exit code deterministic instead of whatever the broken
+    destructor produces. Disable with SHEEPRL_TPU_NO_FAST_EXIT=1."""
+    if os.environ.get("SHEEPRL_TPU_NO_FAST_EXIT"):
+        return
+    import sys
+
+    sys.stdout.flush()
+    sys.stderr.flush()
+    status = getattr(config, "_sheeprl_exitstatus", 0)
+    os._exit(int(status))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    session.config._sheeprl_exitstatus = int(exitstatus)
+
+
+def find_checkpoints(base):
+    """Every checkpoint under ``base`` (pickle .ckpt files and orbax .ckpt
+    directories), oldest first — shared by the resume/decoupled tests."""
+    found = []
+    for root, dirs, files in os.walk(base):
+        found += [os.path.join(root, f) for f in files if f.endswith(".ckpt")]
+        found += [os.path.join(root, d) for d in dirs if d.endswith(".ckpt")]
+    return sorted(set(found), key=os.path.getmtime)
