@@ -338,7 +338,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     metrics = np.asarray(jax.device_get(metrics))
                     train_step += num_processes
                 cumulative_per_rank_gradient_steps += per_rank_gradient_steps
-                player.params = agent.actor_params
+                player.update_params(agent.actor_params)
                 if cfg.metric.log_level > 0:
                     aggregator.update("Loss/value_loss", float(metrics[0]))
                     aggregator.update("Loss/policy_loss", float(metrics[1]))
@@ -401,6 +401,8 @@ def main(fabric, cfg: Dict[str, Any]):
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
+    # land any in-flight async param stream before the final evaluation
+    player.flush_stream_attrs()
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         test(player, fabric, cfg, log_dir)

@@ -150,7 +150,10 @@ class SACPlayer(HostPlayerParams):
         self._greedy = jax.jit(lambda p, o: actor_greedy_action(actor, p, o))
 
     def update_params(self, params: Any) -> None:
-        self.params = params
+        """Per-train-block refresh: non-blocking in host-player mode (the
+        SAC family is off-policy — a block or two of param staleness is the
+        standard actor-learner lag; see ``fabric.HostPlayerParams.stream_attr``)."""
+        self.stream_attr("params", params)
 
     def get_actions(self, obs: Array, key: Optional[Array] = None, greedy: bool = False) -> np.ndarray:
         if greedy:

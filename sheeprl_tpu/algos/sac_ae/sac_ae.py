@@ -488,8 +488,9 @@ def main(fabric, cfg: Dict[str, Any]):
                     metrics = np.asarray(jax.device_get(metrics))
                     train_step += num_processes
                 cumulative_per_rank_gradient_steps += per_rank_gradient_steps
-                player.encoder_params = agent.encoder_params
-                player.actor_params = agent.actor_params
+                # off-policy: non-blocking refresh, params land a block later
+                player.stream_attr("encoder_params", agent.encoder_params)
+                player.stream_attr("actor_params", agent.actor_params)
                 if cfg.metric.log_level > 0:
                     aggregator.update("Loss/value_loss", float(metrics[0]))
                     aggregator.update("Loss/policy_loss", float(metrics[1]))
@@ -559,6 +560,8 @@ def main(fabric, cfg: Dict[str, Any]):
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
+    # land any in-flight async param stream before the final evaluation
+    player.flush_stream_attrs()
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         test(player, fabric, cfg, log_dir)

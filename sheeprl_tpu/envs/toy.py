@@ -46,6 +46,7 @@ class PixelCatcher(gym.Env):
         paddle_speed: int = 3,
         fall_speed: int = 2,
         episode_pellets: int = 12,
+        continuous_actions: bool = False,
         seed: Optional[int] = None,
     ) -> None:
         self._size = int(size)
@@ -53,11 +54,17 @@ class PixelCatcher(gym.Env):
         self._paddle_speed = int(paddle_speed)
         self._fall_speed = int(fall_speed)
         self._episode_pellets = int(episode_pellets)
+        self._continuous = bool(continuous_actions)
         self._rng = np.random.default_rng(seed)
         self.observation_space = spaces.Dict(
             {"rgb": spaces.Box(0, 255, (self._size, self._size, 3), np.uint8)}
         )
-        self.action_space = spaces.Discrete(3)
+        # continuous variant (for the SAC-family pixel checks): one action in
+        # [-1, 1], scaled to a paddle velocity of up to paddle_speed px/step
+        if self._continuous:
+            self.action_space = spaces.Box(-1.0, 1.0, (1,), np.float32)
+        else:
+            self.action_space = spaces.Discrete(3)
         if seed is not None:
             self.action_space.seed(seed)
         self._paddle_x = self._size // 2
@@ -94,7 +101,11 @@ class PixelCatcher(gym.Env):
         return self._frame(), {}
 
     def step(self, action: Any) -> Tuple[Dict[str, np.ndarray], float, bool, bool, Dict[str, Any]]:
-        move = (int(np.asarray(action).reshape(()).item()) - 1) * self._paddle_speed
+        if self._continuous:
+            vel = float(np.clip(np.asarray(action, np.float32).reshape(-1)[0], -1.0, 1.0))
+            move = int(round(vel * self._paddle_speed))
+        else:
+            move = (int(np.asarray(action).reshape(()).item()) - 1) * self._paddle_speed
         half = self._paddle_w // 2
         self._paddle_x = int(np.clip(self._paddle_x + move, half, self._size - 1 - half))
 

@@ -93,3 +93,31 @@ def test_pixel_catcher_through_make_env_factory():
     obs, _ = env.reset(seed=0)
     assert obs["rgb"].shape == (64, 64, 3)
     env.close()
+
+
+def test_pixel_catcher_continuous_actions():
+    env = PixelCatcher(seed=0, continuous_actions=True)
+    assert env.action_space.shape == (1,)
+    env.reset(seed=0)
+    x0 = env._paddle_x
+    env.step(np.array([1.0], np.float32))
+    assert env._paddle_x == x0 + env._paddle_speed
+    env.step(np.array([-1.0], np.float32))
+    env.step(np.array([-1.0], np.float32))
+    assert env._paddle_x == x0 - env._paddle_speed
+
+    # oracle still catches everything through the continuous interface
+    env = PixelCatcher(seed=1, continuous_actions=True, episode_pellets=3)
+    env.reset(seed=1)
+    total = 0.0
+    for _ in range(1000):
+        delta = env._pellet[0] - env._paddle_x
+        a = np.array([np.clip(delta, -1, 1)], np.float32)
+        _, r, term, trunc, info = env.step(a)
+        total += r
+        if trunc:
+            assert info["caught"] == 3 and total == 3.0
+            break
+        assert not term
+    else:
+        raise AssertionError("continuous oracle never hit the pellet cap")
