@@ -36,7 +36,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import Ratio, gradient_step_chunks, save_configs
 
 
 def _ensemble_apply_dropout(critic, stacked_params, obs, action, key, n_critics):
@@ -65,9 +65,9 @@ def make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg):
             return _ensemble_apply_dropout(critic, params, obs, action, key, n_critics)
         return critic_ensemble_apply(critic, params, obs, action)
 
-    def local_train(
+    def local_critic_scan(
         actor_params, critic_params, target_params, log_alpha,
-        actor_opt, critic_opt, alpha_opt, critic_data, actor_batch, key,
+        critic_opt, critic_data, key,
     ):
         if multi_device:
             key = jax.random.fold_in(key, lax.axis_index(data_axis))
@@ -105,8 +105,15 @@ def make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg):
         (critic_params, target_params, critic_opt, key), qf_losses = lax.scan(
             critic_step, (critic_params, target_params, critic_opt, key), critic_data
         )
+        return critic_params, target_params, critic_opt, pmean(qf_losses.mean())
 
-        # one actor + alpha update per train call (reference droq.py:121-139)
+    def local_actor_update(
+        actor_params, critic_params, log_alpha, actor_opt, alpha_opt, actor_batch, key,
+    ):
+        # one actor + alpha update per env update (reference droq.py:121-139)
+        if multi_device:
+            key = jax.random.fold_in(key, lax.axis_index(data_axis))
+        alpha = jnp.exp(log_alpha)
         key, k_actor, k_drop = jax.random.split(key, 3)
 
         def actor_loss_fn(p):
@@ -126,28 +133,32 @@ def make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg):
         updates, alpha_opt = alpha_tx.update(alpha_grad, alpha_opt, log_alpha)
         log_alpha = optax.apply_updates(log_alpha, updates)
         alpha_l = entropy_loss(log_alpha, logpi, target_entropy)
+        return actor_params, log_alpha, actor_opt, alpha_opt, pmean(jnp.stack([a_loss, alpha_l]))
 
-        metrics = pmean(jnp.stack([qf_losses.mean(), a_loss, alpha_l]))
-        return (
-            actor_params, critic_params, target_params, log_alpha,
-            actor_opt, critic_opt, alpha_opt, metrics,
-        )
-
+    critic_fn, actor_fn = local_critic_scan, local_actor_update
     if multi_device:
-        train_fn = shard_map(
-            local_train,
+        critic_fn = shard_map(
+            local_critic_scan,
             mesh=fabric.mesh,
-            in_specs=(P(), P(), P(), P(), P(), P(), P(), P(None, data_axis), P(data_axis), P()),
-            out_specs=(P(), P(), P(), P(), P(), P(), P(), P()),
+            in_specs=(P(), P(), P(), P(), P(), P(None, data_axis), P()),
+            out_specs=(P(), P(), P(), P()),
         )
-    else:
-        train_fn = local_train
-    # donate only optimizer/aux state: param buffers stay un-donated because
-    # concurrent readers (async param streaming to the host player, the ema /
-    # hard-copy target refresh) may still be in flight when the next train
-    # dispatch would otherwise alias over them (observed on the remote chip
-    # as spurious INVALID_ARGUMENT errors surfacing at unrelated fetches)
-    return jax.jit(train_fn, donate_argnums=(4, 5, 6))
+        actor_fn = shard_map(
+            local_actor_update,
+            mesh=fabric.mesh,
+            in_specs=(P(), P(), P(), P(), P(), P(data_axis), P()),
+            out_specs=(P(), P(), P(), P(), P()),
+        )
+    # Split into two jits so the critic scan can run in fixed-size chunks
+    # (utils.gradient_step_chunks — scan length changes recompile) while the
+    # actor update stays exactly once per env update like the reference.
+    # Donate only optimizer state: param buffers stay un-donated because
+    # concurrent readers (async param streaming to the host player, the EMA)
+    # may still be in flight when the next dispatch would alias over them.
+    return (
+        jax.jit(critic_fn, donate_argnums=(4,)),
+        jax.jit(actor_fn, donate_argnums=(3, 4)),
+    )
 
 
 @register_algorithm()
@@ -222,7 +233,7 @@ def main(fabric, cfg: Dict[str, Any]):
 
         rb = select_buffer(state["rb"], rank, num_processes)
 
-    train_fn = make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg)
+    critic_fn, actor_fn = make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg)
 
     train_step = 0
     last_train = 0
@@ -295,54 +306,77 @@ def main(fabric, cfg: Dict[str, Any]):
         if update >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step / num_processes)
             if per_rank_gradient_steps > 0:
-                critic_sample = rb.sample(
-                    batch_size=per_rank_batch_size * fabric.local_device_count,
-                    n_samples=per_rank_gradient_steps,
-                )
+                from sheeprl_tpu.data.buffers import to_device
+
+                # G critic steps in fixed-size scan chunks (every distinct
+                # scan length is a fresh XLA compile — gradient_step_chunks);
+                # sampling/staging stays OUTSIDE the train timer like the
+                # other SAC-family loops
+                qf_losses = []
+                for chunk_steps in gradient_step_chunks(per_rank_gradient_steps, cfg.algo):
+                    critic_sample = rb.sample(
+                        batch_size=per_rank_batch_size * fabric.local_device_count,
+                        n_samples=chunk_steps,
+                    )
+                    critic_data = {k: np.asarray(v, np.float32) for k, v in critic_sample.items()}
+                    if num_processes > 1:
+                        critic_data = fabric.make_global(critic_data, (None, fabric.data_axis))
+                    else:
+                        # async HBM staging ahead of the fused replay loop
+                        critic_data = to_device(critic_data)
+                    with timer("Time/train_time"):
+                        key, train_key = jax.random.split(key)
+                        (
+                            agent.critic_params,
+                            agent.target_critic_params,
+                            critic_opt,
+                            qf_loss,
+                        ) = critic_fn(
+                            agent.actor_params,
+                            agent.critic_params,
+                            agent.target_critic_params,
+                            agent.log_alpha,
+                            critic_opt,
+                            critic_data,
+                            train_key,
+                        )
+                    qf_losses.append(qf_loss)
+                    cumulative_per_rank_gradient_steps += chunk_steps
+
+                # then ONE actor+alpha update (reference droq.py:121-139)
                 actor_sample = rb.sample(batch_size=per_rank_batch_size * fabric.local_device_count)
-                critic_data = {k: np.asarray(v, np.float32) for k, v in critic_sample.items()}
                 actor_batch = {
                     k: np.asarray(v, np.float32)[0] for k, v in actor_sample.items()
                 }  # [B, ...]
                 if num_processes > 1:
-                    critic_data = fabric.make_global(critic_data, (None, fabric.data_axis))
                     actor_batch = fabric.make_global(actor_batch, (fabric.data_axis,))
                 else:
-                    # async HBM staging ahead of the fused high-replay loop
-                    from sheeprl_tpu.data.buffers import to_device
-                    critic_data = to_device(critic_data)
                     actor_batch = to_device(actor_batch)
                 with timer("Time/train_time"):
                     key, train_key = jax.random.split(key)
                     (
                         agent.actor_params,
-                        agent.critic_params,
-                        agent.target_critic_params,
                         agent.log_alpha,
                         actor_opt,
-                        critic_opt,
                         alpha_opt,
-                        metrics,
-                    ) = train_fn(
+                        actor_metrics,
+                    ) = actor_fn(
                         agent.actor_params,
                         agent.critic_params,
-                        agent.target_critic_params,
                         agent.log_alpha,
                         actor_opt,
-                        critic_opt,
                         alpha_opt,
-                        critic_data,
                         actor_batch,
                         train_key,
                     )
-                    metrics = np.asarray(jax.device_get(metrics))
+                    qf_mean = np.mean(np.asarray(jax.device_get(jnp.stack(qf_losses))))
+                    actor_metrics = np.asarray(jax.device_get(actor_metrics))
                     train_step += num_processes
-                cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                 player.update_params(agent.actor_params)
                 if cfg.metric.log_level > 0:
-                    aggregator.update("Loss/value_loss", float(metrics[0]))
-                    aggregator.update("Loss/policy_loss", float(metrics[1]))
-                    aggregator.update("Loss/alpha_loss", float(metrics[2]))
+                    aggregator.update("Loss/value_loss", float(qf_mean))
+                    aggregator.update("Loss/policy_loss", float(actor_metrics[0]))
+                    aggregator.update("Loss/alpha_loss", float(actor_metrics[1]))
 
         if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or update == num_updates):
             logger.log_metrics(aggregator.compute(), policy_step)

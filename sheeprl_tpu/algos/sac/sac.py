@@ -44,7 +44,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import Ratio, gradient_step_chunks, save_configs
 
 
 def make_train_fn(fabric, agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg):
@@ -318,13 +318,17 @@ def main(fabric, cfg: Dict[str, Any]):
 
         if update >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step / num_processes)
-            if per_rank_gradient_steps > 0:
-                # [G, B_total, ...] so the whole gradient loop runs in one jit
-                # each process samples its share of the global batch; the
-                # shards are assembled into one global array over the mesh
+            # fixed-size scan chunks: every distinct scan length is a fresh
+            # XLA compile, and Ratio's first post-warmup call repays the whole
+            # warmup debt in one G (utils.gradient_step_chunks)
+            chunk_metrics = []
+            for chunk_steps in gradient_step_chunks(per_rank_gradient_steps, cfg.algo):
+                # [G, B_total, ...] so the chunk's gradient loop runs in one
+                # jit; each process samples its share of the global batch and
+                # the shards assemble into one global array over the mesh
                 sample = rb.sample(
                     batch_size=per_rank_batch_size * fabric.local_device_count,
-                    n_samples=per_rank_gradient_steps,
+                    n_samples=chunk_steps,
                     sample_next_obs=cfg.buffer.sample_next_obs,
                 )
                 data = {k: np.asarray(v, np.float32) for k, v in sample.items()}
@@ -359,11 +363,18 @@ def main(fabric, cfg: Dict[str, Any]):
                         data,
                         train_key,
                     )
-                    metrics = np.asarray(jax.device_get(metrics))
-                    train_step += num_processes
-                cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                    chunk_metrics.append((chunk_steps, np.asarray(jax.device_get(metrics))))
+                cumulative_per_rank_gradient_steps += chunk_steps
+            if per_rank_gradient_steps > 0:
+                train_step += num_processes  # one "train event" per update
                 player.update_params(agent.actor_params)
                 if cfg.metric.log_level > 0:
+                    # gradient-step-weighted mean over the chunks: identical
+                    # to the pre-chunking all-G mean
+                    weights = np.array([w for w, _ in chunk_metrics], np.float64)
+                    metrics = np.average(
+                        np.stack([m for _, m in chunk_metrics]), axis=0, weights=weights
+                    )
                     aggregator.update("Loss/value_loss", float(metrics[0]))
                     aggregator.update("Loss/policy_loss", float(metrics[1]))
                     aggregator.update("Loss/alpha_loss", float(metrics[2]))

@@ -46,7 +46,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import Ratio, gradient_step_chunks, save_configs
 
 
 def make_train_fn(fabric, agent: SACAEAgent, actor_tx, qf_tx, alpha_tx, encoder_tx, decoder_tx, cfg):
@@ -429,10 +429,14 @@ def main(fabric, cfg: Dict[str, Any]):
 
         if update >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step / num_processes)
-            if per_rank_gradient_steps > 0:
+            # fixed-size scan chunks (utils.gradient_step_chunks): every
+            # distinct scan length is a fresh XLA compile and Ratio's first
+            # post-warmup call repays the whole warmup debt in one G
+            chunk_metrics = []
+            for chunk_steps in gradient_step_chunks(per_rank_gradient_steps, cfg.algo):
                 sample = rb.sample(
                     batch_size=per_rank_batch_size * fabric.local_device_count,
-                    n_samples=per_rank_gradient_steps,
+                    n_samples=chunk_steps,
                 )
                 data = {}
                 for k, v in sample.items():
@@ -485,13 +489,20 @@ def main(fabric, cfg: Dict[str, Any]):
                         data,
                         train_key,
                     )
-                    metrics = np.asarray(jax.device_get(metrics))
-                    train_step += num_processes
-                cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                    chunk_metrics.append((chunk_steps, np.asarray(jax.device_get(metrics))))
+                cumulative_per_rank_gradient_steps += chunk_steps
+            if per_rank_gradient_steps > 0:
+                train_step += num_processes  # one "train event" per update
                 # off-policy: non-blocking refresh, params land a block later
                 player.stream_attr("encoder_params", agent.encoder_params)
                 player.stream_attr("actor_params", agent.actor_params)
                 if cfg.metric.log_level > 0:
+                    # gradient-step-weighted mean over the chunks: identical
+                    # to the pre-chunking all-G mean
+                    weights = np.array([w for w, _ in chunk_metrics], np.float64)
+                    metrics = np.average(
+                        np.stack([m for _, m in chunk_metrics]), axis=0, weights=weights
+                    )
                     aggregator.update("Loss/value_loss", float(metrics[0]))
                     aggregator.update("Loss/policy_loss", float(metrics[1]))
                     aggregator.update("Loss/alpha_loss", float(metrics[2]))

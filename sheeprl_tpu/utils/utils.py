@@ -236,3 +236,25 @@ class SteadyStateProbe:
             return
         with open(self.path, "w") as f:
             json.dump({"steps": step - self._step0, "seconds": time.perf_counter() - self._t0}, f)
+
+
+def gradient_step_chunks(n_steps: int, algo_cfg: Mapping[str, Any]) -> list:
+    """Split a variable gradient-step count into jit-shape-stable pieces.
+
+    The SAC-family loops fuse all G gradient steps of an update into one
+    scanned jit whose length is G — but ``Ratio`` varies G (most brutally on
+    the first post-warmup update, which repays the whole warmup debt: G in
+    the hundreds), and every distinct G compiles a fresh executable (the
+    observed 20-minute stall on the remote chip). Chunking caps the set of
+    compiled lengths at {chunk} ∪ {possible remainders}: full chunks are
+    shape-identical, the scan math is unchanged (scans compose), and only
+    the remainder varies. The chunk size comes from
+    ``algo.gradient_steps_chunk`` (the SAC-family yamls declare it)."""
+    if n_steps <= 0:
+        return []
+    chunk = int(algo_cfg.get("gradient_steps_chunk", 16) or 16)
+    out = [chunk] * (int(n_steps) // chunk)
+    rem = int(n_steps) % chunk
+    if rem:
+        out.append(rem)
+    return out
