@@ -36,7 +36,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, gradient_step_chunks, save_configs
+from sheeprl_tpu.utils.utils import Ratio, gradient_step_chunks, save_configs, weighted_chunk_metrics
 
 
 def _ensemble_apply_dropout(critic, stacked_params, obs, action, key, n_critics):
@@ -340,7 +340,7 @@ def main(fabric, cfg: Dict[str, Any]):
                             critic_data,
                             train_key,
                         )
-                    qf_losses.append(qf_loss)
+                    qf_losses.append((chunk_steps, qf_loss))
                     cumulative_per_rank_gradient_steps += chunk_steps
 
                 # then ONE actor+alpha update (reference droq.py:121-139)
@@ -369,7 +369,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         actor_batch,
                         train_key,
                     )
-                    qf_mean = np.mean(np.asarray(jax.device_get(jnp.stack(qf_losses))))
+                    qf_mean = float(weighted_chunk_metrics(qf_losses))
                     actor_metrics = np.asarray(jax.device_get(actor_metrics))
                     train_step += num_processes
                 player.update_params(agent.actor_params)

@@ -44,7 +44,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, gradient_step_chunks, save_configs
+from sheeprl_tpu.utils.utils import Ratio, gradient_step_chunks, save_configs, weighted_chunk_metrics
 
 
 def make_train_fn(fabric, agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg):
@@ -363,18 +363,13 @@ def main(fabric, cfg: Dict[str, Any]):
                         data,
                         train_key,
                     )
-                    chunk_metrics.append((chunk_steps, np.asarray(jax.device_get(metrics))))
+                    chunk_metrics.append((chunk_steps, metrics))  # device array; fetched once below
                 cumulative_per_rank_gradient_steps += chunk_steps
             if per_rank_gradient_steps > 0:
                 train_step += num_processes  # one "train event" per update
                 player.update_params(agent.actor_params)
                 if cfg.metric.log_level > 0:
-                    # gradient-step-weighted mean over the chunks: identical
-                    # to the pre-chunking all-G mean
-                    weights = np.array([w for w, _ in chunk_metrics], np.float64)
-                    metrics = np.average(
-                        np.stack([m for _, m in chunk_metrics]), axis=0, weights=weights
-                    )
+                    metrics = weighted_chunk_metrics(chunk_metrics)
                     aggregator.update("Loss/value_loss", float(metrics[0]))
                     aggregator.update("Loss/policy_loss", float(metrics[1]))
                     aggregator.update("Loss/alpha_loss", float(metrics[2]))

@@ -46,7 +46,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, gradient_step_chunks, save_configs
+from sheeprl_tpu.utils.utils import Ratio, gradient_step_chunks, save_configs, weighted_chunk_metrics
 
 
 def make_train_fn(fabric, agent: SACAEAgent, actor_tx, qf_tx, alpha_tx, encoder_tx, decoder_tx, cfg):
@@ -441,12 +441,14 @@ def main(fabric, cfg: Dict[str, Any]):
                 data = {}
                 for k, v in sample.items():
                     if k in cnn_keys or (k.startswith("next_") and k[5:] in cnn_keys):
-                        # [G, B, S, H, W, C] or [G, B, H, W, C] -> fold stack
+                        # [G, B, S, H, W, C] or [G, B, H, W, C] -> fold stack;
+                        # pixels STAY uint8 across the link (4x fewer bytes —
+                        # the in-graph /255 normalization promotes to f32)
                         v = np.asarray(v)
                         if v.ndim == 6:
                             g, b, s, h, w, c = v.shape
                             v = np.moveaxis(v, 2, 4).reshape(g, b, h, w, s * c)
-                        data[k] = v.astype(np.float32)
+                        data[k] = v if v.dtype == np.uint8 else v.astype(np.float32)
                     else:
                         data[k] = np.asarray(v, np.float32)
                 if num_processes > 1:
@@ -489,7 +491,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         data,
                         train_key,
                     )
-                    chunk_metrics.append((chunk_steps, np.asarray(jax.device_get(metrics))))
+                    chunk_metrics.append((chunk_steps, metrics))  # device array; fetched once below
                 cumulative_per_rank_gradient_steps += chunk_steps
             if per_rank_gradient_steps > 0:
                 train_step += num_processes  # one "train event" per update
@@ -497,12 +499,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 player.stream_attr("encoder_params", agent.encoder_params)
                 player.stream_attr("actor_params", agent.actor_params)
                 if cfg.metric.log_level > 0:
-                    # gradient-step-weighted mean over the chunks: identical
-                    # to the pre-chunking all-G mean
-                    weights = np.array([w for w, _ in chunk_metrics], np.float64)
-                    metrics = np.average(
-                        np.stack([m for _, m in chunk_metrics]), axis=0, weights=weights
-                    )
+                    metrics = weighted_chunk_metrics(chunk_metrics)
                     aggregator.update("Loss/value_loss", float(metrics[0]))
                     aggregator.update("Loss/policy_loss", float(metrics[1]))
                     aggregator.update("Loss/alpha_loss", float(metrics[2]))

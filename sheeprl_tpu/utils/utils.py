@@ -258,3 +258,15 @@ def gradient_step_chunks(n_steps: int, algo_cfg: Mapping[str, Any]) -> list:
     if rem:
         out.append(rem)
     return out
+
+
+def weighted_chunk_metrics(chunk_metrics: list) -> Any:
+    """Gradient-step-weighted mean over ``(chunk_steps, device_metrics)``
+    pairs — fetched in ONE host round trip and identical to the
+    pre-chunking all-G mean. Companion of :func:`gradient_step_chunks`."""
+    import jax
+    import numpy as np
+
+    weights = np.array([w for w, _ in chunk_metrics], np.float64)
+    stacked = np.asarray(jax.device_get([m for _, m in chunk_metrics]))
+    return np.average(stacked, axis=0, weights=weights)
