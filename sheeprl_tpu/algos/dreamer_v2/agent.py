@@ -664,6 +664,7 @@ class PlayerDV2(HostPlayerParams):
         expl_step: int = 0,
         with_exploration: bool = False,
     ) -> Array:
+        self.poll_stream_attrs()
         action, h, z = self._step(
             self.wm_params, self.actor_params, obs, self.h, self.z, self.actions, put_tree(key, self.device), greedy
         )

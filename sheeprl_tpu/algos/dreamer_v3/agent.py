@@ -829,6 +829,7 @@ class PlayerDV3(HostPlayerParams):
         greedy: bool = False,
         mask: Optional[Dict[str, Array]] = None,
     ) -> Array:
+        self.poll_stream_attrs()
         # keys minted on another backend would clash with host-pinned params
         # (committed-device mismatch) — re-place; identity when aligned
         key = put_tree(key, self.device)

@@ -156,6 +156,7 @@ class SACPlayer(HostPlayerParams):
         self.stream_attr("params", params)
 
     def get_actions(self, obs: Array, key: Optional[Array] = None, greedy: bool = False) -> np.ndarray:
+        self.poll_stream_attrs()
         if greedy:
             return np.asarray(self._greedy(self.params, obs))
         return np.asarray(self._sample(self.params, obs, put_tree(key, self.device)))

@@ -135,6 +135,8 @@ def _player(fabric, cfg, state=None):
     if state and "ratio" in state:
         ratio.load_state_dict(state["ratio"])
     key = jax.random.PRNGKey(int(cfg.seed))
+    if state and "rng_key" in state:
+        key = jnp.asarray(state["rng_key"])
     # action keys live on the player's device so a host-pinned player
     # never blocks on a chip round trip per env step
     from sheeprl_tpu.parallel.fabric import put_tree as _put_tree
@@ -301,6 +303,10 @@ def _trainer(fabric, cfg, state=None):
     train_fn = make_train_fn(tfabric, agent, actor_tx, critic_tx, alpha_tx, cfg)
 
     key = jax.random.PRNGKey(int(cfg.seed) + jax.process_index())
+    if state:
+        # the trainer key is not checkpointed; fold in the resume point so the
+        # post-resume train_key stream does not replay the pre-checkpoint one
+        key = jax.random.fold_in(key, start_update)
     grad_counter = jnp.zeros((), jnp.int32)
     my_dev_idx = [i for i, d in enumerate(trainer_devs) if d.process_index == jax.process_index()]
 

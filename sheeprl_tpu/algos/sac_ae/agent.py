@@ -316,6 +316,7 @@ class SACAEPlayer(HostPlayerParams):
         self._greedy = jax.jit(_greedy)
 
     def get_actions(self, obs: Dict[str, Array], key: Optional[Array] = None, greedy: bool = False) -> np.ndarray:
+        self.poll_stream_attrs()
         if greedy:
             return np.asarray(self._greedy(self.encoder_params, self.actor_params, obs))
         return np.asarray(self._sample(self.encoder_params, self.actor_params, obs, put_tree(key, self.device)))

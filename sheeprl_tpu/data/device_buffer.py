@@ -236,6 +236,13 @@ class DeviceReplayBuffer:
                 o0, o1, _ = self._small_slices[k]
                 smalls[env, o0:o1] = np.asarray(data[k][0, col], np.float32).reshape(-1)
 
+        if (self._device or jax.devices()[0]).platform == "cpu":
+            # PJRT CPU device_put may alias aligned numpy buffers zero-copy;
+            # the staging arrays are refilled on the next add() while the
+            # donated write may still be queued — hand the transfer copies
+            pixels = {k: v.copy() for k, v in pixels.items()}
+            smalls = smalls.copy()
+            pos = pos.copy()
         dev_args = jax.device_put((pixels, smalls, jnp.asarray(pos)), self._device)
         self._bufs = self._write(self._bufs, *dev_args)
         for env in indices:

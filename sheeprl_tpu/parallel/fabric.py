@@ -599,6 +599,20 @@ class HostPlayerParams:
             object.__setattr__(self, name, landed)
         pipe.offer(value)
 
+    def poll_stream_attrs(self) -> None:
+        """Land any in-flight async param stream that has finished copying
+        (non-blocking). Players call this from the action path so params
+        still flip under sparse Ratio schedules, where the next
+        :meth:`stream_attr` call — the only other landing site — may be many
+        env steps away."""
+        pipes = getattr(self, "_stream_pipes", None)
+        if not pipes:
+            return
+        for name, pipe in pipes.items():
+            landed = pipe.poll()
+            if landed is not None:
+                object.__setattr__(self, name, landed)
+
     def flush_stream_attrs(self) -> None:
         """Land every in-flight async param stream NOW (blocking). Training
         loops call this after their last update so the closing evaluation /
