@@ -445,6 +445,8 @@ def main(fabric, cfg: Dict[str, Any]):
             select_buffer(state["rb"], rank, num_processes),
             isinstance(rb, DeviceReplayBuffer),
             seed=cfg.seed,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
         )
 
     # hard target-critic copy (reference dreamer_v2.py:691-693)

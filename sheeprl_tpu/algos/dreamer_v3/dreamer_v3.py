@@ -439,7 +439,11 @@ def main(fabric, cfg: Dict[str, Any]):
 
         # checkpoints from either buffer mode resume into this run's mode
         rb = adapt_restored_buffer(
-            select_buffer(state["rb"], rank, num_processes), use_device_rb, seed=cfg.seed
+            select_buffer(state["rb"], rank, num_processes),
+            use_device_rb,
+            seed=cfg.seed,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
         )
 
     # EMA update for the target critic (reference dreamer_v3.py:670-675)
@@ -509,9 +513,10 @@ def main(fabric, cfg: Dict[str, Any]):
     from sheeprl_tpu.utils.utils import SteadyStateProbe
 
     probe = SteadyStateProbe()
+    bench_batch = None  # one sampled batch kept for the post-run cost analysis
     for update in range(start_step, num_updates + 1):
         if update == learning_starts + 64:
-            probe.mark(policy_step)
+            probe.mark(policy_step, work=cumulative_per_rank_gradient_steps)
         policy_step += num_envs * num_processes
 
         with timer("Time/env_interaction_time"):
@@ -661,6 +666,8 @@ def main(fabric, cfg: Dict[str, Any]):
                             train_key,
                         )
                         cumulative_per_rank_gradient_steps += 1
+                        if probe.active and bench_batch is None:
+                            bench_batch = batch
                     if not timer.disabled:
                         # only when timing: wait so Time/train_time measures
                         # the chip, not the async dispatch
@@ -749,7 +756,30 @@ def main(fabric, cfg: Dict[str, Any]):
     # drain materializes the newest fence marker too — an actual device sync
     # on the tunnel (block_until_ready is advisory on the axon client)
     fence.drain()
-    probe.finish(policy_step)
+
+    def _bench_extra():
+        # per-train-step FLOPs for bench.py's MFU: one AOT cost-analysis
+        # compile, paid after the clock stopped
+        if bench_batch is None:
+            return {}
+        from sheeprl_tpu.utils.profiler import compiled_flops
+
+        flops = compiled_flops(
+            train_fn,
+            wm_params,
+            actor_params,
+            critic_params,
+            target_critic_params,
+            world_opt,
+            actor_opt,
+            critic_opt,
+            moments_state,
+            bench_batch,
+            key,
+        )
+        return {"flops_per_train_step": flops} if flops else {}
+
+    probe.finish(policy_step, work=cumulative_per_rank_gradient_steps, extra=_bench_extra)
     # land any in-flight async param stream so the final evaluation and
     # model registration use the last update's weights
     player.flush_stream_attrs()

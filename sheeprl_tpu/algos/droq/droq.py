@@ -30,7 +30,6 @@ from sheeprl_tpu.algos.droq.agent import (
 from sheeprl_tpu.algos.sac.loss import entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
-from sheeprl_tpu.data import ReplayBuffer
 from sheeprl_tpu.envs import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -220,18 +219,40 @@ def main(fabric, cfg: Dict[str, Any]):
         aggregator.add(k, "mean")
 
     buffer_size = cfg.buffer.size // int(num_envs * num_processes) if not cfg.dry_run else 1
-    rb = ReplayBuffer(
-        buffer_size,
-        num_envs,
+    # high replay ratio is DroQ's defining regime — exactly where re-staging
+    # every resampled batch over the link dominates; the HBM ring uploads each
+    # transition once and gathers on-chip (buffer.device=auto)
+    from sheeprl_tpu.data.device_buffer import (
+        DeviceReplayBuffer,
+        adapt_restored_buffer,
+        make_transition_replay,
+    )
+
+    rb = make_transition_replay(
+        cfg,
+        fabric,
+        observation_space,
+        stored_keys=mlp_keys,
+        actions_dim=action_space.shape,
+        buffer_size=buffer_size,
+        num_envs=num_envs,
         obs_keys=("observations",),
-        memmap=cfg.buffer.memmap,
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
         seed=cfg.seed,
+        store_next_obs=True,
     )
+    use_device_rb = isinstance(rb, DeviceReplayBuffer)
     if cfg.checkpoint.resume_from and cfg.buffer.checkpoint:
         from sheeprl_tpu.utils.checkpoint import select_buffer
 
-        rb = select_buffer(state["rb"], rank, num_processes)
+        rb = adapt_restored_buffer(
+            select_buffer(state["rb"], rank, num_processes),
+            use_device_rb,
+            seed=cfg.seed,
+            mode="transition",
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        )
 
     critic_fn, actor_fn = make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg)
 
@@ -314,16 +335,23 @@ def main(fabric, cfg: Dict[str, Any]):
                 # other SAC-family loops
                 qf_losses = []
                 for chunk_steps in gradient_step_chunks(per_rank_gradient_steps, cfg.algo):
-                    critic_sample = rb.sample(
-                        batch_size=per_rank_batch_size * fabric.local_device_count,
-                        n_samples=chunk_steps,
-                    )
-                    critic_data = {k: np.asarray(v, np.float32) for k, v in critic_sample.items()}
-                    if num_processes > 1:
-                        critic_data = fabric.make_global(critic_data, (None, fabric.data_axis))
+                    if use_device_rb:
+                        # on-chip gather: only the indices cross the link
+                        critic_data = rb.sample_transitions(
+                            batch_size=per_rank_batch_size * fabric.local_device_count,
+                            n_samples=chunk_steps,
+                        )
                     else:
-                        # async HBM staging ahead of the fused replay loop
-                        critic_data = to_device(critic_data)
+                        critic_sample = rb.sample(
+                            batch_size=per_rank_batch_size * fabric.local_device_count,
+                            n_samples=chunk_steps,
+                        )
+                        critic_data = {k: np.asarray(v, np.float32) for k, v in critic_sample.items()}
+                        if num_processes > 1:
+                            critic_data = fabric.make_global(critic_data, (None, fabric.data_axis))
+                        else:
+                            # async HBM staging ahead of the fused replay loop
+                            critic_data = to_device(critic_data)
                     with timer("Time/train_time"):
                         key, train_key = jax.random.split(key)
                         (
@@ -344,14 +372,22 @@ def main(fabric, cfg: Dict[str, Any]):
                     cumulative_per_rank_gradient_steps += chunk_steps
 
                 # then ONE actor+alpha update (reference droq.py:121-139)
-                actor_sample = rb.sample(batch_size=per_rank_batch_size * fabric.local_device_count)
-                actor_batch = {
-                    k: np.asarray(v, np.float32)[0] for k, v in actor_sample.items()
-                }  # [B, ...]
-                if num_processes > 1:
-                    actor_batch = fabric.make_global(actor_batch, (fabric.data_axis,))
+                if use_device_rb:
+                    actor_batch = {
+                        k: v[0]
+                        for k, v in rb.sample_transitions(
+                            batch_size=per_rank_batch_size * fabric.local_device_count
+                        ).items()
+                    }  # [B, ...]
                 else:
-                    actor_batch = to_device(actor_batch)
+                    actor_sample = rb.sample(batch_size=per_rank_batch_size * fabric.local_device_count)
+                    actor_batch = {
+                        k: np.asarray(v, np.float32)[0] for k, v in actor_sample.items()
+                    }  # [B, ...]
+                    if num_processes > 1:
+                        actor_batch = fabric.make_global(actor_batch, (fabric.data_axis,))
+                    else:
+                        actor_batch = to_device(actor_batch)
                 with timer("Time/train_time"):
                     key, train_key = jax.random.split(key)
                     (

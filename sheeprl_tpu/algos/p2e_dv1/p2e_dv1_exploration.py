@@ -505,6 +505,8 @@ def main(fabric, cfg: Dict[str, Any]):
             select_buffer(state["rb"], rank, num_processes),
             isinstance(rb, DeviceReplayBuffer),
             seed=cfg.seed,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
         )
 
     train_fn = make_train_fn(

@@ -38,7 +38,6 @@ from sheeprl_tpu.algos.sac.agent import (
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
-from sheeprl_tpu.data import ReplayBuffer
 from sheeprl_tpu.envs import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -229,18 +228,40 @@ def main(fabric, cfg: Dict[str, Any]):
         aggregator.add(k, "mean")
 
     buffer_size = cfg.buffer.size // int(num_envs * num_processes) if not cfg.dry_run else 1
-    rb = ReplayBuffer(
-        buffer_size,
-        num_envs,
+    # HBM replay ring when the chip allows it (buffer.device=auto): each
+    # transition is uploaded once, every high-replay-ratio resample is an
+    # on-chip gather — the same trade the Dreamer loops made in round 3
+    from sheeprl_tpu.data.device_buffer import (
+        DeviceReplayBuffer,
+        adapt_restored_buffer,
+        make_transition_replay,
+    )
+
+    rb = make_transition_replay(
+        cfg,
+        fabric,
+        observation_space,
+        stored_keys=mlp_keys,
+        actions_dim=action_space.shape,
+        buffer_size=buffer_size,
+        num_envs=num_envs,
         obs_keys=("observations",),
-        memmap=cfg.buffer.memmap,
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
         seed=cfg.seed,
+        store_next_obs=not cfg.buffer.sample_next_obs,
     )
+    use_device_rb = isinstance(rb, DeviceReplayBuffer)
     if cfg.checkpoint.resume_from and cfg.buffer.checkpoint:
         from sheeprl_tpu.utils.checkpoint import select_buffer
 
-        rb = select_buffer(state["rb"], rank, num_processes)
+        rb = adapt_restored_buffer(
+            select_buffer(state["rb"], rank, num_processes),
+            use_device_rb,
+            seed=cfg.seed,
+            mode="transition",
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        )
 
     train_fn = make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg)
 
@@ -326,19 +347,27 @@ def main(fabric, cfg: Dict[str, Any]):
                 # [G, B_total, ...] so the chunk's gradient loop runs in one
                 # jit; each process samples its share of the global batch and
                 # the shards assemble into one global array over the mesh
-                sample = rb.sample(
-                    batch_size=per_rank_batch_size * fabric.local_device_count,
-                    n_samples=chunk_steps,
-                    sample_next_obs=cfg.buffer.sample_next_obs,
-                )
-                data = {k: np.asarray(v, np.float32) for k, v in sample.items()}
-                if num_processes > 1:
-                    data = fabric.make_global(data, (None, fabric.data_axis))
+                if use_device_rb:
+                    # on-chip gather: only the indices cross the link
+                    data = rb.sample_transitions(
+                        batch_size=per_rank_batch_size * fabric.local_device_count,
+                        n_samples=chunk_steps,
+                        sample_next_obs=cfg.buffer.sample_next_obs,
+                    )
                 else:
-                    # async HBM staging: device_put returns immediately and
-                    # XLA orders the copy before the fused train step reads it
-                    from sheeprl_tpu.data.buffers import to_device
-                    data = to_device(data)
+                    sample = rb.sample(
+                        batch_size=per_rank_batch_size * fabric.local_device_count,
+                        n_samples=chunk_steps,
+                        sample_next_obs=cfg.buffer.sample_next_obs,
+                    )
+                    data = {k: np.asarray(v, np.float32) for k, v in sample.items()}
+                    if num_processes > 1:
+                        data = fabric.make_global(data, (None, fabric.data_axis))
+                    else:
+                        # async HBM staging: device_put returns immediately and
+                        # XLA orders the copy before the fused train step reads it
+                        from sheeprl_tpu.data.buffers import to_device
+                        data = to_device(data)
                 with timer("Time/train_time"):
                     key, train_key = jax.random.split(key)
                     (

@@ -39,7 +39,6 @@ from sheeprl_tpu.algos.sac_ae.agent import (
 )
 from sheeprl_tpu.algos.sac_ae.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
-from sheeprl_tpu.data import ReplayBuffer
 from sheeprl_tpu.envs import make_env
 from sheeprl_tpu.parallel.shard_map import shard_map
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -332,18 +331,41 @@ def main(fabric, cfg: Dict[str, Any]):
         aggregator.add(k, "mean")
 
     buffer_size = cfg.buffer.size // int(num_envs * num_processes) if not cfg.dry_run else 1
-    rb = ReplayBuffer(
-        buffer_size,
-        num_envs,
+    # the pixel workload is where the HBM ring pays most: at replay ratio 1.0
+    # the host buffer re-uploads every sampled [G, B] pixel batch over the
+    # link; the ring uploads each frame once and gathers on-chip
+    # (buffer.device=auto)
+    from sheeprl_tpu.data.device_buffer import (
+        DeviceReplayBuffer,
+        adapt_restored_buffer,
+        make_transition_replay,
+    )
+
+    rb = make_transition_replay(
+        cfg,
+        fabric,
+        observation_space,
+        stored_keys=obs_keys,
+        actions_dim=action_space.shape,
+        buffer_size=buffer_size,
+        num_envs=num_envs,
         obs_keys=tuple(obs_keys) + tuple(f"next_{k}" for k in obs_keys),
-        memmap=cfg.buffer.memmap,
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
         seed=cfg.seed,
+        store_next_obs=True,
     )
+    use_device_rb = isinstance(rb, DeviceReplayBuffer)
     if cfg.checkpoint.resume_from and cfg.buffer.checkpoint:
         from sheeprl_tpu.utils.checkpoint import select_buffer
 
-        rb = select_buffer(state["rb"], rank, num_processes)
+        rb = adapt_restored_buffer(
+            select_buffer(state["rb"], rank, num_processes),
+            use_device_rb,
+            seed=cfg.seed,
+            mode="transition",
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        )
 
     train_fn = make_train_fn(fabric, agent, actor_tx, qf_tx, alpha_tx, encoder_tx, decoder_tx, cfg)
 
@@ -434,29 +456,43 @@ def main(fabric, cfg: Dict[str, Any]):
             # post-warmup call repays the whole warmup debt in one G
             chunk_metrics = []
             for chunk_steps in gradient_step_chunks(per_rank_gradient_steps, cfg.algo):
-                sample = rb.sample(
-                    batch_size=per_rank_batch_size * fabric.local_device_count,
-                    n_samples=chunk_steps,
-                )
-                data = {}
-                for k, v in sample.items():
-                    if k in cnn_keys or (k.startswith("next_") and k[5:] in cnn_keys):
-                        # [G, B, S, H, W, C] or [G, B, H, W, C] -> fold stack;
-                        # pixels STAY uint8 across the link (4x fewer bytes —
-                        # the in-graph /255 normalization promotes to f32)
-                        v = np.asarray(v)
-                        if v.ndim == 6:
+                if use_device_rb:
+                    # on-chip gather (only indices cross the link); the
+                    # frame-stack fold happens on device — storage stays raw
+                    # so checkpoints swap between buffer modes
+                    data = {}
+                    for k, v in rb.sample_transitions(
+                        batch_size=per_rank_batch_size * fabric.local_device_count,
+                        n_samples=chunk_steps,
+                    ).items():
+                        if (k in cnn_keys or (k.startswith("next_") and k[5:] in cnn_keys)) and v.ndim == 6:
                             g, b, s, h, w, c = v.shape
-                            v = np.moveaxis(v, 2, 4).reshape(g, b, h, w, s * c)
-                        data[k] = v if v.dtype == np.uint8 else v.astype(np.float32)
-                    else:
-                        data[k] = np.asarray(v, np.float32)
-                if num_processes > 1:
-                    data = fabric.make_global(data, (None, fabric.data_axis))
+                            v = jnp.moveaxis(v, 2, 4).reshape(g, b, h, w, s * c)
+                        data[k] = v
                 else:
-                    # async HBM staging: overlap the [G, B] transfer with dispatch
-                    from sheeprl_tpu.data.buffers import to_device
-                    data = to_device(data)
+                    sample = rb.sample(
+                        batch_size=per_rank_batch_size * fabric.local_device_count,
+                        n_samples=chunk_steps,
+                    )
+                    data = {}
+                    for k, v in sample.items():
+                        if k in cnn_keys or (k.startswith("next_") and k[5:] in cnn_keys):
+                            # [G, B, S, H, W, C] or [G, B, H, W, C] -> fold stack;
+                            # pixels STAY uint8 across the link (4x fewer bytes —
+                            # the in-graph /255 normalization promotes to f32)
+                            v = np.asarray(v)
+                            if v.ndim == 6:
+                                g, b, s, h, w, c = v.shape
+                                v = np.moveaxis(v, 2, 4).reshape(g, b, h, w, s * c)
+                            data[k] = v if v.dtype == np.uint8 else v.astype(np.float32)
+                        else:
+                            data[k] = np.asarray(v, np.float32)
+                    if num_processes > 1:
+                        data = fabric.make_global(data, (None, fabric.data_axis))
+                    else:
+                        # async HBM staging: overlap the [G, B] transfer with dispatch
+                        from sheeprl_tpu.data.buffers import to_device
+                        data = to_device(data)
                 with timer("Time/train_time"):
                     key, train_key = jax.random.split(key)
                     (

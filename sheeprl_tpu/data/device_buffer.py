@@ -162,6 +162,19 @@ class DeviceReplayBuffer:
                 out[k] = jnp.swapaxes(g, 0, 1)
             return out
 
+        obs_keys = self._obs_keys
+
+        def gather_transitions(bufs, env_idx, time_idx):
+            # flat transition gather: env_idx/time_idx [N] -> values [N, ...]
+            return {k: b[env_idx, time_idx] for k, b in bufs.items()}
+
+        def gather_transitions_next(bufs, env_idx, time_idx, next_idx):
+            out = {k: b[env_idx, time_idx] for k, b in bufs.items()}
+            for k in obs_keys:
+                if k in bufs:
+                    out[f"next_{k}"] = bufs[k][env_idx, next_idx]
+            return out
+
         def amend(bufs, env_i, slot, terminated, truncated, is_first):
             out = dict(bufs)
             for k, v in (("terminated", terminated), ("truncated", truncated), ("is_first", is_first)):
@@ -181,6 +194,8 @@ class DeviceReplayBuffer:
             self._write = jax.jit(write, donate_argnums=0)
             self._amend = jax.jit(amend, donate_argnums=0)
         self._gather = jax.jit(gather)
+        self._gather_transitions = jax.jit(gather_transitions)
+        self._gather_transitions_next = jax.jit(gather_transitions_next)
 
     # ------------------------------------------------------------------ write
     def add(
@@ -324,6 +339,65 @@ class DeviceReplayBuffer:
             )
             yield self._gather(self._bufs, ei, ti)
 
+    # ------------------------------------------------- transition sampling
+    def _valid_items(self, env: int, sample_next_obs: bool) -> np.ndarray:
+        """Item indices of one env whose (transition) does not straddle its
+        write cursor — the per-env mirror of ``ReplayBuffer._valid_idxes``
+        (``data/buffers.py:189-214``): when ``sample_next_obs`` the slot just
+        before the cursor is excluded too (its successor is the oldest slot,
+        about to be overwritten)."""
+        pos = int(self._pos[env])
+        end = pos - 1 if sample_next_obs else pos
+        if self._full[env]:
+            second_end = self._buffer_size if end >= 0 else self._buffer_size + end
+            return np.concatenate(
+                [np.arange(0, max(end, 0)), np.arange(pos, second_end)]
+            ).astype(np.intp)
+        return np.arange(0, max(end, 0), dtype=np.intp)
+
+    def sample_transitions(
+        self,
+        batch_size: int,
+        n_samples: int = 1,
+        sample_next_obs: bool = False,
+    ) -> Dict[str, jax.Array]:
+        """Uniform transition sample, shape ``[n_samples, batch_size, ...]``,
+        device-resident — the SAC-family counterpart of ``sample_batches``:
+        same output contract as host ``ReplayBuffer.sample`` (uniform env,
+        uniform valid item, ``next_<k>`` at item+1 when ``sample_next_obs``),
+        but only the int32 indices cross the host→device link; the batch
+        bytes move HBM→HBM inside one jitted gather."""
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(
+                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
+            )
+        if self._bufs is None:
+            raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
+        n = batch_size * n_samples
+        env_idx = self._rng.integers(0, self._n_envs, (n,), dtype=np.intp)
+        items = np.empty((n,), np.intp)
+        for env in np.unique(env_idx):
+            valid = self._valid_items(int(env), sample_next_obs)
+            if len(valid) == 0:
+                raise RuntimeError(
+                    "You want to sample the next observations, but not enough samples have been "
+                    f"added to env {env}. Make sure that at least two samples are added."
+                    if sample_next_obs
+                    else "No sample has been added to the buffer. Please add at least one sample "
+                    "calling 'self.add()'"
+                )
+            rows = np.nonzero(env_idx == env)[0]
+            items[rows] = valid[self._rng.integers(0, len(valid), size=(len(rows),), dtype=np.intp)]
+        ei, ti = jax.device_put(
+            (env_idx.astype(np.int32), items.astype(np.int32)), self._device
+        )
+        if sample_next_obs:
+            ni = jax.device_put(((items + 1) % self._buffer_size).astype(np.int32), self._device)
+            flat = self._gather_transitions_next(self._bufs, ei, ti, ni)
+        else:
+            flat = self._gather_transitions(self._bufs, ei, ti)
+        return {k: v.reshape(n_samples, batch_size, *v.shape[1:]) for k, v in flat.items()}
+
     def flag_last_truncated(self) -> Optional[np.ndarray]:
         """Set ``truncated=1`` on every env's most recent step (checkpoint
         self-consistency — reference ``callback.py:87-142``) and return the
@@ -383,6 +457,7 @@ class DeviceReplayBuffer:
         self._device = None  # re-pinned by the restoring process
         self._bufs = None
         self._write = self._gather = self._amend = None
+        self._gather_transitions = self._gather_transitions_next = None
         self._pending_arrays = state["arrays"]
 
     def restore_to_device(self, device: Optional[jax.Device] = None) -> "DeviceReplayBuffer":
@@ -433,6 +508,57 @@ class DeviceReplayBuffer:
         out.restore_to_device(device)
         return out
 
+    @classmethod
+    def from_transition_host_buffer(
+        cls, host_rb: Any, device: Optional[jax.Device] = None, seed: Optional[int] = None
+    ) -> "DeviceReplayBuffer":
+        """Bulk-load a plain ``ReplayBuffer`` (SAC-family checkpoint,
+        ``[size, n_envs, ...]`` arrays with one global cursor) into HBM."""
+        arrays = {k: np.asarray(v).swapaxes(0, 1) for k, v in host_rb.buffer.items()}
+        out = cls(
+            host_rb.buffer_size,
+            n_envs=host_rb.n_envs,
+            obs_keys=host_rb._obs_keys,
+            device=device,
+            seed=seed,
+        )
+        out._pos = np.full((host_rb.n_envs,), host_rb._pos, np.int64)
+        out._full = np.full((host_rb.n_envs,), host_rb.full, bool)
+        out._pending_arrays = {
+            k: (v if v.dtype == np.uint8 else v.astype(np.float32)) for k, v in arrays.items()
+        }
+        smalls = [k for k in sorted(arrays) if arrays[k].dtype != np.uint8]
+        offset = 0
+        out._small_slices = {}
+        for k in smalls:
+            item = tuple(arrays[k].shape[2:])
+            width = int(np.prod(item)) if item else 1
+            out._small_slices[k] = (offset, offset + width, item)
+            offset += width
+        out._small_keys = tuple(smalls)
+        out._pixel_keys = tuple(k for k in sorted(arrays) if arrays[k].dtype == np.uint8)
+        out.restore_to_device(device)
+        return out
+
+    def to_transition_host_buffer(self, memmap: bool = False, memmap_dir: Any = None) -> Any:
+        """Materialize as a stock plain ``ReplayBuffer`` (the SAC-family host
+        layout) — the cursors advance in lockstep in those loops, so env 0's
+        cursor is the global one."""
+        from sheeprl_tpu.data.buffers import ReplayBuffer
+
+        host = ReplayBuffer(
+            self._buffer_size,
+            n_envs=self._n_envs,
+            obs_keys=self._obs_keys,
+            memmap=memmap,
+            memmap_dir=memmap_dir,
+        )
+        arrays = self.host_arrays()
+        host.add({k: v.swapaxes(0, 1) for k, v in arrays.items()})
+        host._pos = int(self._pos[0])
+        host._full = bool(self._full[0])
+        return host
+
     def ring_bytes(self) -> int:
         """Current HBM footprint of the allocated ring."""
         if self._bufs is None:
@@ -479,8 +605,36 @@ def estimate_ring_bytes(
     return per_step * int(buffer_size) * int(n_envs)
 
 
+def estimate_transition_bytes(
+    obs_space: Any,
+    keys: Sequence[str],
+    actions_dim: Sequence[int],
+    buffer_size: int,
+    n_envs: int,
+    store_next_obs: bool,
+) -> int:
+    """Upper-bound HBM estimate for a SAC-style transition step dict: the
+    stored obs keys (doubled when the loop stores explicit next obs), actions
+    and 3 scalar flags."""
+    per_step = 0
+    for k in keys:
+        space = obs_space[k]
+        itemsize = 1 if np.issubdtype(space.dtype, np.uint8) else 4
+        per_step += int(np.prod(space.shape)) * itemsize
+    if store_next_obs:
+        per_step *= 2
+    per_step += (int(np.sum(actions_dim)) + 3) * 4
+    return per_step * int(buffer_size) * int(n_envs)
+
+
 def resolve_device_buffer(
-    cfg: Any, fabric: Any, obs_space: Any, actions_dim: Sequence[int], buffer_size: int, n_envs: int
+    cfg: Any,
+    fabric: Any,
+    obs_space: Any,
+    actions_dim: Sequence[int],
+    buffer_size: int,
+    n_envs: int,
+    estimated_bytes: Optional[int] = None,
 ) -> bool:
     """Decide whether this run keeps replay in HBM.
 
@@ -504,7 +658,11 @@ def resolve_device_buffer(
         raise ValueError(f"unknown buffer.device spec {spec!r}; use auto/true/false")
     if not supported or jax.default_backend() == "cpu":
         return False
-    est = estimate_ring_bytes(obs_space, actions_dim, buffer_size, n_envs)
+    est = (
+        estimated_bytes
+        if estimated_bytes is not None
+        else estimate_ring_bytes(obs_space, actions_dim, buffer_size, n_envs)
+    )
     return est <= int(cfg.buffer.get("device_max_bytes", 8_000_000_000))
 
 
@@ -537,13 +695,70 @@ def make_sequential_replay(
     )
 
 
-def adapt_restored_buffer(rb: Any, want_device: bool, seed: Optional[int] = None) -> Any:
+def make_transition_replay(
+    cfg: Any,
+    fabric: Any,
+    obs_space: Any,
+    stored_keys: Sequence[str],
+    actions_dim: Sequence[int],
+    buffer_size: int,
+    num_envs: int,
+    obs_keys: Sequence[str],
+    memmap_dir: Any,
+    seed: Optional[int],
+    store_next_obs: bool,
+) -> Any:
+    """Construct the uniform-transition replay for a SAC-family loop: the HBM
+    ring (sampled via :meth:`DeviceReplayBuffer.sample_transitions`) when
+    :func:`resolve_device_buffer` allows it, else the stock host
+    ``ReplayBuffer``. ``stored_keys`` are the observation-space keys the loop
+    actually writes (for the footprint estimate); ``obs_keys`` the step-dict
+    keys that get a ``next_`` twin under ``sample_next_obs``."""
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    est = estimate_transition_bytes(
+        obs_space, stored_keys, actions_dim, buffer_size, num_envs, store_next_obs
+    )
+    if resolve_device_buffer(
+        cfg, fabric, obs_space, actions_dim, buffer_size, num_envs, estimated_bytes=est
+    ):
+        return DeviceReplayBuffer(buffer_size, n_envs=num_envs, obs_keys=obs_keys, seed=seed)
+    return ReplayBuffer(
+        buffer_size,
+        num_envs,
+        obs_keys=obs_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=memmap_dir,
+        seed=seed,
+    )
+
+
+def adapt_restored_buffer(
+    rb: Any,
+    want_device: bool,
+    seed: Optional[int] = None,
+    mode: str = "sequence",
+    memmap: bool = False,
+    memmap_dir: Any = None,
+) -> Any:
     """Convert a checkpoint-restored replay buffer to this run's mode —
-    checkpoints from either buffer mode resume into either."""
-    from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer
+    checkpoints from either buffer mode resume into either. ``mode`` names
+    the host layout: ``sequence`` (Dreamer family,
+    ``EnvIndependentReplayBuffer``) or ``transition`` (SAC family, plain
+    ``ReplayBuffer``). ``memmap``/``memmap_dir`` apply when a device
+    checkpoint materializes as a host buffer — pass the run's
+    ``cfg.buffer.memmap`` so a pixel ring does not land in host RAM that a
+    fresh run of the same config would have memmapped."""
+    from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, ReplayBuffer
 
     if isinstance(rb, DeviceReplayBuffer):
-        return rb.restore_to_device() if want_device else rb.to_host_buffer()
+        if want_device:
+            return rb.restore_to_device()
+        if mode == "sequence":
+            return rb.to_host_buffer(memmap=memmap, memmap_dir=memmap_dir)
+        return rb.to_transition_host_buffer(memmap=memmap, memmap_dir=memmap_dir)
     if want_device and isinstance(rb, EnvIndependentReplayBuffer):
         return DeviceReplayBuffer.from_host_buffer(rb, seed=seed)
+    if want_device and isinstance(rb, ReplayBuffer):
+        return DeviceReplayBuffer.from_transition_host_buffer(rb, seed=seed)
     return rb

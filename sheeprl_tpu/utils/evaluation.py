@@ -1,0 +1,68 @@
+"""Shared evaluation scaffold for the Dreamer family.
+
+Each of the six Dreamer/P2E evaluation entrypoints (reference:
+``sheeprl/algos/{dreamer_v1,dreamer_v2,dreamer_v3,p2e_dv1,p2e_dv2,p2e_dv3}/evaluate.py``)
+does the same dance — open one env to read the spaces, rebuild the agent from
+the checkpointed model states, run the shared ``test`` rollout, finalize the
+logger — differing only in which ``build_agent`` to call and which checkpoint
+keys hold the (task) models. This module holds the dance once; the per-algo
+``evaluate.py`` files reduce to a registration plus that pair.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+import gymnasium as gym
+
+from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+
+
+def action_dims(action_space: gym.Space) -> Tuple[Tuple[int, ...], bool]:
+    """``(actions_dim, is_continuous)`` for a Box/Discrete/MultiDiscrete
+    action space — the tuple every ``build_agent`` consumes."""
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    return actions_dim, is_continuous
+
+
+def dreamer_family_evaluate(
+    fabric: Any,
+    cfg: Dict[str, Any],
+    state: Dict[str, Any],
+    build_agent: Callable[..., Any],
+    test_fn: Callable[..., None],
+    state_keys: Sequence[str],
+) -> None:
+    """Rebuild a Dreamer-family agent from checkpoint ``state[state_keys]``
+    and run the algo's ``test`` rollout. ``build_agent`` must accept
+    ``(fabric, actions_dim, is_continuous, cfg, observation_space, *states)``
+    and return the player last — the contract all six agents share."""
+    log_dir = get_log_dir(cfg)
+    logger = get_logger(cfg, log_dir)
+    fabric.logger = logger
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    actions_dim, is_continuous = action_dims(action_space)
+    env.close()
+
+    *_, player = build_agent(
+        fabric,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        *[state[k] for k in state_keys],
+    )
+    test_fn(player, fabric, cfg, log_dir)
+    logger.finalize()

@@ -85,3 +85,18 @@ def test_droq_evaluate_roundtrip(tmp_path, monkeypatch):
     from sheeprl_tpu.cli import evaluation
 
     evaluation([f"checkpoint_path={ckpt}"])
+
+
+def test_droq_device_buffer(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = [a for a in droq_args(tmp_path) if a != "dry_run=True"]
+    run(
+        args
+        + [
+            "fabric.devices=1",
+            "buffer.device=True",
+            "buffer.size=64",
+            "algo.total_steps=8",
+            "algo.learning_starts=2",
+        ]
+    )

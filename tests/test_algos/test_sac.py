@@ -81,3 +81,48 @@ def test_sac_evaluate_roundtrip(tmp_path, monkeypatch):
     from sheeprl_tpu.cli import evaluation
 
     evaluation([f"checkpoint_path={ckpt}"])
+
+
+def test_sac_device_buffer(tmp_path, monkeypatch):
+    # HBM replay ring on the CPU mesh: a few real updates + a cross-mode
+    # resume (device ckpt -> host buffer run)
+    monkeypatch.chdir(tmp_path)
+    args = [a for a in sac_args(tmp_path) if a != "dry_run=True"]
+    run(
+        args
+        + [
+            "fabric.devices=1",
+            "buffer.device=True",
+            "buffer.size=64",
+            "algo.total_steps=8",
+            "algo.learning_starts=2",
+        ]
+    )
+    (ckpt,) = find_checkpoints(tmp_path)
+    run(
+        args
+        + [
+            "fabric.devices=1",
+            "buffer.device=False",
+            "buffer.size=64",
+            "algo.total_steps=16",
+            "algo.learning_starts=2",
+            f"checkpoint.resume_from={ckpt}",
+        ]
+    )
+
+
+def test_sac_device_buffer_sample_next_obs(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = [a for a in sac_args(tmp_path) if a != "dry_run=True" and "learning_starts" not in a]
+    run(
+        args
+        + [
+            "fabric.devices=1",
+            "buffer.device=True",
+            "buffer.sample_next_obs=True",
+            "buffer.size=64",
+            "algo.total_steps=8",
+            "algo.learning_starts=4",
+        ]
+    )

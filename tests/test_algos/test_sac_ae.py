@@ -68,3 +68,20 @@ def test_sac_ae_resume_and_evaluate(tmp_path, monkeypatch):
     from sheeprl_tpu.cli import evaluation
 
     evaluation([f"checkpoint_path={ckpt}"])
+
+
+def test_sac_ae_device_buffer_frame_stack(tmp_path, monkeypatch):
+    # HBM ring with raw frame-stacked pixel storage + on-device stack fold
+    monkeypatch.chdir(tmp_path)
+    args = [a for a in sac_ae_args(tmp_path) if a not in ("dry_run=True", "env.frame_stack=1")]
+    run(
+        args
+        + [
+            "fabric.devices=1",
+            "buffer.device=True",
+            "env.frame_stack=2",
+            "buffer.size=64",
+            "algo.total_steps=8",
+            "algo.learning_starts=2",
+        ]
+    )

@@ -126,3 +126,129 @@ def test_estimate_ring_bytes():
     est = estimate_ring_bytes(space, actions_dim=(4,), buffer_size=100, n_envs=2)
     per_step = 64 * 64 * 3 + 7 * 4 + (4 + 4) * 4
     assert est == per_step * 100 * 2
+
+
+# ---------------------------------------------------- transition mode (SAC)
+
+
+def _sac_step(rb, t, n_envs=3):
+    rb.add(
+        {
+            "observations": np.full((1, n_envs, 4), t, np.float32),
+            "next_observations": np.full((1, n_envs, 4), t + 1, np.float32),
+            "actions": np.full((1, n_envs, 2), t, np.float32),
+            "rewards": np.full((1, n_envs, 1), t, np.float32),
+            "terminated": np.zeros((1, n_envs, 1), np.float32),
+            "truncated": np.zeros((1, n_envs, 1), np.float32),
+        }
+    )
+
+
+def test_sample_transitions_layout_and_consistency():
+    rb = DeviceReplayBuffer(16, n_envs=3, obs_keys=("observations",), seed=0)
+    for t in range(10):
+        _sac_step(rb, t)
+    data = rb.sample_transitions(batch_size=6, n_samples=4)
+    assert data["observations"].shape == (4, 6, 4)
+    assert data["actions"].shape == (4, 6, 2)
+    # each drawn transition is internally consistent: obs == rewards == t
+    obs = np.asarray(data["observations"])[..., 0]
+    rew = np.asarray(data["rewards"])[..., 0]
+    nxt = np.asarray(data["next_observations"])[..., 0]
+    assert np.array_equal(obs, rew) and np.array_equal(nxt, obs + 1)
+
+
+def test_sample_transitions_next_obs_gather():
+    rb = DeviceReplayBuffer(16, n_envs=2, obs_keys=("observations",), seed=0)
+    for t in range(12):
+        rb.add(
+            {
+                "observations": np.full((1, 2, 4), t, np.float32),
+                "actions": np.zeros((1, 2, 2), np.float32),
+                "rewards": np.full((1, 2, 1), t, np.float32),
+                "terminated": np.zeros((1, 2, 1), np.float32),
+                "truncated": np.zeros((1, 2, 1), np.float32),
+            }
+        )
+    data = rb.sample_transitions(batch_size=8, n_samples=2, sample_next_obs=True)
+    obs = np.asarray(data["observations"])[..., 0]
+    nxt = np.asarray(data["next_observations"])[..., 0]
+    assert np.array_equal(nxt, obs + 1)
+
+
+def test_sample_transitions_wraparound_validity():
+    # after wrapping, samples never come from beyond the stored range and
+    # sample_next_obs never pairs a transition with the overwritten oldest slot
+    rb = DeviceReplayBuffer(8, n_envs=1, obs_keys=("observations",), seed=1)
+    for t in range(20):
+        rb.add(
+            {
+                "observations": np.full((1, 1, 1), t, np.float32),
+                "rewards": np.full((1, 1, 1), t, np.float32),
+            }
+        )
+    assert all(rb.full)
+    data = rb.sample_transitions(batch_size=64, n_samples=1, sample_next_obs=True)
+    obs = np.asarray(data["observations"]).reshape(-1)
+    nxt = np.asarray(data["next_observations"]).reshape(-1)
+    assert obs.min() >= 12 and obs.max() <= 18  # stored range is 12..19; 19's next wrapped
+    assert np.array_equal(nxt, obs + 1)
+
+
+def test_sample_transitions_errors_match_host_contract():
+    rb = DeviceReplayBuffer(8, n_envs=1, obs_keys=("observations",), seed=0)
+    with pytest.raises(RuntimeError, match="has not been initialized"):
+        rb.sample_transitions(batch_size=2)
+    rb.add({"observations": np.zeros((1, 1, 1), np.float32)})
+    with pytest.raises(RuntimeError, match="at least two samples"):
+        rb.sample_transitions(batch_size=2, sample_next_obs=True)
+    with pytest.raises(ValueError, match="must be both greater than 0"):
+        rb.sample_transitions(batch_size=0)
+
+
+def test_transition_host_buffer_roundtrip():
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    rb = DeviceReplayBuffer(16, n_envs=2, obs_keys=("observations",), seed=0)
+    for t in range(10):
+        _sac_step(rb, t, n_envs=2)
+    host = rb.to_transition_host_buffer()
+    assert isinstance(host, ReplayBuffer)
+    assert host._pos == 10 and not host.full
+    assert np.array_equal(
+        np.asarray(host.buffer["rewards"]).swapaxes(0, 1), rb.host_arrays()["rewards"]
+    )
+    back = DeviceReplayBuffer.from_transition_host_buffer(host)
+    assert back._pos.tolist() == [10, 10]
+    assert np.array_equal(back.host_arrays()["rewards"], rb.host_arrays()["rewards"])
+    # adapt_restored_buffer in transition mode, both directions
+    assert isinstance(
+        adapt_restored_buffer(host, want_device=True, mode="transition"), DeviceReplayBuffer
+    )
+    import pickle
+
+    host2 = adapt_restored_buffer(
+        pickle.loads(pickle.dumps(rb)), want_device=False, mode="transition"
+    )
+    assert isinstance(host2, ReplayBuffer)
+    assert np.array_equal(
+        np.asarray(host2.buffer["rewards"]).swapaxes(0, 1), rb.host_arrays()["rewards"]
+    )
+
+
+def test_estimate_transition_bytes():
+    import gymnasium as gym
+
+    from sheeprl_tpu.data.device_buffer import estimate_transition_bytes
+
+    space = gym.spaces.Dict(
+        {
+            "rgb": gym.spaces.Box(0, 255, (32, 32, 3), np.uint8),
+            "state": gym.spaces.Box(-1, 1, (5,), np.float32),
+        }
+    )
+    est = estimate_transition_bytes(
+        space, ["rgb", "state"], actions_dim=(2,), buffer_size=10, n_envs=2, store_next_obs=True
+    )
+    per_step = (32 * 32 * 3 + 5 * 4) * 2 + (2 + 3) * 4
+    assert est == per_step * 10 * 2
