@@ -36,6 +36,47 @@ _PPO_TORCH_CPU_SPS = 12912.91
 DV3_STEPS = 2048
 PPO_STEPS = 32768
 
+def link_probe(tag: str) -> dict:
+    """Contention probe for the time-shared tunnel chip: tiny-op round trip
+    plus a fixed on-device matmul chain. Emitted alongside the bench numbers
+    so a slow run is attributable at read time (link stall vs chip
+    time-sharing vs framework regression) — BASELINE.md round-3/4 variance
+    notes. All arrays are created on-device (no upload) and every chain
+    output is kept referenced until the final materializing fetch (the axon
+    client corrupts state when outputs of queued executions are dropped)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.utils.profiler import tiny_op_rtt_seconds
+
+    dev = jax.devices()[0]
+    out = {"tag": tag, "device": dev.device_kind, "t": round(time.time(), 1)}
+    rtt = tiny_op_rtt_seconds()
+    out["rtt_ms"] = round(rtt * 1e3, 1)
+
+    # 64 chained 4096^3 bf16 matmuls ≈ 17.6 TFLOP — ~90 ms at v5e peak, so
+    # device time dominates the one closing fetch; a = full(1/4096) is a
+    # fixed point of a@a, keeping the chain finite in bf16
+    n, chain = 4096, 64
+    make = jax.jit(lambda: jnp.full((n, n), 1.0 / n, jnp.bfloat16))
+    mm = jax.jit(lambda a: a @ a)
+    a = make()
+    np.asarray(mm(a)[:1, :1].astype(jnp.float32))  # compile + warm
+    keep = [a]
+    t0 = time.perf_counter()
+    r = a
+    for _ in range(chain):
+        r = mm(r)
+        keep.append(r)
+    np.asarray(r[:1, :1].astype(jnp.float32))
+    dt = time.perf_counter() - t0
+    device_s = max(dt - rtt, 1e-9)
+    out["matmul_chain_ms"] = round(dt * 1e3, 1)
+    out["matmul_tflops"] = round(2 * n**3 * chain / device_s / 1e12, 1)
+    return out
+
 
 def _dv3_args(total_steps: int, learning_starts: int = 512):
     return [
@@ -67,7 +108,7 @@ def _dv3_args(total_steps: int, learning_starts: int = 512):
     ]
 
 
-def bench_dv3() -> float:
+def bench_dv3() -> dict:
     import os
     import tempfile
 
@@ -85,7 +126,7 @@ def bench_dv3() -> float:
         finally:
             os.environ.pop("SHEEPRL_TPU_BENCH_JSON", None)
         rec = _read_probe(probe, "dreamer_v3")
-    return rec["steps"] / rec["seconds"]
+    return rec
 
 
 def _read_probe(path, workload):
@@ -132,28 +173,50 @@ def bench_ppo() -> float:
 
 
 def main() -> None:
-    dv3_sps = bench_dv3()
+    import jax
+
+    probes = [link_probe("before")]
+    dv3 = bench_dv3()
+    probes.append(link_probe("mid"))
+    dv3_sps = dv3["steps"] / dv3["seconds"]
     ppo_sps = bench_ppo()
-    print(
-        json.dumps(
-            {
-                "metric": "dreamer_v3_env_steps_per_sec_per_chip",
-                "value": round(dv3_sps, 2),
-                "unit": "steps/sec",
-                "vs_baseline": round(dv3_sps / _DV3_TORCH_CPU_SPS, 3),
-                "secondary": {
-                    "metric": "ppo_cartpole_env_steps_per_sec",
-                    "value": round(ppo_sps, 2),
-                    "unit": "steps/sec",
-                    **(
-                        {"vs_baseline": round(ppo_sps / _PPO_TORCH_CPU_SPS, 3)}
-                        if _PPO_TORCH_CPU_SPS
-                        else {}
-                    ),
-                },
-            }
-        )
-    )
+    probes.append(link_probe("after"))
+
+    record = {
+        "metric": "dreamer_v3_env_steps_per_sec_per_chip",
+        "value": round(dv3_sps, 2),
+        "unit": "steps/sec",
+        "vs_baseline": round(dv3_sps / _DV3_TORCH_CPU_SPS, 3),
+        "secondary": {
+            "metric": "ppo_cartpole_env_steps_per_sec",
+            "value": round(ppo_sps, 2),
+            "unit": "steps/sec",
+            **(
+                {"vs_baseline": round(ppo_sps / _PPO_TORCH_CPU_SPS, 3)}
+                if _PPO_TORCH_CPU_SPS
+                else {}
+            ),
+        },
+        "link_probe": probes,
+    }
+    # single-chip MFU at the bench shape: FLOPs of one fused train step (XLA
+    # cost analysis, recorded by the loop post-window) x gradient steps in
+    # the steady-state window / window seconds / chip bf16 peak. The bench
+    # nets are tiny, so this MFU states how much of the chip the bench
+    # workload can even use — benchmarks/mfu_probe.py holds the model-size
+    # sweep (S size and up) where the MFU ceiling is meaningful.
+    flops = dv3.get("flops_per_train_step")
+    train_steps = dv3.get("train_steps")
+    if flops and train_steps:
+        from sheeprl_tpu.utils.profiler import PEAK_BF16_FLOPS
+
+        record["train_flops_per_sec"] = round(flops * train_steps / dv3["seconds"], 1)
+        record["flops_per_train_step"] = flops
+        peak = PEAK_BF16_FLOPS.get(jax.devices()[0].device_kind)
+        if peak:
+            record["mfu"] = round(flops * train_steps / dv3["seconds"] / peak, 6)
+            record["mfu_peak_flops_assumed"] = peak
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

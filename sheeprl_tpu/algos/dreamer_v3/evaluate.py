@@ -4,45 +4,15 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-import gymnasium as gym
-
 from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
 from sheeprl_tpu.algos.dreamer_v3.utils import test
-from sheeprl_tpu.envs import make_env
-from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.evaluation import dreamer_family_evaluate
 from sheeprl_tpu.utils.registry import register_evaluation
 
 
 @register_evaluation(algorithms="dreamer_v3")
 def evaluate(fabric, cfg: Dict[str, Any], state: Dict[str, Any]) -> None:
-    log_dir = get_log_dir(cfg)
-    logger = get_logger(cfg, log_dir)
-    fabric.logger = logger
-
-    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
-    observation_space = env.observation_space
-    action_space = env.action_space
-    if not isinstance(observation_space, gym.spaces.Dict):
-        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
-    is_continuous = isinstance(action_space, gym.spaces.Box)
-    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
-    actions_dim = tuple(
-        action_space.shape
-        if is_continuous
-        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    dreamer_family_evaluate(
+        fabric, cfg, state, build_agent, test,
+        state_keys=("world_model", "actor", "critic", "target_critic"),
     )
-    env.close()
-
-    *_, player = build_agent(
-        fabric,
-        actions_dim,
-        is_continuous,
-        cfg,
-        observation_space,
-        state["world_model"],
-        state["actor"],
-        state["critic"],
-        state["target_critic"],
-    )
-    test(player, fabric, cfg, log_dir)
-    logger.finalize()

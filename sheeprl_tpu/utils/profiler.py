@@ -41,3 +41,50 @@ def maybe_profile(cfg: Mapping[str, Any], log_dir: Optional[str] = None) -> Iter
         yield trace_dir
     finally:
         jax.profiler.stop_trace()
+
+
+# bf16 peak of known chips, for MFU claims (jax device_kind -> FLOP/s).
+# Unknown chips get no MFU claim, only raw FLOPs.
+PEAK_BF16_FLOPS = {"TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5p": 459e12}
+
+
+def tiny_op_rtt_seconds() -> float:
+    """Best-of-5 dispatch + materializing-fetch round trip of a tiny jitted
+    op — the link-health probe for remote-attached chips (a materializing
+    fetch is the only real sync on the axon client)."""
+    import time
+
+    import jax
+    import numpy as np
+
+    f = jax.jit(lambda x: x + 1)
+    x = jax.device_put(np.ones((8, 8), np.float32))
+    np.asarray(f(x))  # compile + warm
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        rtts.append(time.perf_counter() - t0)
+    return min(rtts)
+
+
+def compiled_flops(jitted_fn: Any, *args: Any) -> Optional[float]:
+    """FLOPs of ONE invocation of ``jitted_fn`` at the shapes of ``args``,
+    read from XLA's cost analysis of an AOT compile built from
+    ``ShapeDtypeStruct``s — no data moves, but one extra compile is paid, so
+    callers run this outside any measured window. The number feeds the MFU
+    computation (``bench.py``): flops x steps / seconds / chip peak."""
+    import jax
+
+    def as_shape(x: Any) -> Any:
+        return jax.ShapeDtypeStruct(x.shape, x.dtype) if hasattr(x, "shape") and hasattr(x, "dtype") else x
+
+    try:
+        compiled = jitted_fn.lower(*jax.tree.map(as_shape, args)).compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        flops = float(analysis.get("flops", 0.0))
+        return flops or None
+    except Exception:
+        return None

@@ -215,14 +215,21 @@ class SteadyStateProbe:
     def active(self) -> bool:
         return self.path is not None
 
-    def mark(self, step: int) -> None:
+    def mark(self, step: int, work: int = 0) -> None:
+        """``work`` is the loop's cumulative gradient-step counter at the
+        mark, so the window's training work can be reported alongside its
+        env steps (the MFU numerator needs gradient steps, not env steps)."""
         if self.path is None or self._t0 is not None:
             return
         import time
 
-        self._t0, self._step0 = time.perf_counter(), step
+        self._t0, self._step0, self._work0 = time.perf_counter(), step, work
 
-    def finish(self, step: int, sync=None) -> None:
+    def finish(self, step: int, sync=None, work: int = 0, extra=None) -> None:
+        """``extra``: optional dict (or zero-arg callable returning one)
+        merged into the record AFTER the clock stops — expensive bookkeeping
+        like an AOT cost-analysis compile goes here without polluting the
+        measured window."""
         if self.path is None or self._t0 is None:
             return
         import json
@@ -232,10 +239,18 @@ class SteadyStateProbe:
 
         if sync is not None:
             sync()
+        seconds = time.perf_counter() - self._t0
         if jax.process_index() != 0:  # one writer on multi-process runs
             return
+        rec = {"steps": step - self._step0, "seconds": seconds}
+        if work:
+            rec["train_steps"] = work - getattr(self, "_work0", 0)
+        if callable(extra):
+            extra = extra()
+        if extra:
+            rec.update(extra)
         with open(self.path, "w") as f:
-            json.dump({"steps": step - self._step0, "seconds": time.perf_counter() - self._t0}, f)
+            json.dump(rec, f)
 
 
 def gradient_step_chunks(n_steps: int, algo_cfg: Mapping[str, Any]) -> list:

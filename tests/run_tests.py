@@ -1,0 +1,67 @@
+"""Canonical test entry point (reference: tests/run_tests.py).
+
+Runs the suite in two tiers so CI and humans share one definition of "the
+tests pass":
+
+- ``unit`` (default): everything except the algorithm smoke suites — the
+  fast tier (data/ops/models/config/utils/envs/parallel), a few minutes on
+  one core.
+- ``all``: the full suite including the CLI-driven algorithm smoke tests
+  (each jit-compiles tiny training graphs; ~30 min on one core).
+
+A wall-clock budget guards against hangs: the run is aborted (exit 2) when
+the budget expires. Everything executes on the 8-device virtual CPU mesh —
+``tests/conftest.py`` forces ``jax_platforms=cpu`` with
+``--xla_force_host_platform_device_count=8`` so no accelerator is needed.
+"""
+
+import argparse
+import os
+import sys
+
+import pytest
+
+UNIT_DIRS = [
+    "tests/test_data",
+    "tests/test_ops",
+    "tests/test_models",
+    "tests/test_config",
+    "tests/test_utils",
+    "tests/test_envs",
+    "tests/test_parallel",
+]
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("tier", nargs="?", default="unit", choices=["unit", "algos", "all"])
+    env_budget = os.environ.get("SHEEPRL_TPU_TEST_BUDGET_MINUTES")
+    parser.add_argument(
+        "--budget-minutes",
+        type=float,
+        default=float(env_budget) if env_budget is not None else None,
+        help="abort the whole run after this many minutes (0 = no budget; default: per-tier)",
+    )
+    # unknown args (incl. dash options like -k/-x) forward to pytest
+    args, pytest_args = parser.parse_known_args()
+
+    targets = {"unit": UNIT_DIRS, "algos": ["tests/test_algos"], "all": ["tests"]}[args.tier]
+    default_budget = {"unit": 15, "algos": 45, "all": 60}[args.tier]
+    budget = args.budget_minutes if args.budget_minutes is not None else default_budget
+
+    if budget:
+        import threading
+
+        def expire() -> None:
+            print(f"\n[run_tests] budget of {budget} min expired — aborting", file=sys.stderr)
+            os._exit(2)
+
+        timer = threading.Timer(budget * 60, expire)
+        timer.daemon = True
+        timer.start()
+
+    return pytest.main(["-q", *targets, *pytest_args])
+
+
+if __name__ == "__main__":
+    sys.exit(main())
