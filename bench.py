@@ -56,7 +56,7 @@ def link_probe(tag: str) -> dict:
     rtt = tiny_op_rtt_seconds()
     out["rtt_ms"] = round(rtt * 1e3, 1)
 
-    # 64 chained 4096^3 bf16 matmuls ≈ 17.6 TFLOP — ~90 ms at v5e peak, so
+    # 64 chained 4096^3 bf16 matmuls ≈ 8.8 TFLOP — ~45 ms at v5e peak, so
     # device time dominates the one closing fetch; a = full(1/4096) is a
     # fixed point of a@a, keeping the chain finite in bf16
     n, chain = 4096, 64

@@ -85,6 +85,16 @@ METRIC_ORDER = (
 )
 
 
+def build_tx(opt_cfg, clip):
+    """Optimizer from its config group, with the algo's clip_gradients folded
+    in — shared by ``main`` and the standalone MFU probe so the probe times
+    the exact training computation (``benchmarks/mfu_probe.py``)."""
+    opt_cfg = dict(opt_cfg.to_dict() if hasattr(opt_cfg, "to_dict") else opt_cfg)
+    if clip and float(clip) > 0:
+        opt_cfg["max_grad_norm"] = float(clip)
+    return instantiate(opt_cfg)
+
+
 def make_train_fn(
     fabric,
     wm: WorldModel,
@@ -392,12 +402,6 @@ def main(fabric, cfg: Dict[str, Any]):
         state["critic"] if cfg.checkpoint.resume_from else None,
         state["target_critic"] if cfg.checkpoint.resume_from else None,
     )
-
-    def build_tx(opt_cfg, clip):
-        opt_cfg = dict(opt_cfg.to_dict() if hasattr(opt_cfg, "to_dict") else opt_cfg)
-        if clip and float(clip) > 0:
-            opt_cfg["max_grad_norm"] = float(clip)
-        return instantiate(opt_cfg)
 
     world_tx = build_tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
     actor_tx = build_tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)

@@ -33,15 +33,17 @@ def sampled_batches(
     """Yield ``n_samples`` train-ready ``[T, B]`` sequence batches for the
     Dreamer-family gradient loops.
 
-    Single-process with ``prefetch``: batches are sampled on a background
-    thread and ``device_put`` one step ahead (:class:`DevicePrefetcher`), so
-    the host→HBM transfer of batch ``i+1`` overlaps the gradient step on
-    batch ``i`` — the SURVEY §7 stage-2 deliverable, replacing the
-    synchronous per-step staging of the reference
-    (``rb.sample_tensors(..., device=...)``, dreamer_v3.py:659-666).
-    Multi-host runs keep host staging so each process can contribute its
-    block to the mesh-global array. ``prefetch`` is the pipeline depth
-    (0 disables; 2 = double buffering).
+    With ``prefetch``, batches are sampled on a background thread and placed
+    one step ahead (:class:`DevicePrefetcher`), so the host→HBM transfer of
+    batch ``i+1`` overlaps the gradient step on batch ``i`` — the SURVEY §7
+    stage-2 deliverable, replacing the synchronous per-step staging of the
+    reference (``rb.sample_tensors(..., device=...)``, dreamer_v3.py:659-666).
+    Multi-host runs prefetch too: each process's worker samples its local
+    block and assembles the mesh-global array (``fabric.make_global`` is
+    communication-free — local shards + sharding metadata — so it is safe
+    off-thread; every process draws the same batch schedule, keeping the
+    global arrays aligned). ``prefetch`` is the pipeline depth (0 disables;
+    2 = double buffering).
 
     An HBM-resident ring (:class:`~sheeprl_tpu.data.device_buffer.DeviceReplayBuffer`)
     needs neither staging nor prefetch — sampling is an on-chip gather — so it
@@ -58,11 +60,15 @@ def sampled_batches(
         # pixels stay uint8 across PCIe; vectors go float32
         return {k: (v[i] if k in cnn_keys else v[i].astype(np.float32)) for k, v in sample.items()}
 
-    if prefetch and getattr(fabric, "num_processes", 1) == 1 and n_samples > 0:
+    if prefetch and n_samples > 0:
         def sample_one() -> Dict[str, np.ndarray]:
             d = rb.sample(batch_size, sequence_length=sequence_length, n_samples=1)
             return stage(d, 0)
 
+        if getattr(fabric, "num_processes", 1) > 1:
+            place = lambda host: fabric.make_global(host, (None, fabric.data_axis))  # noqa: E731
+            yield from DevicePrefetcher(sample_one, n_samples, place=place, depth=int(prefetch))
+            return
         # place batches pre-sharded over the data axis so the jitted step
         # consumes them without a resharding copy
         sharding = None
@@ -95,6 +101,9 @@ class DevicePrefetcher:
             images as uint8 and normalizing on device is cheaper than shipping
             fp32 — 4x less PCIe traffic).
         sharding: optional ``jax.sharding.Sharding`` for pre-sharded placement.
+        place: optional host-batch → device-batch callable overriding the
+            default ``to_device`` (e.g. ``fabric.make_global`` on multi-host,
+            which builds the mesh-global array from this process's block).
         depth: queue depth; 2 = classic double buffering.
     """
 
@@ -104,6 +113,7 @@ class DevicePrefetcher:
         n_batches: int,
         dtype: Any = None,
         sharding: Any = None,
+        place: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, Any]]] = None,
         depth: int = 2,
     ) -> None:
         if n_batches < 0:
@@ -112,6 +122,7 @@ class DevicePrefetcher:
         self._n_batches = n_batches
         self._dtype = dtype
         self._sharding = sharding
+        self._place = place
         self._depth = max(1, depth)
         self._queue: Optional["queue.Queue[Any]"] = None
         self._stop: Optional[threading.Event] = None
@@ -124,7 +135,10 @@ class DevicePrefetcher:
                 if stop.is_set():
                     return
                 host = self._sample_fn()
-                dev = to_device(host, dtype=self._dtype, sharding=self._sharding)
+                if self._place is not None:
+                    dev = self._place(host)
+                else:
+                    dev = to_device(host, dtype=self._dtype, sharding=self._sharding)
                 # bounded put that still observes the stop signal
                 while not stop.is_set():
                     try:

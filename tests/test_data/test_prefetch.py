@@ -80,3 +80,22 @@ def test_prefetch_error_with_full_queue_does_not_hang():
     with pytest.raises(RuntimeError, match="prefetch worker failed"):
         for _ in pf:
             time.sleep(0.05)  # slow consumer keeps the queue full
+
+
+def test_prefetcher_custom_place():
+    # the multi-host lane: a custom `place` callable (fabric.make_global in
+    # production) replaces the default to_device staging
+    import numpy as np
+
+    from sheeprl_tpu.data.prefetch import DevicePrefetcher
+
+    placed = []
+
+    def place(host):
+        placed.append(True)
+        return {k: v + 1 for k, v in host.items()}
+
+    pf = DevicePrefetcher(lambda: {"x": np.zeros((2,), np.float32)}, 3, place=place)
+    out = list(pf)
+    assert len(out) == 3 and len(placed) == 3
+    assert all(np.array_equal(b["x"], np.ones((2,))) for b in out)
