@@ -72,7 +72,8 @@ def build_step(size: str, batch_size: int, seq_len: int):
     import jax.numpy as jnp
 
     from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
-    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import build_tx, make_train_fn
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_fn
+    from sheeprl_tpu.ops.optim import build_tx
     from sheeprl_tpu.config.compose import compose
     from sheeprl_tpu.ops.math import init_moments
     from sheeprl_tpu.parallel.fabric import Fabric
@@ -179,11 +180,16 @@ def measure(size: str, batch_size: int, seq_len: int, chain: int, repeat: int, t
     rec["compile_plus_first_chain_s"] = round(time.perf_counter() - t0, 1)
 
     passes = []
+    clamped = False
     for _ in range(max(1, repeat)):
         dt, args = run_chain(args)
-        # clamp: on an RTT-dominated chain (tiny step x jittery link) the
-        # subtraction can go non-positive — floor at 1 µs/step
-        passes.append(round(max(dt - rtt0, chain * 1e-6) / chain * 1e3, 3))
+        # on an RTT-dominated chain (tiny step x jittery link) the subtraction
+        # can go non-positive: the chain is unmeasurable, not free
+        net = dt - rtt0
+        if net <= 0:
+            clamped = True
+            net = chain * 1e-6
+        passes.append(round(net / chain * 1e3, 3))
     rec["step_ms_passes"] = passes
     step_s = min(passes) / 1e3
     rec["step_ms"] = min(passes)
@@ -193,6 +199,11 @@ def measure(size: str, batch_size: int, seq_len: int, chain: int, repeat: int, t
     flops = compiled_flops(train_fn, *args)
     if flops:
         rec["flops_per_step"] = flops
+    if clamped:
+        # device time drowned in link jitter — no throughput claim possible;
+        # raise --chain until the chain dominates the RTT
+        rec["unmeasurable"] = "chain time <= RTT jitter; raise --chain"
+    elif flops:
         rec["achieved_tflops"] = round(flops / step_s / 1e12, 2)
         peak = PEAK_BF16.get(rec["device"])
         if peak:

@@ -1,0 +1,103 @@
+"""Measure the irreducible env-stepping floor of the PPO bench workload
+(VERDICT round-3 item 3): what does bare ``gym.vector`` CartPole stepping
+cost on this host, with zero learning on top?
+
+Stages, each timed over ``--steps`` env steps (env-steps/s):
+
+1. ``random``: SyncVectorEnv.step with ``action_space.sample()`` — the pure
+   gym floor, no policy at all.
+2. ``noop-policy``: adds the host-side numpy work PPO's player cannot avoid
+   (obs dict assembly + a trivially cheap deterministic action) — isolates
+   vector-env cost from policy cost.
+3. ``policy``: the real PPOPlayer forward (jitted MLP on the player device)
+   — the full interaction path minus buffers and training.
+
+The gap between stage 3 and the full bench number is the framework's
+bookkeeping (rollout buffer writes, GAE, fused update dispatch).
+
+Usage: python benchmarks/ppo_floor.py [--steps 32768] [--envs 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def make_envs(n):
+    import gymnasium as gym
+
+    return gym.vector.SyncVectorEnv([lambda: gym.make("CartPole-v1") for _ in range(n)])
+
+
+def stage_random(envs, steps):
+    n = envs.num_envs
+    envs.reset(seed=0)
+    t0 = time.perf_counter()
+    for _ in range(steps // n):
+        envs.step(envs.action_space.sample())
+    return steps / (time.perf_counter() - t0)
+
+
+def stage_noop_policy(envs, steps):
+    n = envs.num_envs
+    obs, _ = envs.reset(seed=0)
+    actions = np.zeros((n,), np.int64)
+    t0 = time.perf_counter()
+    for _ in range(steps // n):
+        # the cheapest possible "policy": a numpy reduction over the obs
+        actions[:] = (np.asarray(obs).sum(-1) > 0).astype(np.int64)
+        obs, *_ = envs.step(actions)
+    return steps / (time.perf_counter() - t0)
+
+
+def stage_player(envs, steps):
+    import gymnasium as gym
+    import jax
+
+    from sheeprl_tpu.algos.ppo.agent import PPOPlayer, build_agent
+    from sheeprl_tpu.config.compose import compose
+    from sheeprl_tpu.parallel.fabric import Fabric, resolve_player_device
+
+    cfg = compose("config", ["exp=ppo", "env.num_envs=64", "algo.mlp_keys.encoder=[state]"])
+    fabric = Fabric(devices=1, precision=str(cfg.fabric.get("precision", "fp32")))
+    obs_space = gym.spaces.Dict({"state": envs.single_observation_space})
+    agent, params = build_agent(fabric, (int(envs.single_action_space.n),), False, cfg, obs_space)
+    player = PPOPlayer(agent, params, device=resolve_player_device(cfg.algo.get("player_device", "auto")))
+
+    n = envs.num_envs
+    obs, _ = envs.reset(seed=0)
+    key = jax.random.PRNGKey(0)
+    player.get_actions({"state": np.asarray(obs, np.float32)}, key)  # warm the jit
+    t0 = time.perf_counter()
+    for _ in range(steps // n):
+        key, k = jax.random.split(key)
+        actions, logprobs, values = player.get_actions({"state": np.asarray(obs, np.float32)}, k)
+        actions_np, _lp, _v = jax.device_get((actions, logprobs, values))
+        obs, *_ = envs.step(actions_np.argmax(-1).reshape(-1))
+    return steps / (time.perf_counter() - t0)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=32768)
+    p.add_argument("--envs", type=int, default=64)
+    args = p.parse_args()
+
+    envs = make_envs(args.envs)
+    rec = {"envs": args.envs, "steps": args.steps}
+    rec["random_sps"] = round(stage_random(envs, args.steps), 1)
+    rec["noop_policy_sps"] = round(stage_noop_policy(envs, args.steps), 1)
+    try:
+        rec["player_sps"] = round(stage_player(envs, args.steps), 1)
+    except Exception as e:  # the player stage needs the full package import
+        rec["player_error"] = repr(e)
+    envs.close()
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

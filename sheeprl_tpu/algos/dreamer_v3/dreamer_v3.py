@@ -44,7 +44,7 @@ from sheeprl_tpu.algos.dreamer_v3.agent import (
 )
 from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import AGGREGATOR_KEYS, prepare_obs, test
-from sheeprl_tpu.config.compose import instantiate
+from sheeprl_tpu.ops.optim import build_tx
 from sheeprl_tpu.data.device_buffer import (
     DeviceReplayBuffer,
     adapt_restored_buffer,
@@ -83,16 +83,6 @@ METRIC_ORDER = (
     "Grads/actor",
     "Grads/critic",
 )
-
-
-def build_tx(opt_cfg, clip):
-    """Optimizer from its config group, with the algo's clip_gradients folded
-    in — shared by ``main`` and the standalone MFU probe so the probe times
-    the exact training computation (``benchmarks/mfu_probe.py``)."""
-    opt_cfg = dict(opt_cfg.to_dict() if hasattr(opt_cfg, "to_dict") else opt_cfg)
-    if clip and float(clip) > 0:
-        opt_cfg["max_grad_norm"] = float(clip)
-    return instantiate(opt_cfg)
 
 
 def make_train_fn(

@@ -17,10 +17,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.ops.optim import build_tx
 from sheeprl_tpu.algos.dreamer_v1.dreamer_v1 import METRIC_ORDER, make_train_fn
 from sheeprl_tpu.algos.p2e_dv1.agent import build_agent
 from sheeprl_tpu.algos.p2e_dv1.utils import prepare_obs, test
-from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.data.device_buffer import (
     DeviceReplayBuffer,
     adapt_restored_buffer,
@@ -156,12 +156,6 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
         state["actor_exploration"],
         None,
     )
-
-    def build_tx(opt_cfg, clip):
-        opt_cfg = dict(opt_cfg.to_dict() if hasattr(opt_cfg, "to_dict") else opt_cfg)
-        if clip and float(clip) > 0:
-            opt_cfg["max_grad_norm"] = float(clip)
-        return instantiate(opt_cfg)
 
     world_tx = build_tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
     actor_tx = build_tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)

@@ -21,6 +21,7 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from sheeprl_tpu.ops.optim import build_tx
 from sheeprl_tpu.algos.dreamer_v2.agent import (
     WorldModelDV2,
     actor_logprob_entropy,
@@ -30,7 +31,6 @@ from sheeprl_tpu.algos.dreamer_v2.agent import (
 from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
 from sheeprl_tpu.algos.p2e_dv2.agent import build_agent, ensemble_apply
 from sheeprl_tpu.algos.p2e_dv2.utils import AGGREGATOR_KEYS, prepare_obs, test
-from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.data.device_buffer import (
     DeviceReplayBuffer,
     adapt_restored_buffer,
@@ -475,12 +475,6 @@ def main(fabric, cfg: Dict[str, Any]):
         state["critic_exploration"] if cfg.checkpoint.resume_from else None,
         state["target_critic_exploration"] if cfg.checkpoint.resume_from else None,
     )
-
-    def build_tx(opt_cfg, clip):
-        opt_cfg = dict(opt_cfg.to_dict() if hasattr(opt_cfg, "to_dict") else opt_cfg)
-        if clip and float(clip) > 0:
-            opt_cfg["max_grad_norm"] = float(clip)
-        return instantiate(opt_cfg)
 
     world_tx = build_tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
     actor_task_tx = build_tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)

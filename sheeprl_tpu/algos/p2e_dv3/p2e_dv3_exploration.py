@@ -30,6 +30,7 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from sheeprl_tpu.ops.optim import build_tx
 from sheeprl_tpu.algos.dreamer_v3.agent import (
     WorldModel,
     actor_logprob_entropy,
@@ -39,7 +40,6 @@ from sheeprl_tpu.algos.dreamer_v3.agent import (
 from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
 from sheeprl_tpu.algos.p2e_dv3.agent import build_agent, ensemble_apply
 from sheeprl_tpu.algos.p2e_dv3.utils import AGGREGATOR_KEYS, prepare_obs, test
-from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.data.device_buffer import (
     DeviceReplayBuffer,
     adapt_restored_buffer,
@@ -566,12 +566,6 @@ def main(fabric, cfg: Dict[str, Any]):
     critic_meta = {
         k: {"weight": v["weight"], "reward_type": v["reward_type"]} for k, v in critics_exploration.items()
     }
-
-    def build_tx(opt_cfg, clip):
-        opt_cfg = dict(opt_cfg.to_dict() if hasattr(opt_cfg, "to_dict") else opt_cfg)
-        if clip and float(clip) > 0:
-            opt_cfg["max_grad_norm"] = float(clip)
-        return instantiate(opt_cfg)
 
     world_tx = build_tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
     actor_task_tx = build_tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)

@@ -21,10 +21,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P  # noqa: F401 (kept for parity with sibling entrypoints)
 
+from sheeprl_tpu.ops.optim import build_tx
 from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import METRIC_ORDER, make_train_fn
 from sheeprl_tpu.algos.p2e_dv3.agent import build_agent
 from sheeprl_tpu.algos.p2e_dv3.utils import AGGREGATOR_KEYS_FINETUNING, prepare_obs, test
-from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.data.device_buffer import (
     DeviceReplayBuffer,
     adapt_restored_buffer,
@@ -143,12 +143,6 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
         state["target_critic_task"],
         state["actor_exploration"],
     )
-
-    def build_tx(opt_cfg, clip):
-        opt_cfg = dict(opt_cfg.to_dict() if hasattr(opt_cfg, "to_dict") else opt_cfg)
-        if clip and float(clip) > 0:
-            opt_cfg["max_grad_norm"] = float(clip)
-        return instantiate(opt_cfg)
 
     world_tx = build_tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
     actor_tx = build_tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)

@@ -188,6 +188,22 @@ def evaluate_actions(
     return logprob, entropy, values
 
 
+def rollout_step(agent: PPOAgent, params: Any, obs: Dict[str, Array], key: Array):
+    """One fused rollout-time policy call: sample + the one-hot→index
+    conversion the env needs, in a single XLA program. On a 1-core host the
+    per-step budget is milliseconds, so the separate dispatches the naive
+    loop pays (key split, sample, numpy argmax/split per action part) are a
+    measurable fraction of the whole rollout — this folds them into one."""
+    actions, logprob, values = sample_actions(agent, params, obs, key)
+    if agent.is_continuous:
+        real_actions = actions
+    else:
+        splits = np.cumsum(np.asarray(agent.actions_dim))[:-1].tolist()
+        parts = jnp.split(actions, splits, axis=-1)
+        real_actions = jnp.stack([p.argmax(-1) for p in parts], axis=-1)
+    return actions, real_actions, logprob, values
+
+
 class PPOPlayer(HostPlayerParams):
     """Host-side convenience handle for rollout/eval: module + params with
     jitted action/value functions (reference PPOPlayer, agent.py:194-251).
@@ -206,12 +222,23 @@ class PPOPlayer(HostPlayerParams):
             lambda p, o, k, greedy: sample_actions(agent, p, o, k, greedy), static_argnames="greedy"
         )
         self._values = jax.jit(lambda p, o: agent.apply(p, o)[1])
+        # fused rollout step: key folding (counter -> fresh stream, no host
+        # split dispatch) + sample + real-action conversion in one program
+        self._rollout = jax.jit(
+            lambda p, o, k, c: rollout_step(agent, p, o, jax.random.fold_in(k, c))
+        )
 
     def update_params(self, params: Any) -> None:
         self.params = params
 
     def get_actions(self, obs: Dict[str, Array], key: Array, greedy: bool = False):
         return self._sample(self.params, obs, put_tree(key, self.device), greedy)
+
+    def rollout_actions(self, obs: Dict[str, Array], key: Array, counter) -> Any:
+        """(actions, real_actions, logprobs, values) for one env step; the
+        per-step stream is ``fold_in(key, counter)`` so the base key crosses
+        to the player device once per update, not once per step."""
+        return self._rollout(self.params, obs, key, counter)
 
     def get_values(self, obs: Dict[str, Array]) -> Array:
         return self._values(self.params, obs)

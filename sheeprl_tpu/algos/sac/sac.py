@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax import lax
+from sheeprl_tpu.ops.optim import build_tx
 from sheeprl_tpu.parallel.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
@@ -37,7 +38,6 @@ from sheeprl_tpu.algos.sac.agent import (
 )
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
-from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.envs import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -205,9 +205,6 @@ def main(fabric, cfg: Dict[str, Any]):
     agent, player = build_agent(
         fabric, cfg, observation_space, action_space, state["agent"] if cfg.checkpoint.resume_from else None
     )
-
-    def build_tx(opt_cfg):
-        return instantiate(dict(opt_cfg.to_dict() if hasattr(opt_cfg, "to_dict") else opt_cfg))
 
     critic_tx = build_tx(cfg.algo.critic.optimizer)
     actor_tx = build_tx(cfg.algo.actor.optimizer)

@@ -27,11 +27,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.ops.optim import build_tx
 from sheeprl_tpu.algos.ppo.ppo_decoupled import _ckpt_schedule, _trainer_devices
 from sheeprl_tpu.algos.sac.agent import SACPlayer, build_agent
 from sheeprl_tpu.algos.sac.sac import make_train_fn
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
-from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.data import ReplayBuffer
 from sheeprl_tpu.envs import make_env
 from sheeprl_tpu.parallel.collectives import broadcast_object
@@ -282,9 +282,6 @@ def _trainer(fabric, cfg, state=None):
     agent, _player_handle = build_agent(
         tfabric, cfg, observation_space, action_space, state["agent"] if state else None
     )
-
-    def build_tx(opt_cfg):
-        return instantiate(dict(opt_cfg.to_dict() if hasattr(opt_cfg, "to_dict") else opt_cfg))
 
     critic_tx = build_tx(cfg.algo.critic.optimizer)
     actor_tx = build_tx(cfg.algo.actor.optimizer)

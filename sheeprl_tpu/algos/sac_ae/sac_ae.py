@@ -30,6 +30,7 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from sheeprl_tpu.ops.optim import build_tx
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac_ae.agent import (
     SACAEAgent,
@@ -38,7 +39,6 @@ from sheeprl_tpu.algos.sac_ae.agent import (
     qf_ensemble_apply,
 )
 from sheeprl_tpu.algos.sac_ae.utils import AGGREGATOR_KEYS, prepare_obs, test
-from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.envs import make_env
 from sheeprl_tpu.parallel.shard_map import shard_map
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -302,9 +302,6 @@ def main(fabric, cfg: Dict[str, Any]):
         action_space,
         state["agent"] if cfg.checkpoint.resume_from else None,
     )
-
-    def build_tx(opt_cfg):
-        return instantiate(dict(opt_cfg.to_dict() if hasattr(opt_cfg, "to_dict") else opt_cfg))
 
     qf_tx = build_tx(cfg.algo.critic.optimizer)
     actor_tx = build_tx(cfg.algo.actor.optimizer)

@@ -17,6 +17,18 @@ def _lr(lr: float, schedule: Optional[Any]) -> Any:
     return schedule if schedule is not None else lr
 
 
+def build_tx(opt_cfg: Any, clip: Optional[float] = None) -> optax.GradientTransformation:
+    """Optimizer from its config group (``_target_`` instantiate), with the
+    algo's ``clip_gradients`` folded into the update chain — the one
+    construction every training loop (and the standalone MFU probe) shares."""
+    from sheeprl_tpu.config.compose import instantiate
+
+    opt_cfg = dict(opt_cfg.to_dict() if hasattr(opt_cfg, "to_dict") else opt_cfg)
+    if clip and float(clip) > 0:
+        opt_cfg["max_grad_norm"] = float(clip)
+    return instantiate(opt_cfg)
+
+
 def adam(
     lr: float = 1e-3,
     betas: Sequence[float] = (0.9, 0.999),
