@@ -83,6 +83,11 @@ def _dv3_args(total_steps: int, learning_starts: int = 512):
         "exp=dreamer_v3",
         "env=dummy",
         "env.id=dummy_discrete",
+        # sync envs: on this 1-core host AsyncVectorEnv's worker pipes are
+        # pure overhead (measured 4.4 s of pipe I/O per 256 vector steps —
+        # benchmarks/ppo_floor.py investigation), and the torch baseline
+        # steps synchronously too
+        "env.sync_env=True",
         "env.num_envs=4",
         "env.screen_size=64",
         "env.capture_video=False",
@@ -157,6 +162,10 @@ def bench_ppo() -> float:
                     "exp=ppo",
                     f"algo.total_steps={PPO_STEPS}",
                     "env.num_envs=64",
+                    # SyncVectorEnv for parity with the torch baseline (its
+                    # loop is sync); 64 async workers on one core spend more
+                    # time in multiprocessing pipes than in the envs
+                    "env.sync_env=True",
                     "algo.per_rank_batch_size=512",
                     "env.capture_video=False",
                     "buffer.memmap=False",

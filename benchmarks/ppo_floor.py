@@ -60,7 +60,7 @@ def stage_player(envs, steps):
 
     from sheeprl_tpu.algos.ppo.agent import PPOPlayer, build_agent
     from sheeprl_tpu.config.compose import compose
-    from sheeprl_tpu.parallel.fabric import Fabric, resolve_player_device
+    from sheeprl_tpu.parallel.fabric import Fabric, put_tree, resolve_player_device
 
     cfg = compose("config", ["exp=ppo", "env.num_envs=64", "algo.mlp_keys.encoder=[state]"])
     fabric = Fabric(devices=1, precision=str(cfg.fabric.get("precision", "fp32")))
@@ -70,14 +70,15 @@ def stage_player(envs, steps):
 
     n = envs.num_envs
     obs, _ = envs.reset(seed=0)
-    key = jax.random.PRNGKey(0)
-    player.get_actions({"state": np.asarray(obs, np.float32)}, key)  # warm the jit
+    # the key lives on the player's device and steps fold a counter in-graph
+    # — the exact per-step pattern of the training loop (ppo.py rollout)
+    key = put_tree(jax.random.PRNGKey(0), player.device)
+    player.rollout_actions({"state": np.asarray(obs, np.float32)}, key, 0)  # warm the jit
     t0 = time.perf_counter()
-    for _ in range(steps // n):
-        key, k = jax.random.split(key)
-        actions, logprobs, values = player.get_actions({"state": np.asarray(obs, np.float32)}, k)
-        actions_np, _lp, _v = jax.device_get((actions, logprobs, values))
-        obs, *_ = envs.step(actions_np.argmax(-1).reshape(-1))
+    for c in range(steps // n):
+        out = player.rollout_actions({"state": np.asarray(obs, np.float32)}, key, c)
+        _actions, real_actions, _lp, _v = jax.device_get(out)
+        obs, *_ = envs.step(real_actions[..., 0].reshape(-1))
     return steps / (time.perf_counter() - t0)
 
 

@@ -193,20 +193,19 @@ def _player(fabric, cfg, state=None):
     for update in range(start_update, num_updates + 1):
         rollout = {k: [] for k in (*obs_keys, "dones", "values", "actions", "logprobs", "rewards")}
         with timer("Time/env_interaction_time"):
+            # fused rollout step (agent.rollout_step): one jitted dispatch +
+            # one device->host fetch per env step, keys folded in-graph
+            update_key = player_key
             for _ in range(rollout_steps):
                 policy_step += num_envs
-                player_key, action_key = jax.random.split(player_key)
-                actions, logprobs, values = player.get_actions(next_obs, action_key)
-                actions_np, logprobs_np, values_np = jax.device_get((actions, logprobs, values))
-                if is_continuous:
-                    real_actions = actions_np
-                else:
-                    splits = np.cumsum(actions_dim)[:-1]
-                    real_actions = np.stack(
-                        [p.argmax(-1) for p in np.split(actions_np, splits, axis=-1)], axis=-1
-                    )
-                    if real_actions.shape[-1] == 1 and not is_multidiscrete:
-                        real_actions = real_actions[..., 0]
+                actions, real_actions, logprobs, values = player.rollout_actions(
+                    next_obs, update_key, policy_step
+                )
+                actions_np, real_actions, logprobs_np, values_np = jax.device_get(
+                    (actions, real_actions, logprobs, values)
+                )
+                if not is_continuous and real_actions.shape[-1] == 1 and not is_multidiscrete:
+                    real_actions = real_actions[..., 0]
 
                 obs, rewards, terminated, truncated, info = envs.step(
                     real_actions.reshape(envs.action_space.shape)

@@ -396,7 +396,14 @@ def main(fabric, cfg: Dict[str, Any]):
     obs, _ = envs.reset(seed=cfg.seed)
     cumulative_per_rank_gradient_steps = 0
     step_data: Dict[str, np.ndarray] = {}
+    # steady-state throughput probe (SHEEPRL_TPU_BENCH_JSON contract): warm
+    # from 64 updates past the first train event, like the Dreamer loops
+    from sheeprl_tpu.utils.utils import SteadyStateProbe
+
+    probe = SteadyStateProbe()
     for update in range(start_step, num_updates + 1):
+        if update == learning_starts + 64:
+            probe.mark(policy_step, work=cumulative_per_rank_gradient_steps)
         policy_step += num_envs * num_processes
 
         with timer("Time/env_interaction_time"):
@@ -601,6 +608,12 @@ def main(fabric, cfg: Dict[str, Any]):
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
+    probe.finish(
+        policy_step,
+        # a materializing fetch is the only real device sync on the tunnel
+        sync=lambda: np.asarray(jax.device_get(agent.log_alpha)),
+        work=cumulative_per_rank_gradient_steps,
+    )
     # land any in-flight async param stream before the final evaluation
     player.flush_stream_attrs()
     envs.close()
