@@ -188,6 +188,17 @@ def evaluate_actions(
     return logprob, entropy, values
 
 
+def real_actions_from_onehot(actions_dim: Sequence[int], is_continuous: bool, actions: Array) -> Array:
+    """Concatenated one-hot action vector → per-part env indices (identity
+    for continuous) — the in-graph twin of the host-side conversion every
+    rollout used to pay in numpy."""
+    if is_continuous:
+        return actions
+    splits = np.cumsum(np.asarray(actions_dim))[:-1].tolist()
+    parts = jnp.split(actions, splits, axis=-1)
+    return jnp.stack([p.argmax(-1) for p in parts], axis=-1)
+
+
 def rollout_step(agent: PPOAgent, params: Any, obs: Dict[str, Array], key: Array):
     """One fused rollout-time policy call: sample + the one-hot→index
     conversion the env needs, in a single XLA program. On a 1-core host the
@@ -195,12 +206,7 @@ def rollout_step(agent: PPOAgent, params: Any, obs: Dict[str, Array], key: Array
     loop pays (key split, sample, numpy argmax/split per action part) are a
     measurable fraction of the whole rollout — this folds them into one."""
     actions, logprob, values = sample_actions(agent, params, obs, key)
-    if agent.is_continuous:
-        real_actions = actions
-    else:
-        splits = np.cumsum(np.asarray(agent.actions_dim))[:-1].tolist()
-        parts = jnp.split(actions, splits, axis=-1)
-        real_actions = jnp.stack([p.argmax(-1) for p in parts], axis=-1)
+    real_actions = real_actions_from_onehot(agent.actions_dim, agent.is_continuous, actions)
     return actions, real_actions, logprob, values
 
 

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.algos.ppo.agent import CNNEncoder, MLPEncoder
+from sheeprl_tpu.algos.ppo.agent import CNNEncoder, MLPEncoder, real_actions_from_onehot
 from sheeprl_tpu.models import MLP
 from sheeprl_tpu.ops.distributions import Categorical, Independent, Normal
 from sheeprl_tpu.parallel.fabric import HostPlayerParams, put_tree
@@ -220,11 +220,28 @@ class RecurrentPPOPlayer(HostPlayerParams):
         )
         self._values = jax.jit(lambda p, o, pa, hx, cx: agent.apply(p, o, pa, hx, cx)[1])
 
+        def fused(p, o, pa, hx, cx, k, c):
+            actions, logprob, values, new_hx, new_cx = sample_actions(
+                agent, p, o, pa, hx, cx, jax.random.fold_in(k, c)
+            )
+            real = real_actions_from_onehot(agent.actions_dim, agent.is_continuous, actions)
+            return actions, real, logprob, values, new_hx, new_cx
+
+        # fused rollout step, same rationale as ppo.agent.rollout_step
+        self._rollout = jax.jit(fused)
+
     def update_params(self, params: Any) -> None:
         self.params = params
 
     def get_actions(self, obs, prev_actions, hx, cx, key, greedy: bool = False):
         return self._sample(self.params, obs, prev_actions, hx, cx, put_tree(key, self.device), greedy)
+
+    def rollout_actions(self, obs, prev_actions, hx, cx, key, counter):
+        """Fused rollout step (same rationale as ``ppo.agent.rollout_step``):
+        key folding by counter, sampling, and the one-hot→index conversion in
+        one jitted dispatch. Returns
+        ``(actions, real_actions, logprobs, values, hx, cx)``."""
+        return self._rollout(self.params, obs, prev_actions, hx, cx, key, counter)
 
     def get_values(self, obs, prev_actions, hx, cx) -> Array:
         return self._values(self.params, obs, prev_actions, hx, cx)

@@ -343,28 +343,24 @@ def main(fabric, cfg: Dict[str, Any]):
             )
         }
         with timer("Time/env_interaction_time"):
+            # fused rollout step: key folding, sampling and the real-action
+            # conversion in one jitted dispatch + one fetch per env step
+            update_key = player_key
             for _ in range(rollout_steps):
                 policy_step += num_envs * fabric.num_processes
-                player_key, action_key = jax.random.split(player_key)
                 obs_t = {k: v[None] for k, v in next_obs.items()}
-                actions, logprobs, values, new_hx, new_cx = player.get_actions(
-                    obs_t, prev_actions[None], hx, cx, action_key
+                actions, real_actions, logprobs, values, new_hx, new_cx = player.rollout_actions(
+                    obs_t, prev_actions[None], hx, cx, update_key, policy_step
                 )
-                actions_np, logprobs_np, values_np, new_hx, new_cx = jax.device_get(
-                    (actions, logprobs, values, new_hx, new_cx)
+                actions_np, real_actions, logprobs_np, values_np, new_hx, new_cx = jax.device_get(
+                    (actions, real_actions, logprobs, values, new_hx, new_cx)
                 )
                 actions_np = actions_np[0]
                 logprobs_np = logprobs_np[0]
                 values_np = values_np[0]
-                if is_continuous:
-                    real_actions = actions_np
-                else:
-                    splits = np.cumsum(actions_dim)[:-1]
-                    real_actions = np.stack(
-                        [p.argmax(-1) for p in np.split(actions_np, splits, axis=-1)], axis=-1
-                    )
-                    if real_actions.shape[-1] == 1 and not is_multidiscrete:
-                        real_actions = real_actions[..., 0]
+                real_actions = real_actions[0]
+                if not is_continuous and real_actions.shape[-1] == 1 and not is_multidiscrete:
+                    real_actions = real_actions[..., 0]
 
                 obs, rewards, terminated, truncated, info = envs.step(
                     real_actions.reshape(envs.action_space.shape)
