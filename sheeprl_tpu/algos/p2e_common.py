@@ -1,0 +1,61 @@
+"""Shared Plan2Explore constants and helpers.
+
+All three P2E generations log the same exploration metric surface (the
+reference repeats the set in ``sheeprl/algos/p2e_dv{1,2,3}/utils.py``; the
+names are the metric contract, so they must match). Each version's
+``utils.py`` keeps only its deltas: the registered-model set and any extra
+finetuning keys.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+# the exploration-phase metric names common to P2E DV1/DV2/DV3
+P2E_EXPLORATION_KEYS = frozenset(
+    {
+        "Rewards/rew_avg",
+        "Game/ep_len_avg",
+        "Loss/world_model_loss",
+        "Loss/value_loss_task",
+        "Loss/policy_loss_task",
+        "Loss/value_loss_exploration",
+        "Loss/policy_loss_exploration",
+        "Loss/observation_loss",
+        "Loss/reward_loss",
+        "Loss/state_loss",
+        "Loss/continue_loss",
+        "Loss/ensemble_loss",
+        "State/kl",
+        "State/post_entropy",
+        "State/prior_entropy",
+        "Params/exploration_amount",
+        "Rewards/intrinsic",
+        "Values_exploration/predicted_values",
+        "Values_exploration/lambda_values",
+        "Grads/world_model",
+        "Grads/actor_task",
+        "Grads/critic_task",
+        "Grads/actor_exploration",
+        "Grads/critic_exploration",
+        "Grads/ensemble",
+    }
+)
+
+# the plain Dreamer metric names the finetuning phase logs on top
+DREAMER_FINETUNING_KEYS = frozenset(
+    {"Loss/value_loss", "Loss/policy_loss", "Grads/actor", "Grads/critic"}
+)
+
+
+def make_log_models(models_to_register: Iterable[str]):
+    """Per-algo ``log_models_from_checkpoint`` bound to that algo's
+    registered-model set (reference per-algo log_models_from_checkpoint;
+    shared body in ``utils/model_manager.py``)."""
+
+    def log_models_from_checkpoint(fabric, cfg, state, artifacts_dir):
+        from sheeprl_tpu.utils.model_manager import log_models_from_checkpoint as _log
+
+        return _log(state, sorted(models_to_register), artifacts_dir)
+
+    return log_models_from_checkpoint

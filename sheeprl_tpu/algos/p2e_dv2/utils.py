@@ -3,39 +3,14 @@
 from __future__ import annotations
 
 from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test  # noqa: F401
+from sheeprl_tpu.algos.p2e_common import (
+    DREAMER_FINETUNING_KEYS,
+    P2E_EXPLORATION_KEYS,
+    make_log_models,
+)
 
-AGGREGATOR_KEYS = {
-    "Rewards/rew_avg",
-    "Game/ep_len_avg",
-    "Loss/world_model_loss",
-    "Loss/value_loss_task",
-    "Loss/policy_loss_task",
-    "Loss/value_loss_exploration",
-    "Loss/policy_loss_exploration",
-    "Loss/observation_loss",
-    "Loss/reward_loss",
-    "Loss/state_loss",
-    "Loss/continue_loss",
-    "Loss/ensemble_loss",
-    "State/kl",
-    "State/post_entropy",
-    "State/prior_entropy",
-    "Params/exploration_amount",
-    "Rewards/intrinsic",
-    "Values_exploration/predicted_values",
-    "Values_exploration/lambda_values",
-    "Grads/world_model",
-    "Grads/actor_task",
-    "Grads/critic_task",
-    "Grads/actor_exploration",
-    "Grads/critic_exploration",
-    "Grads/ensemble",
-    # finetuning logs the plain Dreamer-V2 metric set
-    "Loss/value_loss",
-    "Loss/policy_loss",
-    "Grads/actor",
-    "Grads/critic",
-}
+# finetuning logs the plain Dreamer-V2 metric set on top
+AGGREGATOR_KEYS = set(P2E_EXPLORATION_KEYS | DREAMER_FINETUNING_KEYS)
 MODELS_TO_REGISTER = {
     "world_model",
     "ensembles",
@@ -49,11 +24,4 @@ MODELS_TO_REGISTER = {
 
 __all__ = ["AGGREGATOR_KEYS", "MODELS_TO_REGISTER", "prepare_obs", "test"]
 
-
-def log_models_from_checkpoint(fabric, cfg, state, artifacts_dir):
-    """Pickle this algorithm's registered sub-models from a checkpoint
-    (reference per-algo log_models_from_checkpoint; shared body in
-    utils/model_manager.py)."""
-    from sheeprl_tpu.utils.model_manager import log_models_from_checkpoint as _log
-
-    return _log(state, sorted(MODELS_TO_REGISTER), artifacts_dir)
+log_models_from_checkpoint = make_log_models(MODELS_TO_REGISTER)
