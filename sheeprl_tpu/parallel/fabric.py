@@ -368,6 +368,7 @@ class _ParamStreamer:
         self.device = device
         sizes = [int(np.prod(s)) * d.itemsize for s, d in zip(self.shapes, self.dtypes)]
         self.offsets = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+        self.nbytes = int(self.offsets[-1])  # pack size; sizes the landing estimate
 
         def _to_bytes(leaf, dtype):
             if dtype == jnp.uint8:
@@ -479,8 +480,30 @@ class _StreamPipe:
         self._inflight: Optional[Tuple[Any, float]] = None
         self._candidate: Any = None
 
+    @staticmethod
+    def _link_bytes_per_s() -> float:
+        """Assumed device→host bulk bandwidth for the landing estimate —
+        conservative floor of the measured ~14 MB/s tunnel rate (BASELINE.md
+        link table); override with SHEEPRL_TPU_LINK_BYTES_PER_S."""
+        try:
+            return max(float(os.environ.get("SHEEPRL_TPU_LINK_BYTES_PER_S", 10e6)), 1e3)
+        except ValueError:
+            return 10e6
+
     def _age_threshold(self) -> float:
-        return max(1.5 * dispatch_roundtrip_seconds(), 0.02)
+        # the copy cannot have landed before bytes/bandwidth + one RTT have
+        # passed; polling earlier turns the "free" finish into a BLOCKING
+        # partial-transfer wait (measured 1.5 s per poll on ~20 MB packs in
+        # the SAC-AE loop, which polls every update). Waiting the full
+        # landing estimate costs only param staleness, which the async
+        # design already accepts. The bytes term only applies on REMOTE
+        # links (same RTT probe as player auto-placement) — a locally
+        # attached device moves GB/s and the old cheap gate is right.
+        rtt = dispatch_roundtrip_seconds()
+        if rtt <= _RTT_PROBE_THRESHOLD_S:
+            return max(1.5 * rtt, 0.02)
+        xfer = self.streamer.nbytes / self._link_bytes_per_s()
+        return max(1.5 * rtt, 0.02, xfer + rtt)
 
     def offer(self, tree: Any) -> None:
         import time
