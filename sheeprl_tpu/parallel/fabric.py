@@ -486,9 +486,12 @@ class _StreamPipe:
         conservative floor of the measured ~14 MB/s tunnel rate (BASELINE.md
         link table); override with SHEEPRL_TPU_LINK_BYTES_PER_S."""
         try:
-            return max(float(os.environ.get("SHEEPRL_TPU_LINK_BYTES_PER_S", 10e6)), 1e3)
+            value = float(os.environ.get("SHEEPRL_TPU_LINK_BYTES_PER_S", 10e6))
         except ValueError:
             return 10e6
+        # `v > 1e3` is False for nan too — max() would keep nan and silently
+        # disable the bytes term of the gate
+        return value if value > 1e3 else 1e3
 
     def _age_threshold(self) -> float:
         # the copy cannot have landed before bytes/bandwidth + one RTT have

@@ -199,3 +199,45 @@ def test_player_on_explicit_device_end_to_end():
     player.update_params(params)
     leaf = jax.tree.leaves(player.params)[0]
     assert leaf.devices() == {dev}
+
+
+def test_age_threshold_scales_with_pack_size_on_remote_links(monkeypatch):
+    """The stream gate waits for the landing estimate (bytes/bandwidth + RTT)
+    on remote links, and keeps the cheap RTT-only gate locally — polling a
+    large pack early turns the 'free' finish into a blocking partial-transfer
+    wait (the round-4 SAC-AE 1.5 s/update regression)."""
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.parallel import fabric as fabric_mod
+    from sheeprl_tpu.parallel.fabric import _ParamStreamer, _StreamPipe
+
+    monkeypatch.delenv("SHEEPRL_TPU_LINK_BYTES_PER_S", raising=False)
+    dev = jax.devices()[0]
+    big = {"w": jnp.zeros((1_000_000,), jnp.float32)}  # 4 MB pack
+    pipe = _StreamPipe(_ParamStreamer(big, dev))
+
+    # local link (sub-threshold RTT): old cheap gate, bytes ignored
+    monkeypatch.setitem(fabric_mod._rtt_cache, "rtt", 0.0001)
+    assert pipe._age_threshold() == pytest.approx(0.02)
+
+    # remote link: the 4 MB pack cannot land before bytes/bandwidth + RTT
+    monkeypatch.setitem(fabric_mod._rtt_cache, "rtt", 0.1)
+    expected = 4_000_000 / _StreamPipe._link_bytes_per_s() + 0.1
+    assert pipe._age_threshold() == pytest.approx(expected)
+
+    # a tiny pack on a remote link keeps the RTT-dominated gate
+    small = _StreamPipe(_ParamStreamer({"w": jnp.zeros((4,), jnp.float32)}, dev))
+    assert small._age_threshold() == pytest.approx(0.15)
+
+
+def test_link_bytes_per_s_env_validation(monkeypatch):
+    from sheeprl_tpu.parallel.fabric import _StreamPipe
+
+    monkeypatch.setenv("SHEEPRL_TPU_LINK_BYTES_PER_S", "0")
+    assert _StreamPipe._link_bytes_per_s() == 1e3  # floored, no ZeroDivision
+    monkeypatch.setenv("SHEEPRL_TPU_LINK_BYTES_PER_S", "14MB")
+    assert _StreamPipe._link_bytes_per_s() == 10e6  # malformed -> default
+    monkeypatch.setenv("SHEEPRL_TPU_LINK_BYTES_PER_S", "5e7")
+    assert _StreamPipe._link_bytes_per_s() == 5e7
+    monkeypatch.setenv("SHEEPRL_TPU_LINK_BYTES_PER_S", "nan")
+    assert _StreamPipe._link_bytes_per_s() == 1e3  # nan must not disable the gate
