@@ -181,7 +181,43 @@ def bench_ppo() -> float:
     return rec["steps"] / rec["seconds"]
 
 
+def wait_for_backend(max_wait_s: float = 1200.0) -> None:
+    """Block until the accelerator backend initializes (probed in a
+    SUBPROCESS so a failed attempt cannot poison this process's backend
+    cache). The tunnel to the pooled chip drops occasionally for tens of
+    minutes (observed 2026-07-31); without this, a driver bench run that
+    lands in an outage records nothing at all."""
+    import subprocess
+    import sys
+
+    deadline = time.time() + max_wait_s
+    while True:
+        detail = ""
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=180,
+                capture_output=True,
+                text=True,
+            )
+            ok = proc.returncode == 0
+            detail = (proc.stderr or "").strip().splitlines()[-1:] or [""]
+            detail = detail[0][-200:]
+        except subprocess.TimeoutExpired:
+            ok = False
+            detail = "probe timed out after 180s"
+        if ok or time.time() > deadline:
+            return  # proceed either way; a real failure surfaces in the run
+        print(
+            f"# backend unavailable ({detail}); retrying for {int(deadline - time.time())}s",
+            file=sys.stderr,
+            flush=True,
+        )
+        time.sleep(60)
+
+
 def main() -> None:
+    wait_for_backend()
     import jax
 
     probes = [link_probe("before")]
