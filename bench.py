@@ -147,6 +147,25 @@ def _read_probe(path, workload):
         return json.load(f)
 
 
+def _ppo_args(total_steps: int):
+    return [
+        "exp=ppo",
+        f"algo.total_steps={total_steps}",
+        "env.num_envs=64",
+        # SyncVectorEnv for parity with the torch baseline (its loop is
+        # sync); 64 async workers on one core spend more time in
+        # multiprocessing pipes than in the envs
+        "env.sync_env=True",
+        "algo.per_rank_batch_size=512",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "algo.run_test=False",
+        "checkpoint.every=10000000",
+        "checkpoint.save_last=False",
+        "metric.log_level=0",
+    ]
+
+
 def bench_ppo() -> float:
     import os
     import tempfile
@@ -157,24 +176,7 @@ def bench_ppo() -> float:
         probe = os.path.join(d, "ppo_bench.json")
         os.environ["SHEEPRL_TPU_BENCH_JSON"] = probe
         try:
-            run(
-                [
-                    "exp=ppo",
-                    f"algo.total_steps={PPO_STEPS}",
-                    "env.num_envs=64",
-                    # SyncVectorEnv for parity with the torch baseline (its
-                    # loop is sync); 64 async workers on one core spend more
-                    # time in multiprocessing pipes than in the envs
-                    "env.sync_env=True",
-                    "algo.per_rank_batch_size=512",
-                    "env.capture_video=False",
-                    "buffer.memmap=False",
-                    "algo.run_test=False",
-                    "checkpoint.every=10000000",
-                    "checkpoint.save_last=False",
-                    "metric.log_level=0",
-                ]
-            )
+            run(_ppo_args(PPO_STEPS))
         finally:
             os.environ.pop("SHEEPRL_TPU_BENCH_JSON", None)
         rec = _read_probe(probe, "ppo")

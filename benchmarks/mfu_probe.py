@@ -65,6 +65,18 @@ SIZES = {
 from sheeprl_tpu.utils.profiler import PEAK_BF16_FLOPS as PEAK_BF16
 from sheeprl_tpu.utils.profiler import tiny_op_rtt_seconds as tiny_rtt
 
+# static base of every probe config (per-size deltas come from SIZES; batch
+# and sequence length are appended per run)
+BASE_OVERRIDES = [
+    "exp=dreamer_v3",
+    "env=dummy",
+    "env.id=dummy_discrete",
+    "env.screen_size=64",
+    "env.num_envs=1",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[]",
+]
+
 
 def build_step(size: str, batch_size: int, seq_len: int):
     """(train_fn, args tuple) at `size`, mirroring dreamer_v3.main's build."""
@@ -79,13 +91,7 @@ def build_step(size: str, batch_size: int, seq_len: int):
     from sheeprl_tpu.parallel.fabric import Fabric
 
     overrides = [
-        "exp=dreamer_v3",
-        "env=dummy",
-        "env.id=dummy_discrete",
-        "env.screen_size=64",
-        "env.num_envs=1",
-        "algo.cnn_keys.encoder=[rgb]",
-        "algo.mlp_keys.encoder=[]",
+        *BASE_OVERRIDES,
         *SIZES[size],
         f"algo.per_rank_batch_size={batch_size}",
         f"algo.per_rank_sequence_length={seq_len}",

@@ -1,0 +1,45 @@
+"""The bench harness's workload configs must always compose — config-tree
+drift (renamed keys, removed groups) would otherwise only surface in the
+driver's end-of-round bench run, where it costs the round its numbers."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).parents[2]
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench_module", _REPO_ROOT / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("bench_module", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_dv3_overrides_compose():
+    from sheeprl_tpu.config.compose import compose
+
+    bench = _load_bench()
+    cfg = compose("config", bench._dv3_args(bench.DV3_STEPS))
+    assert cfg.algo.name == "dreamer_v3"
+    assert cfg.env.sync_env is True
+    assert cfg.algo.total_steps == bench.DV3_STEPS
+
+
+def test_bench_ppo_overrides_compose():
+    from sheeprl_tpu.config.compose import compose
+
+    bench = _load_bench()
+    cfg = compose("config", bench._ppo_args(bench.PPO_STEPS))
+    assert cfg.algo.name == "ppo"
+    assert cfg.env.num_envs == 64 and cfg.env.sync_env is True
+
+
+def test_mfu_probe_sizes_compose():
+    from benchmarks.mfu_probe import BASE_OVERRIDES, SIZES
+    from sheeprl_tpu.config.compose import compose
+
+    for size, overrides in SIZES.items():
+        cfg = compose("config", [*BASE_OVERRIDES, *overrides])
+        assert cfg.algo.name == "dreamer_v3", size
