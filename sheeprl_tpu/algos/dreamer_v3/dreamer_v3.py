@@ -509,8 +509,7 @@ def main(fabric, cfg: Dict[str, Any]):
     probe = SteadyStateProbe()
     bench_batch = None  # one sampled batch kept for the post-run cost analysis
     for update in range(start_step, num_updates + 1):
-        if update == learning_starts + 64:
-            probe.mark(policy_step, work=cumulative_per_rank_gradient_steps)
+        probe.mark_warm(update, learning_starts, policy_step, work=cumulative_per_rank_gradient_steps)
         policy_step += num_envs * num_processes
 
         with timer("Time/env_interaction_time"):

@@ -43,7 +43,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, gradient_step_chunks, save_configs, weighted_chunk_metrics
+from sheeprl_tpu.utils.utils import Ratio, SteadyStateProbe, gradient_step_chunks, save_configs, weighted_chunk_metrics
 
 
 def make_train_fn(fabric, agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg):
@@ -292,7 +292,10 @@ def main(fabric, cfg: Dict[str, Any]):
     obs, _ = envs.reset(seed=cfg.seed)
     cumulative_per_rank_gradient_steps = 0
     step_data: Dict[str, np.ndarray] = {}
+    # steady-state throughput probe (SHEEPRL_TPU_BENCH_JSON contract)
+    probe = SteadyStateProbe()
     for update in range(start_step, num_updates + 1):
+        probe.mark_warm(update, learning_starts, policy_step, work=cumulative_per_rank_gradient_steps)
         policy_step += num_envs * num_processes
 
         with timer("Time/env_interaction_time"):
@@ -458,6 +461,12 @@ def main(fabric, cfg: Dict[str, Any]):
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
+    probe.finish(
+        policy_step,
+        # a materializing fetch is the only real device sync on the tunnel
+        sync=lambda: np.asarray(jax.device_get(agent.log_alpha)),
+        work=cumulative_per_rank_gradient_steps,
+    )
     # land any in-flight async param stream before the final evaluation
     player.flush_stream_attrs()
     envs.close()

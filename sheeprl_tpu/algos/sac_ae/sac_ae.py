@@ -45,7 +45,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, gradient_step_chunks, save_configs, weighted_chunk_metrics
+from sheeprl_tpu.utils.utils import Ratio, SteadyStateProbe, gradient_step_chunks, save_configs, weighted_chunk_metrics
 
 
 def make_train_fn(fabric, agent: SACAEAgent, actor_tx, qf_tx, alpha_tx, encoder_tx, decoder_tx, cfg):
@@ -396,14 +396,10 @@ def main(fabric, cfg: Dict[str, Any]):
     obs, _ = envs.reset(seed=cfg.seed)
     cumulative_per_rank_gradient_steps = 0
     step_data: Dict[str, np.ndarray] = {}
-    # steady-state throughput probe (SHEEPRL_TPU_BENCH_JSON contract): warm
-    # from 64 updates past the first train event, like the Dreamer loops
-    from sheeprl_tpu.utils.utils import SteadyStateProbe
-
+    # steady-state throughput probe (SHEEPRL_TPU_BENCH_JSON contract)
     probe = SteadyStateProbe()
     for update in range(start_step, num_updates + 1):
-        if update == learning_starts + 64:
-            probe.mark(policy_step, work=cumulative_per_rank_gradient_steps)
+        probe.mark_warm(update, learning_starts, policy_step, work=cumulative_per_rank_gradient_steps)
         policy_step += num_envs * num_processes
 
         with timer("Time/env_interaction_time"):

@@ -215,6 +215,18 @@ class SteadyStateProbe:
     def active(self) -> bool:
         return self.path is not None
 
+    #: updates past the first train event before the window opens — enough
+    #: for every gradient-path compile (incl. the chunked-scan variants) to
+    #: have happened, shared by all off-policy loops
+    WARMUP_UPDATES = 64
+
+    def mark_warm(self, update: int, learning_starts: int, step: int, work: int = 0) -> None:
+        """Open the window once ``update`` reaches the shared warm point
+        (``learning_starts + WARMUP_UPDATES``) — the one probe convention of
+        the off-policy/Dreamer loops, kept here so it cannot drift."""
+        if update == learning_starts + self.WARMUP_UPDATES:
+            self.mark(step, work=work)
+
     def mark(self, step: int, work: int = 0) -> None:
         """``work`` is the loop's cumulative gradient-step counter at the
         mark, so the window's training work can be reported alongside its
