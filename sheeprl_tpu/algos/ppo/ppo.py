@@ -274,7 +274,10 @@ def main(fabric, cfg: Dict[str, Any]):
             # once per update. Over a remote-attached TPU separate fetches
             # would cost ~100ms each; on the 1-core host the saved dispatches
             # are a measurable slice of the step budget.
-            update_key = player_key
+            # fold the update index into the base key so action-stream
+            # uniqueness holds even if policy_step bookkeeping ever repeats a
+            # value across a resume (rollout_actions folds policy_step on top)
+            update_key = jax.random.fold_in(player_key, update)
             for _ in range(rollout_steps):
                 policy_step += num_envs * fabric.num_processes
                 actions, real_actions, logprobs, values = player.rollout_actions(

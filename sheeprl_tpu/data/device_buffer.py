@@ -379,7 +379,11 @@ class DeviceReplayBuffer:
         for env in np.unique(env_idx):
             valid = self._valid_items(int(env), sample_next_obs)
             if len(valid) == 0:
-                raise RuntimeError(
+                # ValueError to match the host ReplayBuffer contract for
+                # empty/insufficient data (buffers.py raises ValueError there
+                # and RuntimeError only for the uninitialized ring) so
+                # buffer-mode-swapping callers catch one exception type
+                raise ValueError(
                     "You want to sample the next observations, but not enough samples have been "
                     f"added to env {env}. Make sure that at least two samples are added."
                     if sample_next_obs
@@ -553,6 +557,13 @@ class DeviceReplayBuffer:
             memmap=memmap,
             memmap_dir=memmap_dir,
         )
+        if not ((self._pos == self._pos[0]).all() and (self._full == self._full[0]).all()):
+            raise RuntimeError(
+                "to_transition_host_buffer requires lockstep env cursors (the plain "
+                f"ReplayBuffer has one global cursor) but pos={self._pos.tolist()} "
+                f"full={self._full.tolist()} — this ring was written with partial "
+                "per-env adds; convert with to_host_buffer() instead"
+            )
         arrays = self.host_arrays()
         host.add({k: v.swapaxes(0, 1) for k, v in arrays.items()})
         host._pos = int(self._pos[0])

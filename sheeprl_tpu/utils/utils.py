@@ -223,8 +223,10 @@ class SteadyStateProbe:
     def mark_warm(self, update: int, learning_starts: int, step: int, work: int = 0) -> None:
         """Open the window once ``update`` reaches the shared warm point
         (``learning_starts + WARMUP_UPDATES``) — the one probe convention of
-        the off-policy/Dreamer loops, kept here so it cannot drift."""
-        if update == learning_starts + self.WARMUP_UPDATES:
+        the off-policy/Dreamer loops, kept here so it cannot drift. ``>=``
+        (not ``==``) so a resumed run whose start update is already past the
+        warm point still opens the window; mark() is idempotent."""
+        if update >= learning_starts + self.WARMUP_UPDATES:
             self.mark(step, work=work)
 
     def mark(self, step: int, work: int = 0) -> None:

@@ -200,7 +200,9 @@ def test_sample_transitions_errors_match_host_contract():
     with pytest.raises(RuntimeError, match="has not been initialized"):
         rb.sample_transitions(batch_size=2)
     rb.add({"observations": np.zeros((1, 1, 1), np.float32)})
-    with pytest.raises(RuntimeError, match="at least two samples"):
+    # insufficient data is ValueError, matching the host ReplayBuffer
+    # contract (RuntimeError stays reserved for the uninitialized ring)
+    with pytest.raises(ValueError, match="at least two samples"):
         rb.sample_transitions(batch_size=2, sample_next_obs=True)
     with pytest.raises(ValueError, match="must be both greater than 0"):
         rb.sample_transitions(batch_size=0)

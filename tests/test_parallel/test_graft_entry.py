@@ -1,0 +1,58 @@
+"""Driver-entry hardening tests.
+
+Round-4 failure mode: ``MULTICHIP_r04.json`` recorded rc=124 because
+``dryrun_multichip`` consulted ``jax.devices()`` in the driver's process —
+initializing the default (axon TPU) backend, which blocks forever when the
+tunnel to the remote-attached chip is down. The contract under test: the
+parent process NEVER imports jax; the whole dry run happens in a fresh
+``JAX_PLATFORMS=cpu`` child, so its outcome is independent of accelerator
+health.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_PARENT_BLOCKER = r"""
+import sys
+
+class _NoJax:
+    # Simulate a dead accelerator backend: ANY jax import in this process
+    # fails loudly (a dead tunnel would instead hang backend init forever;
+    # failing fast keeps the test deterministic while proving the same
+    # thing: the parent code path never needs jax).
+    def find_spec(self, name, path=None, target=None):
+        if name == "jax" or name.startswith("jax."):
+            raise ImportError("parent process must not import jax (simulated dead backend)")
+        return None
+
+sys.meta_path.insert(0, _NoJax())
+
+import importlib.util
+
+spec = importlib.util.spec_from_file_location("__graft_entry__", sys.argv[1])
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+mod.dryrun_multichip(2)
+print("PARENT-NEVER-IMPORTED-JAX")
+"""
+
+
+def test_dryrun_parent_never_imports_jax():
+    env = dict(os.environ)
+    env.pop("_SHEEPRL_TPU_DRYRUN_CHILD", None)
+    # the child must not inherit the test harness's 8-device flag untouched —
+    # the entry rewrites it for its own device count; nothing to scrub here
+    proc = subprocess.run(
+        [sys.executable, "-c", _PARENT_BLOCKER, os.path.join(REPO_ROOT, "__graft_entry__.py")],
+        env=env,
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=480,
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n{proc.stderr[-2000:]}"
+    assert "PARENT-NEVER-IMPORTED-JAX" in proc.stdout
+    assert "fused train step OK" in proc.stdout

@@ -1,0 +1,118 @@
+"""bench.py outage hardening (round-4 failure: one tunnel outage produced
+rc=124 and NO JSON at all — ``BENCH_r04.json parsed: null``).
+
+Contract under test: ``python bench.py`` ALWAYS prints one parseable JSON
+line. When the backend probe cannot succeed (dead or hanging), the line
+carries the last-known-good numbers from ``BENCH_CACHE.json`` plus
+``"outage": true`` — and it does so fast, well inside any external timeout.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+BENCH = os.path.join(REPO_ROOT, "bench.py")
+
+
+def _run_bench(extra_env, timeout=120):
+    env = dict(os.environ)
+    env.update(extra_env)
+    proc = subprocess.run(
+        [sys.executable, BENCH],
+        env=env,
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-2000:]}"
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert lines, f"no JSON line in stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-2000:]}"
+    return json.loads(lines[-1])
+
+
+def test_outage_emits_cached_record_when_probe_fails_fast():
+    rec = _run_bench(
+        {
+            "SHEEPRL_TPU_BENCH_PROBE_CMD": "false",
+            "SHEEPRL_TPU_BENCH_MAX_WAIT_SECONDS": "1",
+        }
+    )
+    assert rec["outage"] is True
+    assert rec["metric"] == "dreamer_v3_env_steps_per_sec_per_chip"
+    # the committed BENCH_CACHE.json seed carries the last driver-captured
+    # numbers — an outage must surface them, not null
+    assert rec["value"] is not None
+    assert rec.get("cached_from")
+
+
+def test_outage_emits_within_budget_when_probe_hangs():
+    """A probe that HANGS (the real round-4 signature) must not stall the
+    record: the per-probe timeout bounds each attempt and the wait budget
+    bounds the loop."""
+    t0 = time.monotonic()
+    rec = _run_bench(
+        {
+            "SHEEPRL_TPU_BENCH_PROBE_CMD": "sleep 300",
+            "SHEEPRL_TPU_BENCH_PROBE_TIMEOUT": "2",
+            "SHEEPRL_TPU_BENCH_MAX_WAIT_SECONDS": "3",
+        },
+        timeout=90,
+    )
+    assert rec["outage"] is True
+    assert time.monotonic() - t0 < 60
+    assert rec["value"] is not None
+
+
+def test_assemble_partial_marks_stale_sections():
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    cache = {
+        "record": {
+            "value": {
+                "metric": "dreamer_v3_env_steps_per_sec_per_chip",
+                "value": 100.0,
+                "unit": "steps/sec",
+                "vs_baseline": 24.0,
+                "secondary": {"metric": "ppo_cartpole_env_steps_per_sec", "value": 5000.0},
+            },
+            "provenance": "test-seed",
+        }
+    }
+    fresh = bench._assemble({"steps": 2048, "seconds": 10.0}, None, [])
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench._emit_from_cache(cache, "ppo timed out", fresh)
+    rec = json.loads(buf.getvalue())
+    # fresh dv3 section overrides the cached one; ppo stays cached + stale
+    assert rec["value"] == 204.8
+    assert rec["secondary"]["value"] == 5000.0
+    assert rec["stale"] == ["secondary"]
+    assert rec["outage"] is True
+    assert rec["cached_from"] == "test-seed"
+
+
+def test_cache_checkpoint_roundtrip(tmp_path, monkeypatch):
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    monkeypatch.setattr(bench, "_CACHE_PATH", str(tmp_path / "cache.json"))
+    cache = bench._load_cache()
+    assert cache == {}
+    bench._checkpoint(cache, "dv3", {"steps": 1, "seconds": 2.0}, "unit-test")
+    again = bench._load_cache()
+    assert again["dv3"]["value"] == {"steps": 1, "seconds": 2.0}
+    assert again["dv3"]["provenance"] == "unit-test"
