@@ -383,6 +383,10 @@ def _merge_fresh(cached_value: dict | None, fresh: dict | None) -> dict:
     if "value" in fresh:
         for k in _DV3_DERIVED_KEYS:
             record.pop(k, None)
+    if "link_probe" not in fresh:
+        # never re-emit another run's probe diagnostics as if they described
+        # THIS run's link health
+        record.pop("link_probe", None)
     record.update(fresh)
     return record
 

@@ -45,6 +45,15 @@ def resume_from_checkpoint(cfg: dotdict) -> dotdict:
         )
     merged = dotdict(old_cfg.to_dict())
     merged.checkpoint = dotdict(cfg.checkpoint.to_dict())
+    # TOPOLOGY comes from the resuming invocation (elastic restore: the
+    # checkpoint stores global-batch counters and host-layout arrays, so an
+    # 8-device checkpoint reshards onto whatever mesh this run was launched
+    # with — the reference refuses world-size changes instead); everything
+    # else in fabric (precision, mesh_axes, accelerator) keeps the STORED
+    # values so a resume can't silently change the run's numerics.
+    for key in ("devices", "num_nodes", "mesh_shape"):
+        if key in (cfg.fabric or {}):
+            merged.fabric[key] = cfg.fabric[key]
     merged.root_dir = cfg.root_dir
     merged.run_name = cfg.run_name
     return merged

@@ -210,6 +210,7 @@ class SteadyStateProbe:
         self.path = os.environ.get("SHEEPRL_TPU_BENCH_JSON")
         self._t0: float | None = None
         self._step0 = 0
+        self._first_update: int | None = None
 
     @property
     def active(self) -> bool:
@@ -221,12 +222,20 @@ class SteadyStateProbe:
     WARMUP_UPDATES = 64
 
     def mark_warm(self, update: int, learning_starts: int, step: int, work: int = 0) -> None:
-        """Open the window once ``update`` reaches the shared warm point
-        (``learning_starts + WARMUP_UPDATES``) — the one probe convention of
-        the off-policy/Dreamer loops, kept here so it cannot drift. ``>=``
-        (not ``==``) so a resumed run whose start update is already past the
-        warm point still opens the window; mark() is idempotent."""
-        if update >= learning_starts + self.WARMUP_UPDATES:
+        """Open the window once ``update`` reaches the shared warm point —
+        the one probe convention of the off-policy/Dreamer loops, kept here
+        so it cannot drift. Two conditions, both required:
+
+        - ``learning_starts + WARMUP_UPDATES``: past the first train event's
+          compiles (the fresh-run rule);
+        - ``first observed update + WARMUP_UPDATES``: a RESUMED run whose
+          start update is already beyond the fresh-run warm point still does
+          its gradient-path compiles on its first update — opening there
+          would put minutes of compile time inside the measured window.
+        """
+        if self._first_update is None:
+            self._first_update = update
+        if update >= learning_starts + self.WARMUP_UPDATES and update >= self._first_update + self.WARMUP_UPDATES:
             self.mark(step, work=work)
 
     def mark(self, step: int, work: int = 0) -> None:

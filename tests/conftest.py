@@ -6,11 +6,24 @@ are exercised without TPU hardware — the JAX equivalent of the reference's
 """
 
 import os
+import tempfile
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Persistent XLA compilation cache shared by this process AND every
+# subprocess the suite spawns (multi-process collective tests, CLI children —
+# they inherit the env var): identical tiny training graphs recompile once
+# per host instead of once per interpreter, which is most of the algo tier's
+# wall time on a 1-core host. Opt out with SHEEPRL_TPU_NO_COMPILE_CACHE=1.
+if not os.environ.get("SHEEPRL_TPU_NO_COMPILE_CACHE"):
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(tempfile.gettempdir(), "sheeprl_tpu_xla_cache"),
+    )
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
 import jax  # noqa: E402
 

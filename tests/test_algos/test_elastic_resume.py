@@ -1,0 +1,62 @@
+"""Elastic checkpoint restore: a run saved on one mesh size resumes on
+another (VERDICT round-4 item 5b; reference semantics: the checkpoint stores
+the GLOBAL batch — ``dreamer_v3.py`` writes ``batch_size = per_rank *
+world_size`` and resume divides by the NEW world size — while the reference
+itself refuses world-size changes, callback.py:87-142).
+
+Device elasticity is the TPU-native win: params checkpoint as host arrays
+(sharding-free), so an 8-chip run's state reshards onto any divisor mesh at
+resume. These tests drive DV3 end to end on the virtual CPU mesh: save on 8
+devices, resume on 4, then grow 4 -> 8.
+"""
+
+import os
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.utils.checkpoint import load_checkpoint
+from tests.conftest import find_checkpoints
+from tests.test_algos.test_dreamer_v3 import dv3_args
+
+
+def _elastic_args(tmp_path):
+    # a REAL (non-dry_run) schedule so the resumed half actually trains:
+    # 2 envs -> 2 policy steps/update, total 8 steps = 4 updates, mid-run
+    # checkpoint at update 2. per_rank_batch_size is per DEVICE: 8 devices
+    # x 1 -> global batch 8, which resharding onto 4 devices turns into
+    # per-device 2.
+    args = [a for a in dv3_args(tmp_path) if a != "dry_run=True"]
+    return args + [
+        "buffer.checkpoint=True",
+        "algo.total_steps=8",
+        "algo.learning_starts=2",
+        "checkpoint.every=4",
+        "algo.run_test=False",
+    ]
+
+
+def test_dv3_save_on_8_resume_on_4(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(_elastic_args(tmp_path) + ["fabric.devices=8"])
+    ckpt = min(find_checkpoints(tmp_path), key=os.path.getmtime)  # the mid-run one
+    saved = load_checkpoint(ckpt)
+    assert saved["batch_size"] == 8  # global batch recorded, not per-device
+
+    latest_before = max(os.path.getmtime(p) for p in find_checkpoints(tmp_path))
+    run(_elastic_args(tmp_path) + ["fabric.devices=4", f"checkpoint.resume_from={ckpt}"])
+    newest = max(find_checkpoints(tmp_path), key=os.path.getmtime)
+    assert os.path.getmtime(newest) > latest_before, "resumed run wrote no checkpoint"
+    resumed = load_checkpoint(newest)
+    # the global batch is preserved across the mesh change
+    assert resumed["batch_size"] == 8
+    assert resumed["update"] > saved["update"]
+
+
+def test_dv3_save_on_4_resume_on_8(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(_elastic_args(tmp_path) + ["fabric.devices=4", "algo.per_rank_batch_size=2"])
+    ckpt = min(find_checkpoints(tmp_path), key=os.path.getmtime)
+    latest_before = max(os.path.getmtime(p) for p in find_checkpoints(tmp_path))
+    run(_elastic_args(tmp_path) + ["fabric.devices=8", f"checkpoint.resume_from={ckpt}"])
+    newest = max(find_checkpoints(tmp_path), key=os.path.getmtime)
+    assert os.path.getmtime(newest) > latest_before, "resumed run wrote no checkpoint"
+    assert load_checkpoint(newest)["batch_size"] == 8

@@ -155,6 +155,75 @@ def test_dmc_adapter_rejects_no_obs_source(monkeypatch):
     sys.modules.pop("sheeprl_tpu.envs.dmc", None)
 
 
+def test_dmc_variant_wrappers_with_fake_backend(monkeypatch):
+    """The fork-experiment variants layer distractor observations on the base
+    adapter (reference dmc_64.py:153-201 / dmc_extended.py): every declared
+    space must be produced at reset AND step, with the combined scalar mixing
+    pixel[0,0,0] with state[0]."""
+    _install_fake_dmc(monkeypatch)
+    sys.modules.pop("sheeprl_tpu.envs.dmc_variants", None)
+    variants = importlib.import_module("sheeprl_tpu.envs.dmc_variants")
+
+    env = variants.DMC64Wrapper("walker", "walk", from_pixels=True, from_vectors=True, height=16, width=16)
+    assert env.observation_space["camera_rgb"].shape == (64, 64, 1)
+    assert env.observation_space["camera_depth"].shape == (64, 64, 1)
+    for obs in (env.reset()[0], env.step(np.zeros(2, np.float32))[0]):
+        assert set(obs) == set(env.observation_space.spaces)
+        for k, space in env.observation_space.spaces.items():
+            assert obs[k].shape == space.shape, k
+
+    env = variants.DMCExtendedWrapper("walker", "walk", from_pixels=True, from_vectors=True, height=16, width=16)
+    assert env.observation_space["random_img"].shape == (16, 16, 3)
+    assert env.observation_space["random_values"].shape == (10,)
+    obs, _ = env.reset()
+    assert set(obs) == set(env.observation_space.spaces)
+    assert np.isclose(obs["combined_values"][0], float(obs["rgb"][0, 0, 0]) + float(obs["state"][0]))
+
+    # vectors-only: no distractors beyond the base spaces
+    env = variants.DMCExtendedWrapper("walker", "walk", from_pixels=False, from_vectors=True)
+    assert set(env.observation_space.spaces) == {"state"}
+    sys.modules.pop("sheeprl_tpu.envs.dmc_variants", None)
+    sys.modules.pop("sheeprl_tpu.envs.dmc", None)
+
+
+def test_dmc_through_env_factory(monkeypatch):
+    """Drive the full factory path (``env=dmc`` config -> make_env thunk ->
+    wrapped Dict obs env) against the fake backend — the adapter contract the
+    reference exercises with real dm_control (sheeprl/envs/dmc.py:49-244)."""
+    _install_fake_dmc(monkeypatch)
+    sys.modules.pop("sheeprl_tpu.envs.dmc", None)
+
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.envs.factory import make_env
+    from sheeprl_tpu.utils.utils import dotdict
+
+    cfg = dotdict(
+        compose(
+            "config",
+            [
+                "exp=dreamer_v3",
+                "env=dmc",
+                "env.capture_video=False",
+                "env.screen_size=16",
+                "algo.cnn_keys.encoder=[rgb]",
+                "algo.mlp_keys.encoder=[]",
+                "metric.log_level=0",
+            ],
+        )
+    )
+    env = make_env(cfg, seed=7, rank=0)()
+    try:
+        obs, _ = env.reset(seed=7)
+        assert obs["rgb"].shape == (16, 16, 3) and obs["rgb"].dtype == np.uint8
+        # action_repeat=2 (the dmc recipe): one env.step drives two backend steps
+        obs, reward, terminated, truncated, info = env.step(env.action_space.sample())
+        assert obs["rgb"].shape == (16, 16, 3)
+        assert np.isclose(reward, 1.0)  # 2 backend steps x 0.5 reward each
+    finally:
+        env.close()
+    sys.modules.pop("sheeprl_tpu.envs.dmc", None)
+
+
 # ------------------------------------------------------------------ DIAMBRA
 
 
