@@ -970,10 +970,12 @@ def build_agent(
         else jax.tree.map(jnp.copy, critic_params)
     )
 
-    wm_params = fabric.replicate(wm_params)
-    actor_params = fabric.replicate(actor_params)
-    critic_params = fabric.replicate(critic_params)
-    target_critic_params = fabric.replicate(target_critic_params)
+    # model-axis meshes shard the large kernels over `model` (fabric
+    # param_spec rule); pure-DP meshes replicate — same call either way
+    wm_params = fabric.shard_params(wm_params)
+    actor_params = fabric.shard_params(actor_params)
+    critic_params = fabric.shard_params(critic_params)
+    target_critic_params = fabric.shard_params(target_critic_params)
 
     from sheeprl_tpu.parallel.fabric import resolve_player_device
 

@@ -115,9 +115,16 @@ def make_train_fn(
     moments_cfg = algo.actor.moments
     data_axis = fabric.data_axis
     multi_device = fabric.world_size > 1
+    # Two multi-device modes: pure DP uses shard_map + explicit collectives;
+    # a mesh with a `model` axis instead jits the GLOBAL computation and lets
+    # GSPMD partition it from the committed input shardings (params placed by
+    # fabric.shard_params, batch on the data axis) — explicit pmean/all_gather
+    # would be wrong there because the jitted program already has global
+    # semantics.
+    use_shard_map = multi_device and fabric.model_axis is None
 
     def pmean(x):
-        return lax.pmean(x, data_axis) if multi_device else x
+        return lax.pmean(x, data_axis) if use_shard_map else x
 
     def local_train(
         wm_params,
@@ -131,7 +138,7 @@ def make_train_fn(
         data,
         key,
     ):
-        if multi_device:
+        if use_shard_map:
             key = jax.random.fold_in(key, lax.axis_index(data_axis))
         k_scan, k_img = jax.random.split(key)
         sg = lax.stop_gradient
@@ -229,7 +236,7 @@ def make_train_fn(
                 max_=float(moments_cfg.max),
                 percentile_low=float(moments_cfg.percentile.low),
                 percentile_high=float(moments_cfg.percentile.high),
-                axis_name=data_axis if multi_device else None,
+                axis_name=data_axis if use_shard_map else None,
             )
             baseline = values[:-1]
             normed_lambda = (lambda_values - offset) / invscale
@@ -300,7 +307,7 @@ def make_train_fn(
             metrics,
         )
 
-    if multi_device:
+    if use_shard_map:
         train_fn = shard_map(
             local_train,
             mesh=fabric.mesh,
@@ -308,6 +315,8 @@ def make_train_fn(
             out_specs=(P(), P(), P(), P(), P(), P(), P(), P()),
         )
     else:
+        # single device, or a model-axis mesh: GSPMD partitions the global
+        # program from the inputs' committed shardings
         train_fn = local_train
     # donate only optimizer/aux state: param buffers stay un-donated because
     # concurrent readers (async param streaming to the host player, the ema /
@@ -335,7 +344,9 @@ def main(fabric, cfg: Dict[str, Any]):
 
     rank = fabric.process_index
     num_envs = int(cfg.env.num_envs)
-    world_size = fabric.world_size  # devices: sets the global batch split
+    # batch split width = the DATA axis only (on a [data, model] mesh the
+    # model peers co-own each batch shard rather than adding to it)
+    world_size = fabric.data_parallel_size
     num_processes = fabric.num_processes  # hosts: sets the env-step accounting
 
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
@@ -396,14 +407,16 @@ def main(fabric, cfg: Dict[str, Any]):
     world_tx = build_tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
     actor_tx = build_tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
     critic_tx = build_tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
-    world_opt = fabric.replicate(world_tx.init(jax.device_get(wm_params)))
-    actor_opt = fabric.replicate(actor_tx.init(jax.device_get(actor_params)))
-    critic_opt = fabric.replicate(critic_tx.init(jax.device_get(critic_params)))
+    # shard_params co-shards Adam moments with their params on a model-axis
+    # mesh and replicates on a pure-DP one
+    world_opt = fabric.shard_params(world_tx.init(jax.device_get(wm_params)))
+    actor_opt = fabric.shard_params(actor_tx.init(jax.device_get(actor_params)))
+    critic_opt = fabric.shard_params(critic_tx.init(jax.device_get(critic_params)))
     moments_state: MomentsState = init_moments()
     if cfg.checkpoint.resume_from:
-        world_opt = fabric.replicate(jax.tree.map(jnp.asarray, state["world_optimizer"]))
-        actor_opt = fabric.replicate(jax.tree.map(jnp.asarray, state["actor_optimizer"]))
-        critic_opt = fabric.replicate(jax.tree.map(jnp.asarray, state["critic_optimizer"]))
+        world_opt = fabric.shard_params(jax.tree.map(jnp.asarray, state["world_optimizer"]))
+        actor_opt = fabric.shard_params(jax.tree.map(jnp.asarray, state["actor_optimizer"]))
+        critic_opt = fabric.shard_params(jax.tree.map(jnp.asarray, state["critic_optimizer"]))
         moments_state = MomentsState(
             low=jnp.asarray(state["moments"]["low"]), high=jnp.asarray(state["moments"]["high"])
         )
@@ -462,7 +475,9 @@ def main(fabric, cfg: Dict[str, Any]):
     per_rank_batch_size = int(cfg.algo.per_rank_batch_size)
     sequence_length = int(cfg.algo.per_rank_sequence_length)
     if cfg.checkpoint.resume_from:
-        per_rank_batch_size = state["batch_size"] // world_size
+        from sheeprl_tpu.utils.checkpoint import elastic_per_rank_batch_size
+
+        per_rank_batch_size = elastic_per_rank_batch_size(state["batch_size"], world_size)
         if not cfg.buffer.checkpoint:
             learning_starts += start_step
 
@@ -620,7 +635,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 # batch i+1's host->HBM transfer overlaps gradient step i
                 batches = sampled_batches(
                     rb,
-                    per_rank_batch_size * fabric.local_device_count,
+                    per_rank_batch_size * fabric.local_data_parallel_size,
                     sequence_length,
                     per_rank_gradient_steps,
                     cnn_keys,

@@ -208,7 +208,9 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     per_rank_batch_size = int(cfg.algo.per_rank_batch_size)
     sequence_length = int(cfg.algo.per_rank_sequence_length)
     if resume_from_checkpoint:
-        per_rank_batch_size = state["batch_size"] // world_size
+        from sheeprl_tpu.utils.checkpoint import elastic_per_rank_batch_size
+
+        per_rank_batch_size = elastic_per_rank_batch_size(state["batch_size"], world_size)
         if not cfg.buffer.checkpoint:
             learning_starts += start_step
 

@@ -377,7 +377,9 @@ def main(fabric, cfg: Dict[str, Any]):
     learning_starts = cfg.algo.learning_starts // policy_steps_per_update if not cfg.dry_run else 0
     per_rank_batch_size = int(cfg.algo.per_rank_batch_size)
     if cfg.checkpoint.resume_from:
-        per_rank_batch_size = state["batch_size"] // world_size
+        from sheeprl_tpu.utils.checkpoint import elastic_per_rank_batch_size
+
+        per_rank_batch_size = elastic_per_rank_batch_size(state["batch_size"], world_size)
         if not cfg.buffer.checkpoint:
             learning_starts += start_step
 

@@ -22,9 +22,12 @@ from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry
 from sheeprl_tpu.utils.utils import dotdict, print_config
 
 
-def resume_from_checkpoint(cfg: dotdict) -> dotdict:
+def resume_from_checkpoint(cfg: dotdict, cli_overrides: Optional[List[str]] = None) -> dotdict:
     """Merge the run config stored beside the checkpoint, keeping the current
-    run's checkpoint/resume settings (reference cli.py:23-48)."""
+    run's checkpoint/resume settings (reference cli.py:23-48).
+    ``cli_overrides`` is the raw override list of the resuming invocation —
+    explicitly-passed ``fabric.*`` keys win over the stored fabric section
+    (elastic restore)."""
     import yaml
 
     ckpt_path = cfg.checkpoint.resume_from
@@ -45,15 +48,21 @@ def resume_from_checkpoint(cfg: dotdict) -> dotdict:
         )
     merged = dotdict(old_cfg.to_dict())
     merged.checkpoint = dotdict(cfg.checkpoint.to_dict())
-    # TOPOLOGY comes from the resuming invocation (elastic restore: the
-    # checkpoint stores global-batch counters and host-layout arrays, so an
-    # 8-device checkpoint reshards onto whatever mesh this run was launched
-    # with — the reference refuses world-size changes instead); everything
-    # else in fabric (precision, mesh_axes, accelerator) keeps the STORED
-    # values so a resume can't silently change the run's numerics.
-    for key in ("devices", "num_nodes", "mesh_shape"):
-        if key in (cfg.fabric or {}):
-            merged.fabric[key] = cfg.fabric[key]
+    # The fabric section keeps the STORED values (precision, mesh axes —
+    # so a resume can't silently change the run's numerics or topology) —
+    # EXCEPT the keys the user explicitly overrode on the resume command
+    # line, which enable elastic restore: the checkpoint stores global-batch
+    # counters and host-layout arrays, so an 8-device checkpoint reshards
+    # onto an explicitly requested smaller/larger mesh (the reference
+    # refuses world-size changes instead). Composed defaults do NOT count as
+    # overrides — every config carries all fabric keys, so copying them
+    # wholesale would clobber a model-axis run's stored mesh on a plain
+    # resume.
+    for ov in cli_overrides or []:
+        key = ov.split("=", 1)[0]
+        if key.startswith("fabric."):
+            sub = key[len("fabric."):].split(".", 1)[0]
+            merged.fabric[sub] = cfg.fabric[sub]
     merged.root_dir = cfg.root_dir
     merged.run_name = cfg.run_name
     return merged
@@ -161,7 +170,7 @@ def run(args: Optional[List[str]] = None) -> None:
     cfg = compose("config", overrides)
     cfg = dotdict(cfg)
     if cfg.checkpoint.resume_from:
-        cfg = resume_from_checkpoint(cfg)
+        cfg = resume_from_checkpoint(cfg, cli_overrides=overrides)
     if cfg.metric.log_level > 0:
         print_config(cfg)
     check_configs(cfg)

@@ -175,3 +175,17 @@ def select_buffer(rb_state: Union[Any, List[Any]], process_index: int, num_proce
             f"checkpoint holds {len(rb_state)} replay buffers but {num_processes} processes are running"
         )
     return rb_state
+
+
+def elastic_per_rank_batch_size(global_batch: int, world_size: int) -> int:
+    """Re-split a checkpoint's stored GLOBAL batch over the resuming run's
+    data-parallel width. Fails fast when it doesn't divide (or divides to
+    zero): an elastic resume changed the mesh, and silently flooring would
+    shrink the global batch and compound on every subsequent resume."""
+    if world_size <= 0 or global_batch % world_size != 0 or global_batch // world_size == 0:
+        raise ValueError(
+            f"cannot resume: the checkpoint's global batch size ({global_batch}) does not split "
+            f"evenly over {world_size} data-parallel devices — resume on a mesh whose data axis "
+            f"divides {global_batch}, or start a fresh run"
+        )
+    return global_batch // world_size
