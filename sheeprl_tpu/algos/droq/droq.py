@@ -163,7 +163,7 @@ def make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg):
 @register_algorithm()
 def main(fabric, cfg: Dict[str, Any]):
     rank = fabric.process_index
-    world_size = fabric.world_size
+    world_size = fabric.data_parallel_size  # batch-split width: the data axis (= device count on a 1-D mesh)
     num_processes = fabric.num_processes
     num_envs = int(cfg.env.num_envs)
 

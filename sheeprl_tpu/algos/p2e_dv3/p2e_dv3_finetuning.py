@@ -80,7 +80,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
 
     rank = fabric.process_index
     num_envs = int(cfg.env.num_envs)
-    world_size = fabric.world_size
+    world_size = fabric.data_parallel_size  # batch-split width: the data axis (= device count on a 1-D mesh)
     num_processes = fabric.num_processes
 
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv

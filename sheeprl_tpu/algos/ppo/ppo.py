@@ -40,7 +40,7 @@ from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.envs import make_env
 from sheeprl_tpu.ops.math import gae
-from sheeprl_tpu.parallel.fabric import put_tree, resolve_player_device
+from sheeprl_tpu.parallel.fabric import put_tree, resolve_player_device, resolve_train_device
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -48,9 +48,13 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 
 
-def make_train_fn(fabric, agent, tx, cfg, obs_keys, n_local: int):
+def make_train_fn(fabric, agent, tx, cfg, obs_keys, n_local: int, host_device=None):
     """Build the fused update: epochs x shuffled minibatches, grad-pmean'd
-    over the data axis, one jit (replaces reference train(), ppo.py:30-102)."""
+    over the data axis, one jit (replaces reference train(), ppo.py:30-102).
+
+    ``host_device``: single-device escape hatch (``resolve_train_device``) —
+    the same program without mesh collectives, jitted for the host CPU so a
+    tiny model's update never touches a remote-attached accelerator."""
     batch_size = int(cfg.algo.per_rank_batch_size)
     update_epochs = int(cfg.algo.update_epochs)
     num_minibatches = n_local // batch_size
@@ -70,10 +74,15 @@ def make_train_fn(fabric, agent, tx, cfg, obs_keys, n_local: int):
     normalize_adv = bool(cfg.algo.normalize_advantages)
     reduction = str(cfg.algo.loss_reduction)
     data_axis = fabric.data_axis
+    use_mesh = host_device is None
+
+    def pmean(x):
+        return lax.pmean(x, data_axis) if use_mesh else x
 
     def local_train(params, opt_state, data, key, clip_coef, ent_coef):
-        # distinct permutation stream per device (reference: per-rank sampler)
-        key = jax.random.fold_in(key, lax.axis_index(data_axis))
+        if use_mesh:
+            # distinct permutation stream per device (reference: per-rank sampler)
+            key = jax.random.fold_in(key, lax.axis_index(data_axis))
 
         def minibatch_step(carry, batch):
             params, opt_state = carry
@@ -90,7 +99,7 @@ def make_train_fn(fabric, agent, tx, cfg, obs_keys, n_local: int):
                 return pg + vf_coef * v + ent_coef * ent, (pg, v, ent)
 
             (_, (pg, v, ent)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            grads = lax.pmean(grads, data_axis)  # the DDP all-reduce, over ICI
+            grads = pmean(grads)  # the DDP all-reduce, over ICI
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return (params, opt_state), jnp.stack([pg, v, ent])
@@ -109,8 +118,12 @@ def make_train_fn(fabric, agent, tx, cfg, obs_keys, n_local: int):
             epoch_step, (params, opt_state, key), None, length=update_epochs
         )
         # [epochs, minibatches, 3] -> [3], identical on every device after pmean
-        return params, opt_state, lax.pmean(metrics.mean(axis=(0, 1)), data_axis)
+        return params, opt_state, pmean(metrics.mean(axis=(0, 1)))
 
+    if not use_mesh:
+        # inputs are committed to the host device by the caller, so the jit
+        # executes entirely on the host CPU backend
+        return jax.jit(local_train, donate_argnums=(0, 1))
     train_fn = shard_map(
         local_train,
         mesh=fabric.mesh,
@@ -186,7 +199,11 @@ def main(fabric, cfg: Dict[str, Any]):
 
     num_envs = int(cfg.env.num_envs)
     rollout_steps = int(cfg.algo.rollout_steps)
-    world_size = fabric.world_size
+    # batch split width = the DATA axis only: shard_map's P(data_axis)
+    # in_spec delivers n_global/data_width rows per device, so on a 2-D
+    # (data, model) mesh dividing by world_size would silently train on a
+    # fraction of each shard
+    world_size = fabric.data_parallel_size
     policy_steps_per_update = num_envs * rollout_steps * fabric.num_processes
     num_updates = int(cfg.algo.total_steps) // policy_steps_per_update if not cfg.dry_run else 1
 
@@ -209,11 +226,23 @@ def main(fabric, cfg: Dict[str, Any]):
             float(opt_cfg.get("lr", 1e-3)), 0.0, num_updates * steps_per_update
         )
     tx = instantiate(opt_cfg)
-    opt_state = fabric.replicate(tx.init(jax.device_get(params)))
-    if cfg.checkpoint.resume_from:
-        opt_state = fabric.replicate(
-            jax.tree.map(jnp.asarray, state["opt_state"], is_leaf=lambda x: isinstance(x, np.ndarray))
-        )
+    # remote-chip escape hatch: tiny models train on the host core, so the
+    # env loop, player AND update never touch the link (resolve_train_device)
+    train_device = resolve_train_device(
+        cfg.algo.get("train_device", "auto"), params, fabric.world_size
+    )
+    if train_device is not None:
+        params = put_tree(jax.device_get(params), train_device)
+        player.update_params(params)
+    # resume state stays host numpy until the ONE placement below — routing
+    # it through jnp.asarray would upload the whole optimizer state to the
+    # remote default backend only to fetch it straight back for host training
+    opt_state = (
+        state["opt_state"] if cfg.checkpoint.resume_from else tx.init(jax.device_get(params))
+    )
+    opt_state = (
+        put_tree(opt_state, train_device) if train_device is not None else fabric.replicate(opt_state)
+    )
 
     if fabric.is_global_zero:
         save_configs(cfg, log_dir)
@@ -231,7 +260,7 @@ def main(fabric, cfg: Dict[str, Any]):
     # reference there is no staging ReplayBuffer copy — host lists are the
     # only transient storage
 
-    train_fn = make_train_fn(fabric, agent, tx, cfg, obs_keys, n_local)
+    train_fn = make_train_fn(fabric, agent, tx, cfg, obs_keys, n_local, host_device=train_device)
     gae_fn = jax.jit(partial(gae, gamma=float(cfg.algo.gamma), gae_lambda=float(cfg.algo.gae_lambda)))
 
     # counters (reference ppo.py:214-231)
@@ -244,7 +273,15 @@ def main(fabric, cfg: Dict[str, Any]):
 
     key = jax.random.PRNGKey(int(cfg.seed))
     if cfg.checkpoint.resume_from and "rng_key" in state:
-        key = jnp.asarray(state["rng_key"])
+        # host numpy from the checkpoint; placed exactly once below
+        key = np.asarray(state["rng_key"])
+    if train_device is not None:
+        # the train key chain lives on the train device: a mixed-device
+        # committed-input set would error, and splitting on the remote chip
+        # would re-insert a per-update round trip
+        key = put_tree(key, train_device)
+    elif cfg.checkpoint.resume_from and "rng_key" in state:
+        key = jnp.asarray(key)
     # rollout action keys live on the player's device so a host-pinned
     # player never blocks on a chip round trip per env step
     player_key = put_tree(jax.random.fold_in(key, 1), player.device)
@@ -352,8 +389,12 @@ def main(fabric, cfg: Dict[str, Any]):
                 opt_state,
                 flat,
                 train_key,
-                jnp.float32(clip_coef),
-                jnp.float32(ent_coef),
+                # host numpy scalars: jnp.float32 would materialize them on
+                # the DEFAULT backend every update — with a host-pinned train
+                # device on a remote chip that is a blocking link fetch per
+                # update, more than the round trips host-training saves
+                np.float32(clip_coef),
+                np.float32(ent_coef),
             )
             metrics = jax.block_until_ready(metrics)
         player.update_params(params)

@@ -268,7 +268,7 @@ def main(fabric, cfg: Dict[str, Any]):
 
     rollout_steps = int(cfg.algo.rollout_steps)
     seq_len = int(cfg.algo.per_rank_sequence_length)
-    world_size = fabric.world_size
+    world_size = fabric.data_parallel_size  # batch-split width: the data axis (= device count on a 1-D mesh)
     policy_steps_per_update = num_envs * rollout_steps * fabric.num_processes
     num_updates = int(cfg.algo.total_steps) // policy_steps_per_update if not cfg.dry_run else 1
     pad_multiple = world_size * max(1, int(cfg.algo.per_rank_num_batches))

@@ -377,6 +377,44 @@ def dispatch_roundtrip_seconds() -> float:
     return _rtt_cache["rtt"]
 
 
+#: params budget under which 'auto' host-trains: a 2x64 control MLP's whole
+#: fused update is ~100 ms of 1-core CPU per 8k-step rollout, far cheaper
+#: than the per-update upload + blocking fetches a remote-attached chip
+#: charges; a pixel CNN (>~1M params) stays on the accelerator
+_HOST_TRAIN_PARAM_BUDGET = 300_000
+
+
+def resolve_train_device(spec: str, params: Any, world_size: int) -> Optional[jax.Device]:
+    """Resolve a train-placement spec to a device (None = default backend).
+
+    The PPO-family interaction benchmark is dominated by the env loop on the
+    host; when the accelerator is REMOTE-attached, shipping each update's
+    tiny minibatch program across the link (upload + dispatch + metric and
+    param fetches) costs more wall-clock than running the whole fused update
+    on the host core. ``auto`` host-trains exactly in that regime: single
+    device, remote backend (same RTT probe as the player), and a model under
+    ``_HOST_TRAIN_PARAM_BUDGET`` params. Multi-device runs always train on
+    the mesh.
+    """
+    if spec not in (None, "accelerator", "device", "cpu", "auto"):
+        raise ValueError(f"unknown train_device spec {spec!r} (accelerator | cpu | auto)")
+    if spec in (None, "accelerator", "device"):
+        return None
+    if world_size > 1:
+        if spec == "cpu":
+            raise ValueError("algo.train_device=cpu requires a single-device run")
+        return None
+    if spec == "cpu":
+        return jax.local_devices(backend="cpu")[0]
+    # auto
+    if jax.local_devices()[0].platform == "cpu":
+        return None  # default backend is already the host
+    n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    if n_params <= _HOST_TRAIN_PARAM_BUDGET and dispatch_roundtrip_seconds() > _RTT_PROBE_THRESHOLD_S:
+        return jax.local_devices(backend="cpu")[0]
+    return None
+
+
 def resolve_player_device(spec: str = "auto") -> Optional[jax.Device]:
     """Resolve a player-placement spec to a device (None = default backend).
 

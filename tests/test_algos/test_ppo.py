@@ -48,6 +48,17 @@ def test_ppo_cartpole_vector(tmp_path, monkeypatch):
     assert find_checkpoints(tmp_path)
 
 
+def test_ppo_host_pinned_training(tmp_path, monkeypatch):
+    """algo.train_device=cpu: the whole fused update runs on the host
+    backend (the remote-chip escape hatch, resolve_train_device) — full
+    run + resume through the host-jitted no-mesh train path."""
+    monkeypatch.chdir(tmp_path)
+    args = standard_args(tmp_path) + ["fabric.devices=1", "algo.train_device=cpu"]
+    run(args)
+    (ckpt,) = find_checkpoints(tmp_path)
+    run(args + [f"checkpoint.resume_from={ckpt}", "fabric.devices=1"])
+
+
 def test_ppo_dummy_discrete_pixels(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     run(

@@ -16,6 +16,7 @@ from sheeprl_tpu.parallel.fabric import (
     dispatch_roundtrip_seconds,
     put_tree,
     resolve_player_device,
+    resolve_train_device,
 )
 
 
@@ -51,6 +52,23 @@ def test_resolve_auto_on_cpu_backend_is_none():
     # conv policies too: auto depends only on the measured link latency
     # (a host pixel forward is ~ms, far under a remote chip's round trip)
     assert resolve_player_device("auto") is None
+
+
+def test_resolve_train_device_rules():
+    tiny = {"w": np.zeros((8, 8), np.float32)}
+    # default-backend spellings are always None
+    assert resolve_train_device("accelerator", tiny, 1) is None
+    assert resolve_train_device(None, tiny, 1) is None
+    # auto on a cpu default backend: already the host, nothing to pin
+    assert resolve_train_device("auto", tiny, 1) is None
+    # explicit cpu pin commits to the host backend device
+    dev = resolve_train_device("cpu", tiny, 1)
+    assert dev is not None and dev.platform == "cpu"
+    # multi-device: mesh training only — explicit cpu is a config error,
+    # auto silently stays on the mesh
+    with pytest.raises(ValueError, match="single-device"):
+        resolve_train_device("cpu", tiny, 2)
+    assert resolve_train_device("auto", tiny, 8) is None
 
 
 def test_param_streamer_single_byte_dtypes_roundtrip():
