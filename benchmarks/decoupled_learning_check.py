@@ -47,6 +47,7 @@ def main() -> None:
     args = p.parse_args()
 
     logdir = args.log_base_dir or tempfile.mkdtemp(prefix="sheeprl_tpu_declearn_")
+    os.makedirs(logdir, exist_ok=True)
     cli = [
         "exp=sac_decoupled",
         "env=gym",
@@ -89,22 +90,39 @@ def main() -> None:
                 text=True,
             )
         )
+    import time as _time
+
+    deadline = _time.monotonic() + args.timeout
+    timed_out = False
     try:
         for p_ in procs:
-            p_.wait(timeout=args.timeout)
+            try:
+                # one shared deadline across the group: sequential full-budget
+                # waits would let a hung pair take 2x the stated --timeout
+                p_.wait(timeout=max(1.0, deadline - _time.monotonic()))
+            except subprocess.TimeoutExpired:
+                timed_out = True
+                break
     finally:
         for p_ in procs:
             if p_.poll() is None:
                 p_.kill()
                 p_.wait()
+    failures = []
+    rewards: list = []
     for pid, (p_, out) in enumerate(zip(procs, outs)):
         out.seek(0)
         text = out.read()
         if p_.returncode != 0:
-            sys.stderr.write(text[-3000:])
-            raise SystemExit(f"process {pid} failed rc={p_.returncode}")
+            failures.append(f"--- process {pid} rc={p_.returncode} tail ---\n{text[-3000:]}")
         if pid == 0:
             rewards = [float(m) for m in REWARD_RE.findall(text)]
+    if failures or timed_out:
+        sys.stderr.write("\n".join(failures) + "\n")
+        raise SystemExit(
+            f"decoupled learning run {'timed out' if timed_out else 'failed'} "
+            f"({len(failures)} process(es) non-zero) — tails above"
+        )
     for out in outs:
         out.close()
     if len(rewards) < 10:

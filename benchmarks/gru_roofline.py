@@ -86,20 +86,26 @@ def probe_size(size: str, batches, T: int, chain: int, repeat: int, hbm_bw: floa
 
     H, D = DIMS[size]
     rtt = tiny_op_rtt_seconds()
-    W = jnp.asarray(np.random.default_rng(0).normal(size=(H, 3 * H)) * 0.01, jnp.bfloat16)
+    # the REAL joint projection shape: [h, feat] @ W2 with W2 [H+D, 3H]
+    # (ops/pallas_gru.py reference_step) — XL: (4096+1024)x12288 bf16 = 126 MB
+    W = jnp.asarray(np.random.default_rng(0).normal(size=(H + D, 3 * H)) * 0.01, jnp.bfloat16)
     w_bytes = W.size * 2
 
     records = []
     for B in batches:
         h0 = jnp.zeros((B, H), jnp.bfloat16)
+        feat = jnp.zeros((B, D), jnp.bfloat16)
 
         @jax.jit
-        def scan_matmul(h, W=W):
-            # GRU-shaped recurrence: the full [H, 3H] matrix is genuinely
-            # consumed every step (reset/cand/update gates), so XLA cannot
-            # hoist or slice it — exactly the fused step's streaming pattern
+        def scan_matmul(h, feat=feat, W=W):
+            # GRU-shaped recurrence: the full [H+D, 3H] matrix is genuinely
+            # consumed every step (reset/cand/update gates on the joint
+            # [h, feat] row), so XLA cannot hoist or slice it — exactly the
+            # fused step's streaming pattern
             def step(h, _):
-                p = jnp.dot(h, W, preferred_element_type=jnp.float32)
+                p = jnp.dot(
+                    jnp.concatenate([h, feat], axis=-1), W, preferred_element_type=jnp.float32
+                )
                 H_ = h.shape[1]
                 u = jax.nn.sigmoid(p[:, 2 * H_ :] - 1.0)
                 c = jnp.tanh(jax.nn.sigmoid(p[:, :H_]) * p[:, H_ : 2 * H_])
@@ -109,7 +115,7 @@ def probe_size(size: str, batches, T: int, chain: int, repeat: int, hbm_bw: floa
             return out
 
         measured = chained_seconds(scan_matmul, (h0,), chain, repeat, rtt)
-        flops = 2 * B * H * 3 * H * T
+        flops = 2 * B * (H + D) * 3 * H * T
         bytes_term = w_bytes * T / hbm_bw
         compute_term = flops / peak
         pred = max(bytes_term, compute_term)

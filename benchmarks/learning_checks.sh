@@ -8,20 +8,20 @@ set -e
 LOGS=${LOGS:-/tmp/sheeprl_tpu_learning}
 
 # Recurrent PPO, CartPole (CPU, ~20 min): 13.6 -> 115.8 late avg, peak 398
-JAX_PLATFORMS=cpu python -m sheeprl_tpu exp=ppo_recurrent env=gym env.id=CartPole-v1 \
+JAX_PLATFORMS=cpu python -m sheeprl_tpu fabric=cpu exp=ppo_recurrent env=gym env.id=CartPole-v1 \
     env.num_envs=4 env.capture_video=False buffer.memmap=False \
     algo.total_steps=40960 algo.run_test=False checkpoint.save_last=False \
     metric.log_level=1 metric.log_every=2000 log_base_dir=$LOGS/rppo
 
 # DroQ, Pendulum (CPU, ~15 min): -630 -> -139 mid avg, best episode -1.2
-JAX_PLATFORMS=cpu python -m sheeprl_tpu exp=droq env=gym env.id=Pendulum-v1 \
+JAX_PLATFORMS=cpu python -m sheeprl_tpu fabric=cpu exp=droq env=gym env.id=Pendulum-v1 \
     env.num_envs=4 env.capture_video=False buffer.memmap=False \
     algo.total_steps=12000 algo.learning_starts=400 algo.run_test=False \
     checkpoint.save_last=False metric.log_level=1 metric.log_every=50000 \
     log_base_dir=$LOGS/droq
 
 # Plain SAC, Pendulum (CPU, ~15 min) — round-5 row, see BASELINE.md
-JAX_PLATFORMS=cpu python -m sheeprl_tpu exp=sac env=gym env.id=Pendulum-v1 \
+JAX_PLATFORMS=cpu python -m sheeprl_tpu fabric=cpu exp=sac env=gym env.id=Pendulum-v1 \
     env.num_envs=4 env.capture_video=False buffer.memmap=False \
     algo.total_steps=12000 algo.learning_starts=400 algo.run_test=False \
     checkpoint.save_last=False metric.log_level=1 metric.log_every=50000 \

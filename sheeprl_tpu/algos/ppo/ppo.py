@@ -237,9 +237,10 @@ def main(fabric, cfg: Dict[str, Any]):
     # resume state stays host numpy until the ONE placement below — routing
     # it through jnp.asarray would upload the whole optimizer state to the
     # remote default backend only to fetch it straight back for host training
-    opt_state = (
-        state["opt_state"] if cfg.checkpoint.resume_from else tx.init(jax.device_get(params))
-    )
+    # fresh init runs on the params' own device (host-committed when
+    # train_device is set), so the moment tensors never touch the remote
+    # backend just to be fetched back
+    opt_state = state["opt_state"] if cfg.checkpoint.resume_from else tx.init(params)
     opt_state = (
         put_tree(opt_state, train_device) if train_device is not None else fabric.replicate(opt_state)
     )
