@@ -6,8 +6,8 @@ itself refuses world-size changes, callback.py:87-142).
 
 Device elasticity is the TPU-native win: params checkpoint as host arrays
 (sharding-free), so an 8-chip run's state reshards onto any divisor mesh at
-resume. These tests drive DV3 end to end on the virtual CPU mesh: save on 8
-devices, resume on 4, then grow 4 -> 8.
+resume. These tests drive DV3 end to end on the virtual CPU mesh: shrink
+8 -> 4, grow 4 -> 8, and cross mesh KINDS (param-sharded -> pure DP).
 """
 
 import os
@@ -34,29 +34,56 @@ def _elastic_args(tmp_path):
     ]
 
 
-def test_dv3_save_on_8_resume_on_4(tmp_path, monkeypatch):
-    monkeypatch.chdir(tmp_path)
-    run(_elastic_args(tmp_path) + ["fabric.devices=8"])
+def _save_then_resume(tmp_path, save_overrides, resume_overrides):
+    """Save a mid-run checkpoint with one topology, resume with another;
+    assert the resumed run genuinely trained (updates progressed, a newer
+    checkpoint landed) and return ``(saved, resumed)`` states."""
+    run(_elastic_args(tmp_path) + save_overrides)
     ckpt = min(find_checkpoints(tmp_path), key=os.path.getmtime)  # the mid-run one
     saved = load_checkpoint(ckpt)
-    assert saved["batch_size"] == 8  # global batch recorded, not per-device
-
     latest_before = max(os.path.getmtime(p) for p in find_checkpoints(tmp_path))
-    run(_elastic_args(tmp_path) + ["fabric.devices=4", f"checkpoint.resume_from={ckpt}"])
+    run(_elastic_args(tmp_path) + resume_overrides + [f"checkpoint.resume_from={ckpt}"])
     newest = max(find_checkpoints(tmp_path), key=os.path.getmtime)
     assert os.path.getmtime(newest) > latest_before, "resumed run wrote no checkpoint"
     resumed = load_checkpoint(newest)
-    # the global batch is preserved across the mesh change
+    assert resumed["update"] > saved["update"], "resume restored state but trained no updates"
+    return saved, resumed
+
+
+def test_dv3_save_on_8_resume_on_4(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    saved, resumed = _save_then_resume(tmp_path, ["fabric.devices=8"], ["fabric.devices=4"])
+    # global batch recorded (not per-device) and preserved across the change
+    assert saved["batch_size"] == 8
     assert resumed["batch_size"] == 8
-    assert resumed["update"] > saved["update"]
 
 
 def test_dv3_save_on_4_resume_on_8(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
-    run(_elastic_args(tmp_path) + ["fabric.devices=4", "algo.per_rank_batch_size=2"])
-    ckpt = min(find_checkpoints(tmp_path), key=os.path.getmtime)
-    latest_before = max(os.path.getmtime(p) for p in find_checkpoints(tmp_path))
-    run(_elastic_args(tmp_path) + ["fabric.devices=8", f"checkpoint.resume_from={ckpt}"])
-    newest = max(find_checkpoints(tmp_path), key=os.path.getmtime)
-    assert os.path.getmtime(newest) > latest_before, "resumed run wrote no checkpoint"
-    assert load_checkpoint(newest)["batch_size"] == 8
+    saved, resumed = _save_then_resume(
+        tmp_path, ["fabric.devices=4", "algo.per_rank_batch_size=2"], ["fabric.devices=8"]
+    )
+    assert saved["batch_size"] == 8
+    assert resumed["batch_size"] == 8
+
+
+def test_dv3_model_axis_checkpoint_resumes_on_dp_mesh(tmp_path, monkeypatch):
+    """Topology change ACROSS mesh kinds: a checkpoint trained with param
+    sharding on a (data=2, model=4) mesh resumes on a plain 8-wide DP mesh —
+    possible because checkpoints store host-layout arrays, and because
+    explicitly-passed fabric.* overrides (including mesh_axes) win over the
+    stored fabric section at resume (cli.resume_from_checkpoint)."""
+    monkeypatch.chdir(tmp_path)
+    saved, resumed = _save_then_resume(
+        tmp_path,
+        [
+            "fabric.mesh_axes=[data,model]",
+            "fabric.mesh_shape=[2,4]",
+            "algo.per_rank_batch_size=4",  # data width 2 -> global batch 8
+            "algo.dense_units=16",
+            "algo.world_model.recurrent_model.recurrent_state_size=16",
+        ],
+        ["fabric.mesh_axes=[data]", "fabric.mesh_shape=null", "fabric.devices=8"],
+    )
+    assert saved["batch_size"] == 8
+    assert resumed["batch_size"] == 8
