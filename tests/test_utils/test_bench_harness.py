@@ -17,11 +17,13 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__fi
 BENCH = os.path.join(REPO_ROOT, "bench.py")
 
 
-def _run_bench(extra_env, timeout=120):
+def _run_bench(extra_env, timeout=120, argv=None):
+    """Run bench (directly, or via a wrapper ``argv``) and return the last
+    JSON line; failures carry the captured output."""
     env = dict(os.environ)
     env.update(extra_env)
     proc = subprocess.run(
-        [sys.executable, BENCH],
+        argv or [sys.executable, BENCH],
         env=env,
         cwd=REPO_ROOT,
         capture_output=True,
@@ -100,6 +102,41 @@ def test_assemble_partial_marks_stale_sections():
     assert rec["stale"] == ["secondary"]
     assert rec["outage"] is True
     assert rec["cached_from"] == "test-seed"
+
+
+_NOJAX_BENCH_PARENT = r"""
+import sys
+
+class _NoJax:
+    # the round-4 record died because harness code touched the jax backend
+    # with the tunnel down; the bench PARENT must never import jax at all
+    def find_spec(self, name, path=None, target=None):
+        if name == "jax" or name.startswith("jax."):
+            raise ImportError("bench parent must not import jax")
+        return None
+
+sys.meta_path.insert(0, _NoJax())
+import importlib.util
+
+spec = importlib.util.spec_from_file_location("bench", sys.argv[1])
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+mod.main()
+"""
+
+
+def test_bench_parent_never_imports_jax():
+    """Outage path driven with jax imports POISONED in the parent process:
+    the emitted record must still appear (probe subprocesses are exempt —
+    they are separate interpreters)."""
+    rec = _run_bench(
+        {
+            "SHEEPRL_TPU_BENCH_PROBE_CMD": "false",
+            "SHEEPRL_TPU_BENCH_MAX_WAIT_SECONDS": "1",
+        },
+        argv=[sys.executable, "-c", _NOJAX_BENCH_PARENT, BENCH],
+    )
+    assert rec["outage"] is True and rec["value"] is not None
 
 
 def test_cache_checkpoint_roundtrip(tmp_path, monkeypatch):

@@ -1,0 +1,62 @@
+"""SteadyStateProbe warm-point semantics (utils.SteadyStateProbe — the one
+measurement convention every off-policy loop shares; consumed by bench.py).
+"""
+
+import json
+
+import pytest
+
+from sheeprl_tpu.utils.utils import SteadyStateProbe
+
+
+@pytest.fixture()
+def probe(tmp_path, monkeypatch):
+    path = str(tmp_path / "probe.json")
+    monkeypatch.setenv("SHEEPRL_TPU_BENCH_JSON", path)
+    return SteadyStateProbe(), path
+
+
+def test_fresh_run_opens_at_shared_warm_point(probe):
+    p, _ = probe
+    W = SteadyStateProbe.WARMUP_UPDATES
+    for update in range(0, 10 + W + 1):
+        p.mark_warm(update, 10, step=update * 4)
+        if update < 10 + W:
+            assert p._t0 is None, update
+    assert p._t0 is not None
+    assert p._step0 == (10 + W) * 4
+
+
+def test_resumed_run_waits_its_own_warmup(probe):
+    """A run resuming at update 5000 (long past learning_starts + warmup)
+    still compiles its gradient path on its FIRST update — the window must
+    wait WARMUP_UPDATES from the first observed update, not open
+    immediately (which would put minutes of compile inside the window)."""
+    p, _ = probe
+    W = SteadyStateProbe.WARMUP_UPDATES
+    p.mark_warm(5000, 0, step=0)
+    assert p._t0 is None
+    p.mark_warm(5000 + W - 1, 0, step=0)
+    assert p._t0 is None
+    p.mark_warm(5000 + W, 0, step=123)
+    assert p._t0 is not None and p._step0 == 123
+
+
+def test_finish_writes_record(probe):
+    p, path = probe
+    p.mark(100, work=7)
+    p.finish(500, sync=lambda: None, work=27, extra={"note": "x"})
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["steps"] == 400
+    assert rec["train_steps"] == 20
+    assert rec["note"] == "x"
+    assert rec["seconds"] > 0
+
+
+def test_inactive_without_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("SHEEPRL_TPU_BENCH_JSON", raising=False)
+    p = SteadyStateProbe()
+    assert not p.active
+    p.mark(0)
+    p.finish(10)  # no-op, must not raise or write
