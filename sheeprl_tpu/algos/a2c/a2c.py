@@ -27,6 +27,7 @@ from sheeprl_tpu.algos.a2c.loss import policy_loss, value_loss
 from sheeprl_tpu.algos.a2c.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import PPOPlayer, evaluate_actions
 from sheeprl_tpu.config.compose import instantiate
+from sheeprl_tpu.parallel.fabric import put_tree, resolve_player_device, resolve_train_device
 from sheeprl_tpu.envs import make_env
 from sheeprl_tpu.ops.math import gae
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -132,8 +133,6 @@ def main(fabric, cfg: Dict[str, Any]):
         observation_space,
         state["agent"] if cfg.checkpoint.resume_from else None,
     )
-    from sheeprl_tpu.parallel.fabric import resolve_player_device
-
     player = PPOPlayer(
         agent, params, device=resolve_player_device(cfg.algo.get("player_device", "auto"))
     )
@@ -146,9 +145,20 @@ def main(fabric, cfg: Dict[str, Any]):
     if cfg.algo.max_grad_norm and float(cfg.algo.max_grad_norm) > 0:
         opt_cfg["max_grad_norm"] = float(cfg.algo.max_grad_norm)
     tx = instantiate(opt_cfg)
-    opt_state = fabric.replicate(tx.init(jax.device_get(params)))
-    if cfg.checkpoint.resume_from:
-        opt_state = fabric.replicate(jax.tree.map(jnp.asarray, state["opt_state"]))
+    # remote-chip escape hatch (same as plain PPO): a tiny model's update
+    # runs on the host core so nothing in the A2C loop touches the link —
+    # the single-device train program has no mesh collectives, so committing
+    # params/opt/batch to the host is all it takes
+    train_device = resolve_train_device(
+        cfg.algo.get("train_device", "auto"), params, fabric.world_size
+    )
+    if train_device is not None:
+        params = put_tree(jax.device_get(params), train_device)
+        player.update_params(params)
+    opt_state = state["opt_state"] if cfg.checkpoint.resume_from else tx.init(params)
+    opt_state = (
+        put_tree(opt_state, train_device) if train_device is not None else fabric.replicate(opt_state)
+    )
 
     if fabric.is_global_zero:
         save_configs(cfg, log_dir)
@@ -175,9 +185,7 @@ def main(fabric, cfg: Dict[str, Any]):
     key = jax.random.PRNGKey(int(cfg.seed))
     # action keys live on the player's device so a host-pinned player
     # never blocks on a chip round trip per env step
-    from sheeprl_tpu.parallel.fabric import put_tree as _put_tree
-
-    player_key = _put_tree(jax.random.fold_in(key, 1), player.device)
+    player_key = put_tree(jax.random.fold_in(key, 1), player.device)
     next_obs, _ = envs.reset(seed=cfg.seed)
     next_obs = prepare_obs(next_obs, num_envs=num_envs)
 
@@ -223,11 +231,14 @@ def main(fabric, cfg: Dict[str, Any]):
 
         local_data = {k: np.stack(v, axis=0) for k, v in rollout.items()}
         next_values = np.asarray(player.get_values(next_obs))
+        # GAE on the player's device (host when the chip is remote-attached):
+        # rollout arrays are already host-side, so the advantage pass never
+        # pays a link round trip (same routing as plain PPO)
         returns, advantages = gae_fn(
-            jnp.asarray(local_data["rewards"]),
-            jnp.asarray(local_data["values"]),
-            jnp.asarray(local_data["dones"]),
-            jnp.asarray(next_values),
+            put_tree(local_data["rewards"], player.device),
+            put_tree(local_data["values"], player.device),
+            put_tree(local_data["dones"], player.device),
+            put_tree(next_values, player.device),
         )
         local_data["returns"] = np.asarray(returns)
         local_data["advantages"] = np.asarray(advantages)

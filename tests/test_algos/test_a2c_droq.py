@@ -34,6 +34,16 @@ def test_a2c_cartpole(tmp_path, monkeypatch):
     assert find_checkpoints(tmp_path)
 
 
+def test_a2c_host_pinned_training(tmp_path, monkeypatch):
+    """algo.train_device=cpu runs the whole A2C update on the host backend
+    (remote-chip escape hatch shared with plain PPO) — full run + resume."""
+    monkeypatch.chdir(tmp_path)
+    args = a2c_args(tmp_path) + ["fabric.devices=1", "algo.train_device=cpu"]
+    run(args)
+    (ckpt,) = find_checkpoints(tmp_path)
+    run(args + [f"checkpoint.resume_from={ckpt}", "fabric.devices=1"])
+
+
 def test_a2c_continuous(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     run(a2c_args(tmp_path) + ["env.id=Pendulum-v1"])
