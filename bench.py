@@ -177,7 +177,104 @@ def _read_probe(path, workload):
             "raise total_steps or lower learning_starts"
         )
     with open(path) as f:
-        return json.load(f)
+        rec = json.load(f)
+    if rec.get("error") == "window_never_opened":
+        # the probe ran to finish() but the warmup gate never opened — a
+        # configuration problem (run shorter than the warmup), NOT an outage,
+        # so don't let it fall into the backend-outage retry path
+        raise RuntimeError(
+            f"the {workload} run ended before its steady-state window opened: "
+            f"{rec.get('detail', 'run shorter than warmup')}"
+        )
+    return rec
+
+
+# ------------------------------------------------------------ telemetry ----
+# Readers for the run-telemetry JSONL stream (sheeprl_tpu/obs, schema in
+# howto/telemetry.md): the run's own heartbeat/span/compile events replace
+# log scraping as the source of SPS/MFU. Pure python — the bench parent
+# NEVER imports jax (see module docstring), and MFU arrives precomputed in
+# the heartbeat fields, so no peak-FLOPS table is needed here.
+
+
+def read_telemetry(path: str) -> list:
+    """Parse a telemetry.jsonl into a list of event dicts. A torn final line
+    (run killed mid-flush) is dropped, not fatal."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue
+    return events
+
+
+def telemetry_summary(events_or_path) -> dict:
+    """Aggregate a run's telemetry stream into the bench-facing numbers:
+    SPS from the heartbeat windows, time-weighted MFU, per-span totals,
+    compile/recompile counts, device-poll count and HBM peak."""
+    events = (
+        read_telemetry(events_or_path) if isinstance(events_or_path, str) else list(events_or_path)
+    )
+    summary: dict = {"events": len(events)}
+
+    heartbeats = [e for e in events if e.get("event") == "heartbeat"]
+    env_steps = sum(e.get("window_env_steps", 0) for e in heartbeats)
+    env_time = sum(e.get("window_env_time", 0.0) for e in heartbeats)
+    train_steps = sum(e.get("window_train_steps", 0) for e in heartbeats)
+    train_time = sum(e.get("window_train_time", 0.0) for e in heartbeats)
+    summary["heartbeats"] = len(heartbeats)
+    if env_time > 0:
+        summary["sps_env"] = env_steps / env_time
+    if train_time > 0:
+        summary["sps_train"] = train_steps / train_time
+    if env_time + train_time > 0:
+        summary["duty_cycle_train"] = train_time / (env_time + train_time)
+    # train_time-weighted averages: a long window's MFU should count more
+    weighted = [
+        (e["window_train_time"], e[k])
+        for k in ("mfu",)
+        for e in heartbeats
+        if k in e and e.get("window_train_time")
+    ]
+    if weighted:
+        total_w = sum(w for w, _ in weighted)
+        summary["mfu"] = sum(w * v for w, v in weighted) / total_w
+    fps = [
+        (e["window_train_time"], e["train_flops_per_sec"])
+        for e in heartbeats
+        if "train_flops_per_sec" in e and e.get("window_train_time")
+    ]
+    if fps:
+        total_w = sum(w for w, _ in fps)
+        summary["train_flops_per_sec"] = sum(w * v for w, v in fps) / total_w
+
+    spans: dict = {}
+    for e in events:
+        if e.get("event") == "span":
+            s = spans.setdefault(e.get("name", "<unnamed>"), {"count": 0, "total_s": 0.0})
+            s["count"] += 1
+            s["total_s"] += float(e.get("dur", 0.0))
+    if spans:
+        summary["spans"] = spans
+
+    compiles = [e for e in events if e.get("event") == "compile" and e.get("phase") == "lower"]
+    summary["compiles"] = len(compiles)
+    summary["recompiles_post_warm"] = sum(1 for e in compiles if e.get("post_warm"))
+    summary["device_polls"] = sum(1 for e in events if e.get("event") == "device_poll")
+    hbm = [
+        d.get("peak_bytes_in_use", 0)
+        for e in events
+        if e.get("event") == "device_poll"
+        for d in e.get("devices", [])
+    ]
+    if any(hbm):
+        summary["hbm_peak_bytes"] = max(hbm)
+    return summary
 
 
 def _ppo_args(total_steps: int):
@@ -490,8 +587,15 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--workload", choices=sorted(_WORKLOADS))
     parser.add_argument("--out")
+    parser.add_argument(
+        "--telemetry",
+        metavar="PATH",
+        help="summarize a run's telemetry.jsonl (SPS/MFU/spans/compiles) and exit",
+    )
     args = parser.parse_args()
-    if args.workload:
+    if args.telemetry:
+        print(json.dumps(telemetry_summary(args.telemetry)))
+    elif args.workload:
         if not args.out:
             parser.error("--workload requires --out")
         _run_child(args.workload, args.out)

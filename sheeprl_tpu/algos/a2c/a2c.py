@@ -29,6 +29,12 @@ from sheeprl_tpu.algos.ppo.agent import PPOPlayer, evaluate_actions
 from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.parallel.fabric import put_tree, resolve_player_device, resolve_train_device
 from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.obs import (
+    log_sps_and_heartbeat,
+    telemetry_advance,
+    telemetry_mark_warm,
+    telemetry_register_flops,
+)
 from sheeprl_tpu.ops.math import gae
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -190,6 +196,10 @@ def main(fabric, cfg: Dict[str, Any]):
     next_obs = prepare_obs(next_obs, num_envs=num_envs)
 
     for update in range(start_update, num_updates + 1):
+        telemetry_advance(policy_step)
+        if update == start_update + 1:
+            # no bench probe in this loop — warm the recompile watchdog here
+            telemetry_mark_warm()
         rollout = {k: [] for k in (*obs_keys, "dones", "values", "actions", "logprobs", "rewards")}
         with timer("Time/env_interaction_time"):
             for _ in range(rollout_steps):
@@ -251,6 +261,8 @@ def main(fabric, cfg: Dict[str, Any]):
             metrics = jax.block_until_ready(metrics)
         player.params = params
         train_step += num_processes
+        if update == start_update:
+            telemetry_register_flops(train_fn, params, opt_state, flat)
 
         if cfg.metric.log_level > 0:
             aggregator.update("Loss/policy_loss", float(metrics[0]))
@@ -258,24 +270,13 @@ def main(fabric, cfg: Dict[str, Any]):
             if policy_step - last_log >= cfg.metric.log_every or update == num_updates:
                 logger.log_metrics(aggregator.compute(), policy_step)
                 aggregator.reset()
-                if not timer.disabled:
-                    timer_metrics = timer.compute()
-                    if timer_metrics.get("Time/train_time"):
-                        logger.log_metrics(
-                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
-                            policy_step,
-                        )
-                    if timer_metrics.get("Time/env_interaction_time"):
-                        logger.log_metrics(
-                            {
-                                "Time/sps_env_interaction": (
-                                    (policy_step - last_log) * cfg.env.action_repeat
-                                )
-                                / timer_metrics["Time/env_interaction_time"]
-                            },
-                            policy_step,
-                        )
-                    timer.reset()
+                log_sps_and_heartbeat(
+                    logger,
+                    policy_step=policy_step,
+                    env_steps=(policy_step - last_log) * cfg.env.action_repeat,
+                    train_steps=train_step - last_train,
+                    train_invocations=(train_step - last_train) // num_processes,
+                )
                 last_log = policy_step
                 last_train = train_step
 

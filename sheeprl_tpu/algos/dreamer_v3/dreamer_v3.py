@@ -61,6 +61,7 @@ from sheeprl_tpu.ops.distributions import (
     SymlogDistribution,
     TwoHotEncodingDistribution,
 )
+from sheeprl_tpu.obs import log_sps_and_heartbeat, telemetry_advance, telemetry_register_flops
 from sheeprl_tpu.ops.math import MomentsState, compute_lambda_values, init_moments, update_moments
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -523,7 +524,9 @@ def main(fabric, cfg: Dict[str, Any]):
 
     probe = SteadyStateProbe()
     bench_batch = None  # one sampled batch kept for the post-run cost analysis
+    last_grad_steps = 0  # heartbeat window: train_fn invocations since last log
     for update in range(start_step, num_updates + 1):
+        telemetry_advance(policy_step)
         probe.mark_warm(update, learning_starts, policy_step, work=cumulative_per_rank_gradient_steps)
         policy_step += num_envs * num_processes
 
@@ -676,6 +679,21 @@ def main(fabric, cfg: Dict[str, Any]):
                         cumulative_per_rank_gradient_steps += 1
                         if probe.active and bench_batch is None:
                             bench_batch = batch
+                        if cumulative_per_rank_gradient_steps == 1:
+                            # shapes only — the batch itself is not pinned
+                            telemetry_register_flops(
+                                train_fn,
+                                wm_params,
+                                actor_params,
+                                critic_params,
+                                target_critic_params,
+                                world_opt,
+                                actor_opt,
+                                critic_opt,
+                                moments_state,
+                                batch,
+                                train_key,
+                            )
                     if not timer.disabled:
                         # only when timing: wait so Time/train_time measures
                         # the chip, not the async dispatch
@@ -707,26 +725,16 @@ def main(fabric, cfg: Dict[str, Any]):
                     {"Params/replay_ratio": cumulative_per_rank_gradient_steps * num_processes / policy_step},
                     policy_step,
                 )
-            if not timer.disabled:
-                timer_metrics = timer.compute()
-                if timer_metrics.get("Time/train_time"):
-                    logger.log_metrics(
-                        {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
-                        policy_step,
-                    )
-                if timer_metrics.get("Time/env_interaction_time"):
-                    logger.log_metrics(
-                        {
-                            "Time/sps_env_interaction": (
-                                (policy_step - last_log) / num_processes * cfg.env.action_repeat
-                            )
-                            / timer_metrics["Time/env_interaction_time"]
-                        },
-                        policy_step,
-                    )
-                timer.reset()
+            log_sps_and_heartbeat(
+                logger,
+                policy_step=policy_step,
+                env_steps=(policy_step - last_log) / num_processes * cfg.env.action_repeat,
+                train_steps=train_step - last_train,
+                train_invocations=cumulative_per_rank_gradient_steps - last_grad_steps,
+            )
             last_log = policy_step
             last_train = train_step
+            last_grad_steps = cumulative_per_rank_gradient_steps
 
         # ---------------- checkpoint ---------------- #
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (

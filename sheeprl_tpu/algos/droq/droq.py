@@ -340,12 +340,12 @@ def main(fabric, cfg: Dict[str, Any]):
                     if use_device_rb:
                         # on-chip gather: only the indices cross the link
                         critic_data = rb.sample_transitions(
-                            batch_size=per_rank_batch_size * fabric.local_device_count,
+                            batch_size=per_rank_batch_size * fabric.local_data_parallel_size,
                             n_samples=chunk_steps,
                         )
                     else:
                         critic_sample = rb.sample(
-                            batch_size=per_rank_batch_size * fabric.local_device_count,
+                            batch_size=per_rank_batch_size * fabric.local_data_parallel_size,
                             n_samples=chunk_steps,
                         )
                         critic_data = {k: np.asarray(v, np.float32) for k, v in critic_sample.items()}
@@ -378,11 +378,11 @@ def main(fabric, cfg: Dict[str, Any]):
                     actor_batch = {
                         k: v[0]
                         for k, v in rb.sample_transitions(
-                            batch_size=per_rank_batch_size * fabric.local_device_count
+                            batch_size=per_rank_batch_size * fabric.local_data_parallel_size
                         ).items()
                     }  # [B, ...]
                 else:
-                    actor_sample = rb.sample(batch_size=per_rank_batch_size * fabric.local_device_count)
+                    actor_sample = rb.sample(batch_size=per_rank_batch_size * fabric.local_data_parallel_size)
                     actor_batch = {
                         k: np.asarray(v, np.float32)[0] for k, v in actor_sample.items()
                     }  # [B, ...]

@@ -693,7 +693,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 # batch i+1's host->HBM transfer overlaps gradient step i
                 batches = sampled_batches(
                     rb,
-                    per_rank_batch_size * fabric.local_device_count,
+                    per_rank_batch_size * fabric.local_data_parallel_size,
                     sequence_length,
                     per_rank_gradient_steps,
                     cnn_keys,

@@ -39,6 +39,7 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.obs import log_sps_and_heartbeat, telemetry_advance, telemetry_register_flops
 from sheeprl_tpu.ops.math import gae
 from sheeprl_tpu.parallel.fabric import put_tree, resolve_player_device, resolve_train_device
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -122,8 +123,11 @@ def make_train_fn(fabric, agent, tx, cfg, obs_keys, n_local: int, host_device=No
 
     if not use_mesh:
         # inputs are committed to the host device by the caller, so the jit
-        # executes entirely on the host CPU backend
-        return jax.jit(local_train, donate_argnums=(0, 1))
+        # executes entirely on the host CPU backend. Donate ONLY opt_state:
+        # the host-pinned player aliases the very params buffers passed in
+        # here (update_params hands them over without a copy), so donating
+        # them would leave the player holding deleted arrays.
+        return jax.jit(local_train, donate_argnums=(1,))
     train_fn = shard_map(
         local_train,
         mesh=fabric.mesh,
@@ -302,6 +306,7 @@ def main(fabric, cfg: Dict[str, Any]):
 
     probe = SteadyStateProbe()
     for update in range(start_update, num_updates + 1):
+        telemetry_advance(policy_step)
         if update == start_update + 1:
             probe.mark(policy_step)
         rollout = {k: [] for k in (*obs_keys, "dones", "values", "actions", "logprobs", "rewards")}
@@ -400,6 +405,12 @@ def main(fabric, cfg: Dict[str, Any]):
             metrics = jax.block_until_ready(metrics)
         player.update_params(params)
         train_step += world_size
+        if update == start_update:
+            # shapes are fixed from here on; register the MFU flops source
+            # off the first real invocation (resolved lazily at heartbeat)
+            telemetry_register_flops(
+                train_fn, params, opt_state, flat, train_key, np.float32(clip_coef), np.float32(ent_coef)
+            )
 
         if cfg.metric.log_level > 0:
             aggregator.update("Loss/policy_loss", float(metrics[0]))
@@ -410,24 +421,13 @@ def main(fabric, cfg: Dict[str, Any]):
                 metrics_dict = aggregator.compute()
                 logger.log_metrics(metrics_dict, policy_step)
                 aggregator.reset()
-                if not timer.disabled:
-                    timer_metrics = timer.compute()
-                    if timer_metrics.get("Time/train_time"):
-                        logger.log_metrics(
-                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
-                            policy_step,
-                        )
-                    if timer_metrics.get("Time/env_interaction_time"):
-                        logger.log_metrics(
-                            {
-                                "Time/sps_env_interaction": (
-                                    (policy_step - last_log) * cfg.env.action_repeat
-                                )
-                                / timer_metrics["Time/env_interaction_time"]
-                            },
-                            policy_step,
-                        )
-                    timer.reset()
+                log_sps_and_heartbeat(
+                    logger,
+                    policy_step=policy_step,
+                    env_steps=(policy_step - last_log) * cfg.env.action_repeat,
+                    train_steps=train_step - last_train,
+                    train_invocations=(train_step - last_train) // world_size,
+                )
                 last_log = policy_step
                 last_train = train_step
 

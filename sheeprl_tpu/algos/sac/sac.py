@@ -39,6 +39,7 @@ from sheeprl_tpu.algos.sac.agent import (
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.obs import log_sps_and_heartbeat, telemetry_advance
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -297,6 +298,7 @@ def main(fabric, cfg: Dict[str, Any]):
     # steady-state throughput probe (SHEEPRL_TPU_BENCH_JSON contract)
     probe = SteadyStateProbe()
     for update in range(start_step, num_updates + 1):
+        telemetry_advance(policy_step)
         probe.mark_warm(update, learning_starts, policy_step, work=cumulative_per_rank_gradient_steps)
         policy_step += num_envs * num_processes
 
@@ -350,15 +352,18 @@ def main(fabric, cfg: Dict[str, Any]):
                 # jit; each process samples its share of the global batch and
                 # the shards assemble into one global array over the mesh
                 if use_device_rb:
-                    # on-chip gather: only the indices cross the link
+                    # on-chip gather: only the indices cross the link.
+                    # local_data_parallel_size, NOT local_device_count: on a
+                    # 2-D (data x model) mesh the batch splits over the data
+                    # axis only — model-axis devices see the same batch shard
                     data = rb.sample_transitions(
-                        batch_size=per_rank_batch_size * fabric.local_device_count,
+                        batch_size=per_rank_batch_size * fabric.local_data_parallel_size,
                         n_samples=chunk_steps,
                         sample_next_obs=cfg.buffer.sample_next_obs,
                     )
                 else:
                     sample = rb.sample(
-                        batch_size=per_rank_batch_size * fabric.local_device_count,
+                        batch_size=per_rank_batch_size * fabric.local_data_parallel_size,
                         n_samples=chunk_steps,
                         sample_next_obs=cfg.buffer.sample_next_obs,
                     )
@@ -414,24 +419,12 @@ def main(fabric, cfg: Dict[str, Any]):
                     {"Params/replay_ratio": cumulative_per_rank_gradient_steps * num_processes / policy_step},
                     policy_step,
                 )
-            if not timer.disabled:
-                timer_metrics = timer.compute()
-                if timer_metrics.get("Time/train_time"):
-                    logger.log_metrics(
-                        {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
-                        policy_step,
-                    )
-                if timer_metrics.get("Time/env_interaction_time"):
-                    logger.log_metrics(
-                        {
-                            "Time/sps_env_interaction": (
-                                (policy_step - last_log) / num_processes * cfg.env.action_repeat
-                            )
-                            / timer_metrics["Time/env_interaction_time"]
-                        },
-                        policy_step,
-                    )
-                timer.reset()
+            log_sps_and_heartbeat(
+                logger,
+                policy_step=policy_step,
+                env_steps=(policy_step - last_log) / num_processes * cfg.env.action_repeat,
+                train_steps=train_step - last_train,
+            )
             last_log = policy_step
             last_train = train_step
 

@@ -464,7 +464,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     # so checkpoints swap between buffer modes
                     data = {}
                     for k, v in rb.sample_transitions(
-                        batch_size=per_rank_batch_size * fabric.local_device_count,
+                        batch_size=per_rank_batch_size * fabric.local_data_parallel_size,
                         n_samples=chunk_steps,
                     ).items():
                         if (k in cnn_keys or (k.startswith("next_") and k[5:] in cnn_keys)) and v.ndim == 6:
@@ -473,7 +473,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         data[k] = v
                 else:
                     sample = rb.sample(
-                        batch_size=per_rank_batch_size * fabric.local_device_count,
+                        batch_size=per_rank_batch_size * fabric.local_data_parallel_size,
                         n_samples=chunk_steps,
                     )
                     data = {}

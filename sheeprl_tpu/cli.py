@@ -59,10 +59,22 @@ def resume_from_checkpoint(cfg: dotdict, cli_overrides: Optional[List[str]] = No
     # wholesale would clobber a model-axis run's stored mesh on a plain
     # resume.
     for ov in cli_overrides or []:
-        key = ov.split("=", 1)[0]
-        if key.startswith("fabric."):
+        # normalize the way compose.parse_overrides does: `+key=` / `/key=`
+        # prefixes add, `~key` deletes — all of them are explicit user intent
+        # about that key, so all of them must defeat the stored fabric section
+        key = ov.split("=", 1)[0].strip().lstrip("+~").lstrip("/")
+        if key == "fabric":
+            # bare `fabric=<group>` group override: the user re-selected the
+            # whole fabric group — take the freshly composed section wholesale
+            merged.fabric = dotdict(cfg.fabric.to_dict())
+        elif key.startswith("fabric."):
             sub = key[len("fabric."):].split(".", 1)[0]
-            merged.fabric[sub] = cfg.fabric[sub]
+            if sub in cfg.fabric:
+                merged.fabric[sub] = cfg.fabric[sub]
+            else:
+                # `~fabric.<sub>` deleted the key from the composed config —
+                # mirror the deletion instead of KeyError-ing on the copy
+                merged.fabric.pop(sub, None)
     merged.root_dir = cfg.root_dir
     merged.run_name = cfg.run_name
     return merged
@@ -154,14 +166,20 @@ def run_algorithm(cfg: dotdict) -> None:
     except ModuleNotFoundError:
         pass
 
+    from sheeprl_tpu.obs import configure_telemetry, shutdown_telemetry
     from sheeprl_tpu.utils.logger import run_base_dir
     from sheeprl_tpu.utils.profiler import maybe_profile
 
     # the run's TB root (the versioned dir itself is only chosen inside the
     # entrypoint): traces land at <root>/profile, next to version_N, so
-    # `tensorboard --logdir <root>` picks up the profile plugin data
-    with maybe_profile(cfg, log_dir=run_base_dir(cfg)):
-        entrypoint(fabric, cfg, **kwargs)
+    # `tensorboard --logdir <root>` picks up the profile plugin data; the
+    # telemetry JSONL lands beside them at <root>/telemetry.jsonl
+    configure_telemetry(cfg, log_dir=run_base_dir(cfg))
+    try:
+        with maybe_profile(cfg, log_dir=run_base_dir(cfg)):
+            entrypoint(fabric, cfg, **kwargs)
+    finally:
+        shutdown_telemetry()
 
 
 def run(args: Optional[List[str]] = None) -> None:

@@ -1,0 +1,47 @@
+"""Run-telemetry subsystem (observability layer).
+
+One instrumentation vocabulary for the whole framework:
+
+- :class:`~sheeprl_tpu.obs.span.span` — context-decorator that puts the SAME
+  section name into the wall-clock metric registry (the old ``timer``), the
+  XLA/Perfetto trace (``jax.profiler.TraceAnnotation``) and the per-process
+  ``telemetry.jsonl`` event stream.
+- :class:`~sheeprl_tpu.obs.recompile.CompileWatchdog` — ``jax.monitoring``
+  subscriber that turns every trace+lower into a ``compile`` event and raises
+  a loud warning on post-warmup recompiles (silent retracing is the #1 TPU
+  perf killer).
+- :class:`~sheeprl_tpu.obs.telemetry.RunTelemetry` — the per-run sink: JSONL
+  writer, low-rate device poller (HBM in-use/peak, optional link RTT) and the
+  per-log-interval ``heartbeat`` assembly (SPS, duty cycle, MFU, HBM peak,
+  recompile count).
+
+The event schema is documented in ``howto/telemetry.md``; ``bench.py``
+consumes the same stream (``telemetry_summary``) so the bench and the run
+report the same numbers. Everything is inert unless
+``metric.telemetry.enabled=True`` — the disabled hot path is one global read.
+"""
+
+from sheeprl_tpu.obs.heartbeat import log_sps_and_heartbeat
+from sheeprl_tpu.obs.span import TimerError, span
+from sheeprl_tpu.obs.telemetry import (
+    RunTelemetry,
+    configure_telemetry,
+    get_telemetry,
+    shutdown_telemetry,
+    telemetry_advance,
+    telemetry_mark_warm,
+    telemetry_register_flops,
+)
+
+__all__ = [
+    "RunTelemetry",
+    "TimerError",
+    "configure_telemetry",
+    "get_telemetry",
+    "log_sps_and_heartbeat",
+    "shutdown_telemetry",
+    "span",
+    "telemetry_advance",
+    "telemetry_mark_warm",
+    "telemetry_register_flops",
+]

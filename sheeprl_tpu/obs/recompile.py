@@ -1,0 +1,133 @@
+"""Recompile watchdog: turn silent retracing into loud, counted events.
+
+``jax.monitoring`` fires duration events for every trace/lower/compile.  The
+robust "a new computation variant exists" signal is
+``/jax/core/compile/jaxpr_to_mlir_module_duration``: it fires exactly once per
+traced-and-lowered variant even when the persistent compilation cache
+satisfies the backend compile (``backend_compile_duration`` can be skipped or
+be near-zero on cache hits, so it is emitted as a secondary ``phase`` only).
+
+jax.monitoring passes no function names, so while the watchdog is active the
+``jax._src.interpreters.pxla`` logger is lowered to DEBUG and a capture
+handler parses the "Compiling <name> with global shapes and types" line that
+immediately precedes lowering; the original level is restored on ``stop()``.
+
+After :meth:`mark_warm` (called from the bench steady-state probe, or
+explicitly by loops without one), every further lowering is a *recompile*:
+it increments the ``Counters/recompiles`` counter, is tagged
+``post_warm=true`` in the JSONL stream, and raises a ``RecompileWarning`` —
+silent retracing is the #1 TPU perf killer.
+"""
+
+from __future__ import annotations
+
+import logging
+import warnings
+from typing import Any, Optional
+
+_LOWER_EVENT = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+_BACKEND_EVENT = "/jax/core/compile/backend_compile_duration"
+_PXLA_LOGGER = "jax._src.interpreters.pxla"
+
+
+class RecompileWarning(UserWarning):
+    """A jitted function was re-traced/re-lowered after the warmup point."""
+
+
+class _NameCaptureHandler(logging.Handler):
+    """Grabs the function name from pxla's 'Compiling <name> with global
+    shapes and types ...' DEBUG line, emitted just before lowering."""
+
+    def __init__(self) -> None:
+        super().__init__(level=logging.DEBUG)
+        self.last_name: Optional[str] = None
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:
+            return
+        if msg.startswith("Compiling "):
+            self.last_name = msg[len("Compiling ") :].split(" ", 1)[0]
+
+
+class CompileWatchdog:
+    """Subscriber for jax.monitoring compile-duration events.
+
+    Lifecycle is owned by :class:`~sheeprl_tpu.obs.telemetry.RunTelemetry`:
+    ``start()`` on configure, ``mark_warm()`` at the steady-state point,
+    ``stop()`` on shutdown (unregisters the listener and restores the pxla
+    logger).  ``emit`` is the telemetry event sink.
+    """
+
+    def __init__(self, emit) -> None:
+        self._emit = emit
+        self.compiles = 0
+        self.recompiles = 0
+        self.warm = False
+        self._started = False
+        self._handler = _NameCaptureHandler()
+        self._logger = logging.getLogger(_PXLA_LOGGER)
+        self._saved_level: Optional[int] = None
+        self._saved_propagate: Optional[bool] = None
+
+    def start(self) -> None:
+        if self._started:
+            return
+        import jax
+
+        self._saved_level = self._logger.level
+        self._logger.addHandler(self._handler)
+        if self._logger.getEffectiveLevel() > logging.DEBUG:
+            self._logger.setLevel(logging.DEBUG)
+            # the DEBUG records exist only for the capture handler — don't
+            # spray them through the root handler for the watchdog's lifetime
+            self._saved_propagate = self._logger.propagate
+            self._logger.propagate = False
+        jax.monitoring.register_event_duration_secs_listener(self._on_event)
+        self._started = True
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        try:
+            from jax._src import monitoring as _mon  # no public unregister API
+
+            _mon._unregister_event_duration_listener_by_callback(self._on_event)
+        except Exception:
+            pass
+        self._logger.removeHandler(self._handler)
+        if self._saved_level is not None:
+            self._logger.setLevel(self._saved_level)
+            self._saved_level = None
+        if self._saved_propagate is not None:
+            self._logger.propagate = self._saved_propagate
+            self._saved_propagate = None
+
+    def mark_warm(self) -> None:
+        self.warm = True
+
+    def _on_event(self, event: str, duration: float, **kwargs: Any) -> None:
+        if event == _LOWER_EVENT:
+            phase = "lower"
+        elif event == _BACKEND_EVENT:
+            phase = "backend"
+        else:
+            return
+        name = self._handler.last_name or "<unknown>"
+        post_warm = self.warm
+        if phase == "lower":
+            self.compiles += 1
+            if post_warm:
+                self.recompiles += 1
+                warnings.warn(
+                    f"recompile after warmup: {name} was re-traced/re-lowered "
+                    f"({duration:.3f}s). Check for weak-type or shape drift in its inputs.",
+                    RecompileWarning,
+                    stacklevel=2,
+                )
+        try:
+            self._emit("compile", name=name, phase=phase, dur=duration, post_warm=post_warm)
+        except Exception:
+            pass

@@ -1,0 +1,366 @@
+"""Per-run telemetry sink: JSONL event stream, device poller, heartbeat.
+
+One :class:`RunTelemetry` per process per run, created by
+:func:`configure_telemetry` from ``cfg.metric.telemetry`` and torn down by
+:func:`shutdown_telemetry` (both wired in ``cli.run_algorithm``).  Everything
+funnels into an append-only ``telemetry.jsonl`` next to the run's logs —
+process 0 owns ``telemetry.jsonl``, the others write ``telemetry.<i>.jsonl``.
+
+Event schema (one JSON object per line, documented in howto/telemetry.md):
+every event carries ``event`` (kind), ``t`` (unix seconds), ``step``
+(policy step at emission), ``process_index`` and optionally ``name``; the
+kinds are ``run_start``, ``span``, ``compile``, ``device_poll``,
+``heartbeat``, ``bench_probe`` and ``run_end``.
+
+The module-level accessor :func:`get_telemetry` returns ``None`` unless a run
+configured telemetry — callers on hot paths pay one global read when the
+subsystem is off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Mapping, Optional
+
+from sheeprl_tpu.obs.recompile import CompileWatchdog
+
+_FLUSH_EVERY_EVENTS = 64
+_FLUSH_EVERY_SECONDS = 5.0
+
+_active_telemetry: Optional["RunTelemetry"] = None
+
+
+class TelemetryWriter:
+    """Buffered, thread-safe JSONL appender.
+
+    jax.monitoring listeners and the poller can fire from any thread; the
+    lock keeps lines whole.  Events are buffered and flushed every
+    ``_FLUSH_EVERY_EVENTS`` events or ``_FLUSH_EVERY_SECONDS`` seconds so the
+    hot path never waits on the filesystem."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fh = open(path, "a", buffering=1)
+        self._lock = threading.Lock()
+        self._buf: list = []
+        self._last_flush = time.time()
+
+    def write(self, event: Dict[str, Any]) -> None:
+        line = json.dumps(event, default=str)
+        with self._lock:
+            self._buf.append(line)
+            if len(self._buf) >= _FLUSH_EVERY_EVENTS or time.time() - self._last_flush > _FLUSH_EVERY_SECONDS:
+                self._flush_locked()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if self._buf:
+            self._fh.write("\n".join(self._buf) + "\n")
+            self._buf.clear()
+        self._fh.flush()
+        self._last_flush = time.time()
+
+    def close(self) -> None:
+        self.flush()
+        self._fh.close()
+
+
+class RunTelemetry:
+    """The per-run telemetry hub.
+
+    Owns the JSONL writer, the :class:`CompileWatchdog`, the low-rate device
+    poller, and the heartbeat assembly.  ``step`` is advanced by the training
+    loops (:func:`telemetry_advance`) so asynchronous events (compiles,
+    polls) are attributable to a policy step."""
+
+    def __init__(
+        self,
+        jsonl_path: str,
+        *,
+        poll_interval: float = 30.0,
+        poll_rtt: bool = False,
+    ) -> None:
+        import jax
+
+        self._jax = jax
+        self.process_index = jax.process_index()
+        self.step = 0
+        self.poll_interval = float(poll_interval)
+        self.poll_rtt = bool(poll_rtt)
+        self.writer = TelemetryWriter(jsonl_path)
+        self.watchdog = CompileWatchdog(self.emit)
+        self._last_poll: Optional[float] = None
+        self._hbm_peak_bytes = 0
+        self._device_polls = 0
+        self._flops_source: Optional[Callable[[], Optional[float]]] = None
+        self._flops_per_train_step: Optional[float] = None
+        self._flops_resolved = False
+
+    # -- core event plumbing -------------------------------------------------
+
+    def emit(self, event: str, name: Optional[str] = None, **fields: Any) -> None:
+        record: Dict[str, Any] = {
+            "event": event,
+            "t": time.time(),
+            "step": self.step,
+            "process_index": self.process_index,
+        }
+        if name is not None:
+            record["name"] = name
+        record.update(fields)
+        self.writer.write(record)
+
+    def emit_span(self, name: str, t_start: Optional[float], dur: float, attrs: Mapping[str, Any]) -> None:
+        fields: Dict[str, Any] = {"t_start": t_start, "dur": dur}
+        if attrs:
+            fields["attrs"] = dict(attrs)
+        self.emit("span", name=name, **fields)
+
+    def trace_annotation(self, name: Optional[str]):
+        if name is None:
+            return None
+        return self._jax.profiler.TraceAnnotation(name)
+
+    # -- loop hooks ----------------------------------------------------------
+
+    def advance(self, step: int) -> None:
+        self.step = int(step)
+        self.maybe_poll_devices()
+
+    def mark_warm(self) -> None:
+        self.watchdog.mark_warm()
+
+    def set_flops_source(self, source: Callable[[], Optional[float]]) -> None:
+        if not self._flops_resolved:
+            self._flops_source = source
+
+    def _resolve_flops(self) -> Optional[float]:
+        if not self._flops_resolved and self._flops_source is not None:
+            # the AOT cost-analysis compile is deliberate, not a retrace —
+            # keep the watchdog from flagging it as a post-warm recompile
+            saved_warm = self.watchdog.warm
+            self.watchdog.warm = False
+            try:
+                self._flops_per_train_step = self._flops_source()
+            except Exception:
+                self._flops_per_train_step = None
+            finally:
+                self.watchdog.warm = saved_warm
+            self._flops_source = None
+            self._flops_resolved = True
+        return self._flops_per_train_step
+
+    # -- device poller -------------------------------------------------------
+
+    def maybe_poll_devices(self, force: bool = False) -> None:
+        now = time.time()
+        if not force and self._last_poll is not None and now - self._last_poll < self.poll_interval:
+            return
+        self._last_poll = now
+        devices = []
+        for dev in self._jax.local_devices():
+            entry: Dict[str, Any] = {
+                "id": dev.id,
+                "kind": getattr(dev, "device_kind", "unknown"),
+                "platform": getattr(dev, "platform", "unknown"),
+            }
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
+            if stats:
+                in_use = stats.get("bytes_in_use")
+                peak = stats.get("peak_bytes_in_use", in_use)
+                if in_use is not None:
+                    entry["bytes_in_use"] = int(in_use)
+                if peak is not None:
+                    entry["peak_bytes_in_use"] = int(peak)
+                    self._hbm_peak_bytes = max(self._hbm_peak_bytes, int(peak))
+            devices.append(entry)
+        fields: Dict[str, Any] = {"devices": devices}
+        if self.poll_rtt and self._jax.default_backend() != "cpu":
+            # Link-health probe for remote-attached chips. It is a real sync
+            # point, so it is opt-in (metric.telemetry.poll_rtt) and rides the
+            # same low-rate schedule as the memory poll.
+            try:
+                from sheeprl_tpu.utils.profiler import tiny_op_rtt_seconds
+
+                fields["rtt_ms"] = tiny_op_rtt_seconds() * 1e3
+            except Exception:
+                pass
+        self._device_polls += 1
+        self.emit("device_poll", **fields)
+
+    def device_kind(self) -> str:
+        devs = self._jax.local_devices()
+        return getattr(devs[0], "device_kind", "unknown") if devs else "unknown"
+
+    # -- heartbeat -----------------------------------------------------------
+
+    def heartbeat(
+        self,
+        logger,
+        *,
+        step: int,
+        env_steps: float,
+        train_steps: float,
+        train_invocations: Optional[float],
+        timer_window: Mapping[str, float],
+    ) -> None:
+        """Assemble the per-log-interval health summary: SPS, train/rollout
+        duty cycle, MFU (via the registered ``compiled_flops`` source), HBM
+        peak, recompile count — one JSONL event + ``Telemetry/*`` scalars."""
+        env_t = float(timer_window.get("Time/env_interaction_time") or 0.0)
+        train_t = float(timer_window.get("Time/train_time") or 0.0)
+        fields: Dict[str, Any] = {
+            "window_env_steps": env_steps,
+            "window_train_steps": train_steps,
+            "window_env_time": env_t,
+            "window_train_time": train_t,
+            "device_kind": self.device_kind(),
+            "hbm_peak_bytes": self._hbm_peak_bytes,
+            "recompiles": self.watchdog.recompiles,
+            "compiles_total": self.watchdog.compiles,
+        }
+        scalars: Dict[str, float] = {"Counters/recompiles": float(self.watchdog.recompiles)}
+        if env_t > 0:
+            fields["sps_env"] = env_steps / env_t
+        if train_t > 0:
+            fields["sps_train"] = train_steps / train_t
+        if env_t + train_t > 0:
+            fields["duty_cycle_train"] = train_t / (env_t + train_t)
+            scalars["Telemetry/duty_cycle_train"] = fields["duty_cycle_train"]
+        if self._hbm_peak_bytes:
+            scalars["Telemetry/hbm_peak_bytes"] = float(self._hbm_peak_bytes)
+        flops = self._resolve_flops()
+        if flops is not None:
+            fields["flops_per_train_step"] = flops
+            if train_invocations is not None:
+                fields["window_train_invocations"] = train_invocations
+                if train_t > 0 and train_invocations > 0:
+                    fps = flops * train_invocations / train_t
+                    fields["train_flops_per_sec"] = fps
+                    scalars["Telemetry/train_flops_per_sec"] = fps
+                    from sheeprl_tpu.utils.profiler import PEAK_BF16_FLOPS
+
+                    peak = PEAK_BF16_FLOPS.get(fields["device_kind"])
+                    if peak:
+                        fields["mfu"] = fps / peak
+                        scalars["Telemetry/mfu"] = fields["mfu"]
+        self.emit("heartbeat", **fields)
+        self.writer.flush()
+        if logger is not None:
+            try:
+                logger.log_metrics(scalars, step)
+            except Exception:
+                pass
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, run_info: Optional[Mapping[str, Any]] = None) -> None:
+        self.watchdog.start()
+        self.emit("run_start", **dict(run_info or {}))
+        self.maybe_poll_devices(force=True)
+
+    def close(self) -> None:
+        self.emit(
+            "run_end",
+            compiles_total=self.watchdog.compiles,
+            recompiles=self.watchdog.recompiles,
+            device_polls=self._device_polls,
+            hbm_peak_bytes=self._hbm_peak_bytes,
+        )
+        self.watchdog.stop()
+        self.writer.close()
+
+
+# -- module-level accessors (cheap no-ops when telemetry is off) -------------
+
+
+def get_telemetry() -> Optional[RunTelemetry]:
+    return _active_telemetry
+
+
+def configure_telemetry(cfg: Mapping[str, Any], log_dir: Optional[str] = None) -> Optional[RunTelemetry]:
+    """Build the process-wide :class:`RunTelemetry` from
+    ``cfg.metric.telemetry`` (``{enabled, jsonl, poll_interval, poll_rtt}``).
+    Returns ``None`` (and leaves the subsystem inert) unless enabled."""
+    global _active_telemetry
+    tel_cfg = ((cfg.get("metric") or {}).get("telemetry")) or {}
+    if not bool(tel_cfg.get("enabled", False)):
+        return None
+    if _active_telemetry is not None:
+        shutdown_telemetry()
+    import jax
+
+    path = tel_cfg.get("jsonl") or os.path.join(log_dir or ".", "telemetry.jsonl")
+    proc = jax.process_index()
+    if proc != 0:
+        root, ext = os.path.splitext(path)
+        path = f"{root}.{proc}{ext or '.jsonl'}"
+    tel = RunTelemetry(
+        path,
+        poll_interval=float(tel_cfg.get("poll_interval", 30.0) or 0.0),
+        poll_rtt=bool(tel_cfg.get("poll_rtt", False)),
+    )
+    tel.start(
+        run_info={
+            "backend": jax.default_backend(),
+            "local_device_count": jax.local_device_count(),
+            "process_count": jax.process_count(),
+        }
+    )
+    _active_telemetry = tel
+    return tel
+
+
+def shutdown_telemetry() -> None:
+    global _active_telemetry
+    tel = _active_telemetry
+    _active_telemetry = None
+    if tel is not None:
+        try:
+            tel.close()
+        except Exception:
+            pass
+
+
+def telemetry_advance(step: int) -> None:
+    tel = _active_telemetry
+    if tel is not None:
+        tel.advance(step)
+
+
+def telemetry_mark_warm() -> None:
+    tel = _active_telemetry
+    if tel is not None:
+        tel.mark_warm()
+
+
+def telemetry_register_flops(jitted_fn: Any, *args: Any) -> None:
+    """Register a lazy ``compiled_flops`` source for MFU: shapes are captured
+    eagerly (so no device buffers are pinned), the AOT cost analysis runs at
+    most once, at the first heartbeat that needs it."""
+    tel = _active_telemetry
+    if tel is None:
+        return
+    import jax
+
+    def as_shape(x: Any) -> Any:
+        return jax.ShapeDtypeStruct(x.shape, x.dtype) if hasattr(x, "shape") and hasattr(x, "dtype") else x
+
+    shapes = jax.tree.map(as_shape, args)
+
+    def source() -> Optional[float]:
+        from sheeprl_tpu.utils.profiler import compiled_flops
+
+        return compiled_flops(jitted_fn, *shapes)
+
+    tel.set_flops_source(source)

@@ -1,63 +1,12 @@
-"""Wall-clock section timers (reference: sheeprl/utils/timer.py:16-84).
+"""Wall-clock section timers — thin shim over the telemetry span.
 
-Context-decorator accumulating per-key elapsed seconds; algorithms time
-``Time/env_interaction_time`` and ``Time/train_time`` and convert them to
-steps/sec rates at log time (dreamer_v3.py:710-725). For device work wrap the
-timed block's results in ``jax.block_until_ready`` before exiting, or the
-async dispatch makes the measurement meaningless.
+The implementation moved to :mod:`sheeprl_tpu.obs.span`: ``timer`` IS the
+``span`` class, so the class-level ``disabled`` flag and ``timers`` registry
+that the CLI and the loops poke keep working, and every timed section
+automatically becomes an XLA trace annotation + ``telemetry.jsonl`` event
+when ``metric.telemetry.enabled=True``.
 """
 
-from __future__ import annotations
+from sheeprl_tpu.obs.span import TimerError, span as timer
 
-import time
-from contextlib import ContextDecorator
-from typing import Dict, Optional
-
-from sheeprl_tpu.utils.metric import Metric, SumMetric, make_metric
-
-
-class TimerError(Exception):
-    pass
-
-
-class timer(ContextDecorator):
-    disabled: bool = False
-    timers: Dict[str, Metric] = {}
-
-    def __init__(self, name: str, metric: Optional[object] = None) -> None:
-        self.name = name
-        self._start_time: Optional[float] = None
-        if not timer.disabled and name is not None and name not in timer.timers:
-            timer.timers[name] = make_metric(metric) if metric is not None else SumMetric()
-
-    def start(self) -> None:
-        if self._start_time is not None:
-            raise TimerError("timer is running. Use .stop() to stop it")
-        self._start_time = time.perf_counter()
-
-    def stop(self) -> float:
-        if self._start_time is None:
-            raise TimerError("timer is not running. Use .start() to start it")
-        elapsed = time.perf_counter() - self._start_time
-        self._start_time = None
-        if self.name:
-            timer.timers[self.name].update(elapsed)
-        return elapsed
-
-    @classmethod
-    def reset(cls) -> None:
-        for m in cls.timers.values():
-            m.reset()
-
-    @classmethod
-    def compute(cls) -> Dict[str, float]:
-        return {k: v.compute() for k, v in cls.timers.items()}
-
-    def __enter__(self) -> "timer":
-        if not timer.disabled:
-            self.start()
-        return self
-
-    def __exit__(self, *exc_info) -> None:
-        if not timer.disabled:
-            self.stop()
+__all__ = ["TimerError", "timer"]

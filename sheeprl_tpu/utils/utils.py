@@ -242,6 +242,11 @@ class SteadyStateProbe:
         """``work`` is the loop's cumulative gradient-step counter at the
         mark, so the window's training work can be reported alongside its
         env steps (the MFU numerator needs gradient steps, not env steps)."""
+        # every loop's steady-state point doubles as the recompile watchdog's
+        # warm point — anything traced past here is a genuine recompile
+        from sheeprl_tpu.obs.telemetry import telemetry_mark_warm
+
+        telemetry_mark_warm()
         if self.path is None or self._t0 is not None:
             return
         import time
@@ -253,12 +258,33 @@ class SteadyStateProbe:
         merged into the record AFTER the clock stops — expensive bookkeeping
         like an AOT cost-analysis compile goes here without polluting the
         measured window."""
-        if self.path is None or self._t0 is None:
+        if self.path is None:
             return
         import json
         import time
 
         import jax
+
+        if self._t0 is None:
+            # The run ended before the warmup gate opened the window. That is
+            # NOT an outage — the workload was simply shorter than
+            # learning_starts/WARMUP_UPDATES — so say exactly that, both to
+            # bench.py (which raises a targeted error instead of the outage
+            # path) and to the telemetry stream.
+            detail = (
+                f"run ended at step {step} before the steady-state window opened "
+                f"(first update {self._first_update}, warmup {self.WARMUP_UPDATES} updates); "
+                "raise total_steps or lower learning_starts for this bench"
+            )
+            from sheeprl_tpu.obs.telemetry import get_telemetry
+
+            tel = get_telemetry()
+            if tel is not None:
+                tel.emit("bench_probe", error="window_never_opened", detail=detail)
+            if jax.process_index() == 0:
+                with open(self.path, "w") as f:
+                    json.dump({"error": "window_never_opened", "detail": detail}, f)
+            return
 
         if sync is not None:
             sync()

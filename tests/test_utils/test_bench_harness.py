@@ -139,6 +139,122 @@ def test_bench_parent_never_imports_jax():
     assert rec["outage"] is True and rec["value"] is not None
 
 
+def _write_telemetry(path):
+    """Synthetic telemetry.jsonl in the documented schema (howto/telemetry.md),
+    including a torn final line (run killed mid-flush)."""
+    events = [
+        {"event": "run_start", "t": 0.0, "step": 0, "process_index": 0, "backend": "cpu"},
+        {
+            "event": "device_poll",
+            "t": 0.1,
+            "step": 0,
+            "process_index": 0,
+            "devices": [{"id": 0, "kind": "TPU v5e", "platform": "tpu", "peak_bytes_in_use": 123456}],
+        },
+        {"event": "compile", "t": 0.2, "step": 0, "process_index": 0, "name": "train_fn", "phase": "lower", "dur": 1.5, "post_warm": False},
+        {"event": "compile", "t": 0.3, "step": 0, "process_index": 0, "name": "train_fn", "phase": "backend", "dur": 3.0, "post_warm": False},
+        {"event": "span", "t": 1.0, "step": 10, "process_index": 0, "name": "Time/train_time", "t_start": 0.5, "dur": 0.5},
+        {"event": "span", "t": 2.0, "step": 20, "process_index": 0, "name": "Time/train_time", "t_start": 1.5, "dur": 0.5},
+        {"event": "compile", "t": 2.5, "step": 20, "process_index": 0, "name": "train_fn", "phase": "lower", "dur": 1.0, "post_warm": True},
+        {
+            "event": "heartbeat", "t": 3.0, "step": 1000, "process_index": 0,
+            "window_env_steps": 1000, "window_env_time": 2.0,
+            "window_train_steps": 400, "window_train_time": 1.0,
+            "mfu": 0.10, "train_flops_per_sec": 1.0e12,
+        },
+        {
+            "event": "heartbeat", "t": 6.0, "step": 2000, "process_index": 0,
+            "window_env_steps": 1000, "window_env_time": 2.0,
+            "window_train_steps": 400, "window_train_time": 3.0,
+            "mfu": 0.30, "train_flops_per_sec": 3.0e12,
+        },
+    ]
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+        f.write('{"event": "heartbe')  # torn tail: must be skipped, not fatal
+
+
+def test_telemetry_summary_from_jsonl(tmp_path):
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    path = str(tmp_path / "telemetry.jsonl")
+    _write_telemetry(path)
+    s = bench.telemetry_summary(path)
+    assert s["heartbeats"] == 2
+    assert s["sps_env"] == 2000 / 4.0
+    assert s["sps_train"] == 800 / 4.0
+    assert s["duty_cycle_train"] == 4.0 / 8.0
+    # train_time-weighted: (1*0.1 + 3*0.3) / 4
+    assert abs(s["mfu"] - 0.25) < 1e-9
+    assert abs(s["train_flops_per_sec"] - 2.5e12) < 1e3
+    assert s["spans"]["Time/train_time"] == {"count": 2, "total_s": 1.0}
+    # only phase=lower counts as a compile; the backend phase is not double-counted
+    assert s["compiles"] == 2
+    assert s["recompiles_post_warm"] == 1
+    assert s["device_polls"] == 1
+    assert s["hbm_peak_bytes"] == 123456
+
+
+def test_telemetry_summary_cli(tmp_path):
+    """`bench.py --telemetry PATH` prints one JSON summary line."""
+    path = str(tmp_path / "telemetry.jsonl")
+    _write_telemetry(path)
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--telemetry", path],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["sps_env"] == 500.0 and rec["heartbeats"] == 2
+
+
+def test_telemetry_summary_needs_no_jax(tmp_path):
+    """The summary runs with jax imports poisoned — the bench parent must
+    stay jax-free even when digesting telemetry."""
+    path = str(tmp_path / "telemetry.jsonl")
+    _write_telemetry(path)
+    code = _NOJAX_BENCH_PARENT.replace("mod.main()", "") + (
+        "import json\n"
+        "print(json.dumps(mod.telemetry_summary(sys.argv[2])))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code, BENCH, path],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["sps_train"] == 200.0
+
+
+def test_read_probe_window_never_opened_is_distinct(tmp_path):
+    """The probe's 'window never opened' record must raise a targeted config
+    error, not be mistaken for a throughput record or an outage."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    import pytest
+
+    path = str(tmp_path / "probe.json")
+    with open(path, "w") as f:
+        json.dump({"error": "window_never_opened", "detail": "run shorter than warmup"}, f)
+    with pytest.raises(RuntimeError, match="before its steady-state window opened"):
+        bench._read_probe(path, "dv3")
+
+
 def test_cache_checkpoint_roundtrip(tmp_path, monkeypatch):
     sys.path.insert(0, REPO_ROOT)
     try:
