@@ -274,7 +274,47 @@ def telemetry_summary(events_or_path) -> dict:
     ]
     if any(hbm):
         summary["hbm_peak_bytes"] = max(hbm)
+    ds = dispatch_stats(events)
+    if ds.get("train_windows"):
+        summary["dispatch_stats"] = ds
     return summary
+
+
+def dispatch_stats(events_or_path) -> dict:
+    """Per-train-window dispatch counts from the run-telemetry counters
+    (obs/telemetry.py record_train_window): how many device programs one
+    train window of G gradient steps issued. The fused superstep path
+    (algo.fused_gradient_steps, howto/fused_training.md) should report
+    dispatches_per_window == ceil(G / K); the per-step path reports ~G (x2
+    with the device replay buffer's separate gather program). Prefers the
+    run_end totals (they include the trailing unflushed heartbeat window),
+    falls back to summing heartbeat windows for a still-running stream."""
+    events = (
+        read_telemetry(events_or_path) if isinstance(events_or_path, str) else list(events_or_path)
+    )
+    windows = dispatches = gradient_steps = 0
+    for e in events:
+        if e.get("event") == "run_end":
+            windows = int(e.get("train_windows", 0) or 0)
+            dispatches = int(e.get("train_dispatches", 0) or 0)
+            gradient_steps = int(e.get("train_gradient_steps", 0) or 0)
+            break
+    else:
+        for e in events:
+            if e.get("event") == "heartbeat":
+                windows += int(e.get("window_train_windows", 0) or 0)
+                dispatches += int(e.get("window_train_dispatches", 0) or 0)
+                gradient_steps += int(e.get("window_train_gradient_steps", 0) or 0)
+    out = {
+        "train_windows": windows,
+        "train_dispatches": dispatches,
+        "train_gradient_steps": gradient_steps,
+    }
+    if windows:
+        out["dispatches_per_window"] = round(dispatches / windows, 3)
+    if dispatches:
+        out["gradient_steps_per_dispatch"] = round(gradient_steps / dispatches, 3)
+    return out
 
 
 def _ppo_args(total_steps: int):
@@ -592,8 +632,16 @@ if __name__ == "__main__":
         metavar="PATH",
         help="summarize a run's telemetry.jsonl (SPS/MFU/spans/compiles) and exit",
     )
+    parser.add_argument(
+        "--dispatch-stats",
+        metavar="PATH",
+        help="report per-train-window device dispatch counts from a run's "
+        "telemetry.jsonl (fused supersteps should show ceil(G/K) per window) and exit",
+    )
     args = parser.parse_args()
-    if args.telemetry:
+    if args.dispatch_stats:
+        print(json.dumps(dispatch_stats(args.dispatch_stats)))
+    elif args.telemetry:
         print(json.dumps(telemetry_summary(args.telemetry)))
     elif args.workload:
         if not args.out:

@@ -48,9 +48,16 @@ from sheeprl_tpu.ops.optim import build_tx
 from sheeprl_tpu.data.device_buffer import (
     DeviceReplayBuffer,
     adapt_restored_buffer,
+    draw_sequence_batch,
     make_sequential_replay,
 )
 from sheeprl_tpu.data.prefetch import sampled_batches
+from sheeprl_tpu.ops.superstep import (
+    fold_sample_key,
+    make_superstep_fn,
+    periodic_target_ema,
+    pregathered,
+)
 from sheeprl_tpu.envs import make_env
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.distributions import (
@@ -61,7 +68,12 @@ from sheeprl_tpu.ops.distributions import (
     SymlogDistribution,
     TwoHotEncodingDistribution,
 )
-from sheeprl_tpu.obs import log_sps_and_heartbeat, telemetry_advance, telemetry_register_flops
+from sheeprl_tpu.obs import (
+    log_sps_and_heartbeat,
+    telemetry_advance,
+    telemetry_register_flops,
+    telemetry_train_window,
+)
 from sheeprl_tpu.ops.math import MomentsState, compute_lambda_values, init_moments, update_moments
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -86,7 +98,7 @@ METRIC_ORDER = (
 )
 
 
-def make_train_fn(
+def make_train_step(
     fabric,
     wm: WorldModel,
     actor,
@@ -98,8 +110,11 @@ def make_train_fn(
     is_continuous: bool,
     actions_dim: Sequence[int],
 ):
-    """One fused gradient step over a ``[T, B_local]`` sequence batch
-    (replaces reference train(), dreamer_v3.py:48-354)."""
+    """The raw (un-jitted) single-gradient-step body over a ``[T, B_local]``
+    sequence batch (replaces reference train(), dreamer_v3.py:48-354).
+    Returns ``(local_train, use_shard_map)`` — :func:`make_train_fn` wraps it
+    in shard_map/jit for the per-step path, :func:`make_fused_train_fn`
+    scans it inside one fused superstep dispatch."""
     algo = cfg.algo
     wmc = algo.world_model
     cnn_keys = tuple(algo.cnn_keys.encoder)
@@ -308,7 +323,28 @@ def make_train_fn(
             metrics,
         )
 
+    return local_train, use_shard_map
+
+
+def make_train_fn(
+    fabric,
+    wm: WorldModel,
+    actor,
+    critic,
+    world_tx,
+    actor_tx,
+    critic_tx,
+    cfg: Dict[str, Any],
+    is_continuous: bool,
+    actions_dim: Sequence[int],
+):
+    """One fused gradient step over a ``[T, B_local]`` sequence batch
+    (replaces reference train(), dreamer_v3.py:48-354)."""
+    local_train, use_shard_map = make_train_step(
+        fabric, wm, actor, critic, world_tx, actor_tx, critic_tx, cfg, is_continuous, actions_dim
+    )
     if use_shard_map:
+        data_axis = fabric.data_axis
         train_fn = shard_map(
             local_train,
             mesh=fabric.mesh,
@@ -325,6 +361,57 @@ def make_train_fn(
     # dispatch would otherwise alias over them (observed on the remote chip
     # as spurious INVALID_ARGUMENT errors surfacing at unrelated fetches)
     return jax.jit(train_fn, donate_argnums=(4, 5, 6, 7))
+
+
+def make_fused_train_fn(
+    fabric,
+    wm: WorldModel,
+    actor,
+    critic,
+    world_tx,
+    actor_tx,
+    critic_tx,
+    cfg: Dict[str, Any],
+    is_continuous: bool,
+    actions_dim: Sequence[int],
+    gather,
+    num_steps: int,
+):
+    """``num_steps`` gradient steps — replay gather, EMA target refresh and
+    train body — fused into ONE donated dispatch (``algo.fused_gradient_steps``;
+    see :mod:`sheeprl_tpu.ops.superstep`). Single-device only: the scan body
+    is the raw ``local_train``, not the shard_map'd program.
+
+    The jitted fn's signature is ``(params, aux, counter, sample_ctx, key) ->
+    (params, aux, key, metrics[num_steps, len(METRIC_ORDER)])`` with
+    ``params = (wm, actor, critic, target_critic)`` (un-donated) and ``aux =
+    (world_opt, actor_opt, critic_opt, moments_state)`` (donated)."""
+    local_train, use_shard_map = make_train_step(
+        fabric, wm, actor, critic, world_tx, actor_tx, critic_tx, cfg, is_continuous, actions_dim
+    )
+    if use_shard_map:
+        raise ValueError(
+            "fused supersteps need a single-device run; got "
+            f"world_size={fabric.world_size}"
+        )
+    freq = max(1, int(cfg.algo.critic.per_rank_target_network_update_freq))
+    tau = float(cfg.algo.critic.tau)
+
+    def train_body(params, aux, batch, key):
+        wm_p, a_p, c_p, t_p = params
+        wm_p, a_p, c_p, w_o, a_o, c_o, m_s, metrics = local_train(
+            wm_p, a_p, c_p, t_p, *aux, batch, key
+        )
+        return (wm_p, a_p, c_p, t_p), (w_o, a_o, c_o, m_s), metrics
+
+    def pre_step(params, aux, counter):
+        # the host loop refreshes the target BEFORE the step on the same
+        # schedule (cumulative % freq == 0, hard copy at step 0)
+        wm_p, a_p, c_p, t_p = params
+        t_p = periodic_target_ema(counter, c_p, t_p, freq, tau)
+        return (wm_p, a_p, c_p, t_p), aux
+
+    return make_superstep_fn(train_body, gather, num_steps, pre_step=pre_step)
 
 
 @register_algorithm()
@@ -486,6 +573,65 @@ def main(fabric, cfg: Dict[str, Any]):
     if cfg.checkpoint.resume_from:
         ratio.load_state_dict(state["ratio"])
 
+    # ---- fused training supersteps (algo.fused_gradient_steps) ----
+    # K > 0 chunks each train window into ceil(G / K) superstep dispatches:
+    # replay gather, EMA target refresh and K gradient steps in ONE donated
+    # XLA program (ops.superstep). 0 keeps the per-step path above.
+    fused_k = int(cfg.algo.get("fused_gradient_steps", 0) or 0)
+    if fused_k > 0 and fabric.world_size * fabric.num_processes > 1:
+        import warnings
+
+        warnings.warn(
+            "algo.fused_gradient_steps needs a single-process single-device "
+            "run; falling back to the per-step train path",
+            stacklevel=2,
+        )
+        fused_k = 0
+    fused_fns: Dict[int, Any] = {}  # one compiled superstep per distinct scan length
+    fused_batch_size = per_rank_batch_size * fabric.local_data_parallel_size
+
+    if use_device_rb:
+
+        def fused_gather(ctx, gather_key, i):
+            del i  # fresh indices come from the folded per-step key
+            bufs, pos, full = ctx
+            return draw_sequence_batch(
+                bufs, pos, full, fold_sample_key(gather_key), fused_batch_size, sequence_length
+            )
+
+    else:
+        fused_gather = pregathered
+
+    def get_fused_fn(n: int):
+        fn = fused_fns.get(n)
+        if fn is None:
+            fn = fused_fns[n] = make_fused_train_fn(
+                fabric,
+                wm,
+                actor,
+                critic,
+                world_tx,
+                actor_tx,
+                critic_tx,
+                cfg,
+                is_continuous,
+                actions_dim,
+                fused_gather,
+                n,
+            )
+        return fn
+
+    def fused_pregather_ctx(n: int):
+        # host-buffer fallback: draw the chunk's n batches with the buffer's
+        # own RNG (the unfused sampling distribution and stream) and ship
+        # them once as a stacked [n, T, B, ...] pytree
+        from sheeprl_tpu.data.buffers import to_device
+
+        sample = rb.sample(fused_batch_size, sequence_length=sequence_length, n_samples=n)
+        return to_device(
+            {k: (v if k in cnn_keys else v.astype(np.float32)) for k, v in sample.items()}
+        )
+
     key = jax.random.PRNGKey(int(cfg.seed))
     if cfg.checkpoint.resume_from and "rng_key" in state:
         key = jnp.asarray(state["rng_key"])
@@ -524,6 +670,7 @@ def main(fabric, cfg: Dict[str, Any]):
 
     probe = SteadyStateProbe()
     bench_batch = None  # one sampled batch kept for the post-run cost analysis
+    bench_superstep = None  # fused path: (fn, chunk, arg shapes) for the same
     last_grad_steps = 0  # heartbeat window: train_fn invocations since last log
     for update in range(start_step, num_updates + 1):
         telemetry_advance(policy_step)
@@ -633,7 +780,54 @@ def main(fabric, cfg: Dict[str, Any]):
         # ---------------- training ---------------- #
         if update >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step / num_processes)
-            if per_rank_gradient_steps > 0:
+            if per_rank_gradient_steps > 0 and fused_k > 0:
+                # fused path: the whole window is ceil(G / K) superstep
+                # dispatches — gather + EMA + train scanned inside XLA
+                window_dispatches = 0
+                with timer("Time/train_time"):
+                    n_left = per_rank_gradient_steps
+                    while n_left > 0:
+                        chunk = min(fused_k, n_left)
+                        n_left -= chunk
+                        superstep = get_fused_fn(chunk)
+                        ctx = (
+                            rb.superstep_inputs(sequence_length)
+                            if use_device_rb
+                            else fused_pregather_ctx(chunk)
+                        )
+                        params = (wm_params, actor_params, critic_params, target_critic_params)
+                        aux = (world_opt, actor_opt, critic_opt, moments_state)
+                        counter = jnp.int32(cumulative_per_rank_gradient_steps)
+                        if cumulative_per_rank_gradient_steps == 0:
+                            # shapes only; scaled so the heartbeat's MFU stays
+                            # per-gradient-step (invocations count steps)
+                            telemetry_register_flops(
+                                superstep, params, aux, counter, ctx, key, scale=1.0 / chunk
+                            )
+                        if probe.active and bench_superstep is None:
+                            # ShapeDtypeStructs, NOT live refs — aux is about
+                            # to be donated and deleted by the dispatch
+                            shapes = jax.tree.map(
+                                lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)),
+                                (params, aux, counter, ctx, key),
+                            )
+                            bench_superstep = (superstep, chunk, shapes)
+                        params, aux, key, metrics = superstep(params, aux, counter, ctx, key)
+                        wm_params, actor_params, critic_params, target_critic_params = params
+                        world_opt, actor_opt, critic_opt, moments_state = aux
+                        cumulative_per_rank_gradient_steps += chunk
+                        window_dispatches += 1
+                        if cfg.metric.log_level > 0:
+                            # [chunk, len(METRIC_ORDER)] on device, one fetch
+                            # per log interval for the whole window
+                            pending_metrics.append(metrics)
+                    if not timer.disabled:
+                        jax.block_until_ready(wm_params)
+                    train_step += num_processes
+                telemetry_train_window(window_dispatches, per_rank_gradient_steps)
+                player.update_params(wm_params, actor_params)
+                fence.push(metrics)
+            elif per_rank_gradient_steps > 0:
                 # each process samples its share of the global batch
                 # batch i+1's host->HBM transfer overlaps gradient step i
                 batches = sampled_batches(
@@ -645,6 +839,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     fabric,
                     prefetch=int(cfg.buffer.get("prefetch", 0) or 0),
                 )
+                window_ema_dispatches = 0
                 with timer("Time/train_time"):
                     for i, batch in enumerate(batches):
                         if (
@@ -654,6 +849,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         ):
                             tau = 1.0 if cumulative_per_rank_gradient_steps == 0 else float(cfg.algo.critic.tau)
                             target_critic_params = ema(critic_params, target_critic_params, tau)
+                            window_ema_dispatches += 1
                         key, train_key = jax.random.split(key)
                         (
                             wm_params,
@@ -699,6 +895,12 @@ def main(fabric, cfg: Dict[str, Any]):
                         # the chip, not the async dispatch
                         jax.block_until_ready(wm_params)
                     train_step += num_processes
+                # per-step dispatch shape: one train call per gradient step,
+                # plus the on-device gather per batch and the EMA refreshes
+                telemetry_train_window(
+                    per_rank_gradient_steps * (2 if use_device_rb else 1) + window_ema_dispatches,
+                    per_rank_gradient_steps,
+                )
                 player.update_params(wm_params, actor_params)
                 fence.push(metrics)
                 if cfg.metric.log_level > 0:
@@ -712,8 +914,12 @@ def main(fabric, cfg: Dict[str, Any]):
         if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or update == num_updates):
             if pending_metrics:
                 # stack ON DEVICE first: one transfer for the whole window
-                # instead of one round trip per train block
-                for metrics_np in np.asarray(jax.device_get(jnp.stack(pending_metrics))):
+                # instead of one round trip per train block; fused entries
+                # are already [chunk, |METRIC_ORDER|] blocks
+                stacked = jnp.concatenate(
+                    [m if m.ndim == 2 else m[None] for m in pending_metrics], axis=0
+                )
+                for metrics_np in np.asarray(jax.device_get(stacked)):
                     for name, value in zip(METRIC_ORDER, metrics_np):
                         aggregator.update(name, float(value))
                 pending_metrics.clear()
@@ -776,9 +982,14 @@ def main(fabric, cfg: Dict[str, Any]):
     def _bench_extra():
         # per-train-step FLOPs for bench.py's MFU: one AOT cost-analysis
         # compile, paid after the clock stopped
+        from sheeprl_tpu.utils.profiler import compiled_flops
+
+        if bench_superstep is not None:
+            fn, chunk, shapes = bench_superstep
+            flops = compiled_flops(fn, *shapes)
+            return {"flops_per_train_step": flops / chunk} if flops else {}
         if bench_batch is None:
             return {}
-        from sheeprl_tpu.utils.profiler import compiled_flops
 
         flops = compiled_flops(
             train_fn,

@@ -11,6 +11,7 @@ per-critic, per-step.
 from __future__ import annotations
 
 import os
+import warnings
 from typing import Any, Dict
 
 import gymnasium as gym
@@ -30,7 +31,10 @@ from sheeprl_tpu.algos.droq.agent import (
 )
 from sheeprl_tpu.algos.sac.loss import entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
+from sheeprl_tpu.data.device_buffer import draw_transition_batch
 from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.obs import telemetry_train_window
+from sheeprl_tpu.ops.superstep import fold_sample_key
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -46,7 +50,7 @@ def _ensemble_apply_dropout(critic, stacked_params, obs, action, key, n_critics)
     return jnp.moveaxis(qs[..., 0], 0, -1)  # [B, n_critics]
 
 
-def make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg):
+def make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg, *, fused_length=None, fused_batch_size=None):
     gamma = float(cfg.algo.gamma)
     tau = float(cfg.algo.tau)
     target_entropy = agent.target_entropy
@@ -55,6 +59,13 @@ def make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg):
     use_dropout = float(cfg.algo.critic.get("dropout", 0.0)) > 0.0
     data_axis = fabric.data_axis
     multi_device = fabric.world_size > 1
+    # fused superstep mode (algo.fused_gradient_steps): `critic_data` becomes
+    # the device ring's (bufs, pos, full) context and every scanned critic
+    # step draws its own batch on device — gather, TD update and target EMA
+    # in ONE dispatch per chunk. The actor update stays one dispatch.
+    fused = fused_length is not None
+    if fused and multi_device:
+        raise ValueError("fused in-scan gather supersteps need a single-device run")
 
     def pmean(x):
         return lax.pmean(x, data_axis) if multi_device else x
@@ -101,9 +112,28 @@ def make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg):
             )
             return (critic_params, target_params, critic_opt, key), qf_loss
 
-        (critic_params, target_params, critic_opt, key), qf_losses = lax.scan(
-            critic_step, (critic_params, target_params, critic_opt, key), critic_data
-        )
+        if fused:
+            bufs, pos, full = critic_data
+
+            def fused_critic_step(carry, _):
+                # draw key = carried key folded with the sample salt, so the
+                # index noise stays decorrelated from the dropout/gradient
+                # noise critic_step derives from the same key via split
+                batch = draw_transition_batch(
+                    bufs, pos, full, fold_sample_key(carry[-1]), fused_batch_size
+                )
+                return critic_step(carry, batch)
+
+            (critic_params, target_params, critic_opt, key), qf_losses = lax.scan(
+                fused_critic_step,
+                (critic_params, target_params, critic_opt, key),
+                None,
+                length=int(fused_length),
+            )
+        else:
+            (critic_params, target_params, critic_opt, key), qf_losses = lax.scan(
+                critic_step, (critic_params, target_params, critic_opt, key), critic_data
+            )
         return critic_params, target_params, critic_opt, pmean(qf_losses.mean())
 
     def local_actor_update(
@@ -251,6 +281,26 @@ def main(fabric, cfg: Dict[str, Any]):
             memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
         )
 
+    # fused supersteps (algo.fused_gradient_steps): K > 0 moves the replay
+    # gather INSIDE the scanned critic chunk so one train window of G critic
+    # steps issues ceil(G / K) dispatches (the actor update stays one)
+    fused_k = int(cfg.algo.get("fused_gradient_steps", 0) or 0)
+    if fused_k > 0 and not use_device_rb:
+        warnings.warn(
+            "algo.fused_gradient_steps needs the device replay buffer (buffer.device) to draw "
+            "batches inside the scanned chunk; the host-buffer path already runs each chunk as "
+            "one dispatch. Falling back to the per-chunk host gather.",
+            stacklevel=2,
+        )
+        fused_k = 0
+    if fused_k > 0 and fabric.world_size * fabric.num_processes > 1:
+        warnings.warn(
+            "algo.fused_gradient_steps needs a single-process, single-device run; falling back "
+            "to the per-chunk gather path.",
+            stacklevel=2,
+        )
+        fused_k = 0
+
     critic_fn, actor_fn = make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg)
 
     train_step = 0
@@ -273,6 +323,27 @@ def main(fabric, cfg: Dict[str, Any]):
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if cfg.checkpoint.resume_from:
         ratio.load_state_dict(state["ratio"])
+
+    # per scanned length one compiled critic superstep (chunking keeps the set
+    # of lengths at {fused_k} ∪ {possible remainders}); built lazily AFTER the
+    # elastic resume may have rewritten per_rank_batch_size
+    fused_critic_fns: Dict[int, Any] = {}
+
+    def get_fused_critic_fn(n: int):
+        fn = fused_critic_fns.get(n)
+        if fn is None:
+            fn = make_train_fn(
+                fabric,
+                agent,
+                actor_tx,
+                critic_tx,
+                alpha_tx,
+                cfg,
+                fused_length=n,
+                fused_batch_size=per_rank_batch_size * fabric.local_data_parallel_size,
+            )[0]
+            fused_critic_fns[n] = fn
+        return fn
 
     key = jax.random.PRNGKey(int(cfg.seed))
     # action keys live on the player's device so a host-pinned player
@@ -336,14 +407,25 @@ def main(fabric, cfg: Dict[str, Any]):
                 # sampling/staging stays OUTSIDE the train timer like the
                 # other SAC-family loops
                 qf_losses = []
-                for chunk_steps in gradient_step_chunks(per_rank_gradient_steps, cfg.algo):
-                    if use_device_rb:
+                window_dispatches = 0
+                chunk_cfg = {"gradient_steps_chunk": fused_k} if fused_k > 0 else cfg.algo
+                for chunk_steps in gradient_step_chunks(per_rank_gradient_steps, chunk_cfg):
+                    chunk_fn = critic_fn
+                    if fused_k > 0:
+                        # in-scan gather: the whole chunk is ONE dispatch;
+                        # only the [E] pos/full cursors cross the link
+                        critic_data = rb.superstep_inputs()
+                        chunk_fn = get_fused_critic_fn(chunk_steps)
+                        window_dispatches += 1
+                    elif use_device_rb:
                         # on-chip gather: only the indices cross the link
                         critic_data = rb.sample_transitions(
                             batch_size=per_rank_batch_size * fabric.local_data_parallel_size,
                             n_samples=chunk_steps,
                         )
+                        window_dispatches += 2  # gather program + scanned train program
                     else:
+                        window_dispatches += 1
                         critic_sample = rb.sample(
                             batch_size=per_rank_batch_size * fabric.local_data_parallel_size,
                             n_samples=chunk_steps,
@@ -361,7 +443,7 @@ def main(fabric, cfg: Dict[str, Any]):
                             agent.target_critic_params,
                             critic_opt,
                             qf_loss,
-                        ) = critic_fn(
+                        ) = chunk_fn(
                             agent.actor_params,
                             agent.critic_params,
                             agent.target_critic_params,
@@ -381,7 +463,9 @@ def main(fabric, cfg: Dict[str, Any]):
                             batch_size=per_rank_batch_size * fabric.local_data_parallel_size
                         ).items()
                     }  # [B, ...]
+                    window_dispatches += 2  # actor-batch gather + actor program
                 else:
+                    window_dispatches += 1
                     actor_sample = rb.sample(batch_size=per_rank_batch_size * fabric.local_data_parallel_size)
                     actor_batch = {
                         k: np.asarray(v, np.float32)[0] for k, v in actor_sample.items()
@@ -410,6 +494,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     qf_mean = float(weighted_chunk_metrics(qf_losses))
                     actor_metrics = np.asarray(jax.device_get(actor_metrics))
                     train_step += num_processes
+                telemetry_train_window(window_dispatches, per_rank_gradient_steps + 1)
                 player.update_params(agent.actor_params)
                 if cfg.metric.log_level > 0:
                     aggregator.update("Loss/value_loss", float(qf_mean))

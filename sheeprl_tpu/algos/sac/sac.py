@@ -38,8 +38,10 @@ from sheeprl_tpu.algos.sac.agent import (
 )
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
+from sheeprl_tpu.data.device_buffer import draw_transition_batch
 from sheeprl_tpu.envs import make_env
-from sheeprl_tpu.obs import log_sps_and_heartbeat, telemetry_advance
+from sheeprl_tpu.obs import log_sps_and_heartbeat, telemetry_advance, telemetry_train_window
+from sheeprl_tpu.ops.superstep import fold_sample_key
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -47,7 +49,18 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, SteadyStateProbe, gradient_step_chunks, save_configs, weighted_chunk_metrics
 
 
-def make_train_fn(fabric, agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg):
+def make_train_fn(
+    fabric,
+    agent: SACAgent,
+    actor_tx,
+    critic_tx,
+    alpha_tx,
+    cfg,
+    *,
+    fused_length=None,
+    fused_batch_size=None,
+    fused_sample_next_obs=False,
+):
     gamma = float(cfg.algo.gamma)
     tau = float(cfg.algo.tau)
     target_entropy = agent.target_entropy
@@ -55,6 +68,14 @@ def make_train_fn(fabric, agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg):
     actor, critic = agent.actor, agent.critic
     data_axis = fabric.data_axis
     multi_device = fabric.world_size > 1
+    # fused superstep mode (algo.fused_gradient_steps): instead of scanning a
+    # pre-gathered [G, B, ...] batch, `data` is the device ring's
+    # (bufs, pos, full) context and every scanned step draws its own batch
+    # on device — replay gather, critic/actor/alpha updates and the target
+    # EMA all land in ONE dispatch per chunk (ops/superstep.py rationale)
+    fused = fused_length is not None
+    if fused and multi_device:
+        raise ValueError("fused in-scan gather supersteps need a single-device run")
     # EMA cadence in gradient steps (reference sac.py:56 ties it to updates)
     ema_every = max(1, int(cfg.algo.critic.target_network_frequency) // max(1, int(cfg.env.num_envs)))
 
@@ -126,7 +147,27 @@ def make_train_fn(fabric, agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg):
 
         carry = (actor_params, critic_params, target_params, log_alpha,
                  actor_opt, critic_opt, alpha_opt, grad_counter, key)
-        carry, metrics = lax.scan(one_step, carry, data)
+        if fused:
+            bufs, pos, full = data
+
+            def fused_step(carry, _):
+                # the draw key is the carried key folded with the sample salt,
+                # so the index noise never correlates with the gradient noise
+                # one_step derives from the same key via split
+                batch = draw_transition_batch(
+                    bufs,
+                    pos,
+                    full,
+                    fold_sample_key(carry[-1]),
+                    fused_batch_size,
+                    sample_next_obs=fused_sample_next_obs,
+                    obs_keys=("observations",),
+                )
+                return one_step(carry, batch)
+
+            carry, metrics = lax.scan(fused_step, carry, None, length=int(fused_length))
+        else:
+            carry, metrics = lax.scan(one_step, carry, data)
         (actor_params, critic_params, target_params, log_alpha,
          actor_opt, critic_opt, alpha_opt, grad_counter, _) = carry
         return (
@@ -261,6 +302,26 @@ def main(fabric, cfg: Dict[str, Any]):
             memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
         )
 
+    # fused supersteps (algo.fused_gradient_steps): K > 0 moves the replay
+    # gather INSIDE the scanned chunk so one train window of G steps issues
+    # ceil(G / K) dispatches with no host round trip in between
+    fused_k = int(cfg.algo.get("fused_gradient_steps", 0) or 0)
+    if fused_k > 0 and not use_device_rb:
+        warnings.warn(
+            "algo.fused_gradient_steps needs the device replay buffer (buffer.device) to draw "
+            "batches inside the scanned chunk; the host-buffer path already runs each chunk as "
+            "one dispatch. Falling back to the per-chunk host gather.",
+            stacklevel=2,
+        )
+        fused_k = 0
+    if fused_k > 0 and fabric.world_size * fabric.num_processes > 1:
+        warnings.warn(
+            "algo.fused_gradient_steps needs a single-process, single-device run; falling back "
+            "to the per-chunk gather path.",
+            stacklevel=2,
+        )
+        fused_k = 0
+
     train_fn = make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg)
 
     train_step = 0
@@ -283,6 +344,28 @@ def main(fabric, cfg: Dict[str, Any]):
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if cfg.checkpoint.resume_from:
         ratio.load_state_dict(state["ratio"])
+
+    # per scanned length one compiled superstep (chunking keeps the set of
+    # lengths at {fused_k} ∪ {possible remainders}); built lazily AFTER the
+    # elastic resume may have rewritten per_rank_batch_size
+    fused_train_fns: Dict[int, Any] = {}
+
+    def get_fused_fn(n: int):
+        fn = fused_train_fns.get(n)
+        if fn is None:
+            fn = make_train_fn(
+                fabric,
+                agent,
+                actor_tx,
+                critic_tx,
+                alpha_tx,
+                cfg,
+                fused_length=n,
+                fused_batch_size=per_rank_batch_size * fabric.local_data_parallel_size,
+                fused_sample_next_obs=bool(cfg.buffer.sample_next_obs),
+            )
+            fused_train_fns[n] = fn
+        return fn
 
     key = jax.random.PRNGKey(int(cfg.seed))
     grad_counter = jnp.zeros((), jnp.int32)
@@ -347,11 +430,20 @@ def main(fabric, cfg: Dict[str, Any]):
             # XLA compile, and Ratio's first post-warmup call repays the whole
             # warmup debt in one G (utils.gradient_step_chunks)
             chunk_metrics = []
-            for chunk_steps in gradient_step_chunks(per_rank_gradient_steps, cfg.algo):
+            window_dispatches = 0
+            chunk_cfg = {"gradient_steps_chunk": fused_k} if fused_k > 0 else cfg.algo
+            for chunk_steps in gradient_step_chunks(per_rank_gradient_steps, chunk_cfg):
                 # [G, B_total, ...] so the chunk's gradient loop runs in one
                 # jit; each process samples its share of the global batch and
                 # the shards assemble into one global array over the mesh
-                if use_device_rb:
+                chunk_fn = train_fn
+                if fused_k > 0:
+                    # in-scan gather: the whole chunk is ONE dispatch; only
+                    # the [E] pos/full cursors cross the link per chunk
+                    data = rb.superstep_inputs(sample_next_obs=cfg.buffer.sample_next_obs)
+                    chunk_fn = get_fused_fn(chunk_steps)
+                    window_dispatches += 1
+                elif use_device_rb:
                     # on-chip gather: only the indices cross the link.
                     # local_data_parallel_size, NOT local_device_count: on a
                     # 2-D (data x model) mesh the batch splits over the data
@@ -361,7 +453,9 @@ def main(fabric, cfg: Dict[str, Any]):
                         n_samples=chunk_steps,
                         sample_next_obs=cfg.buffer.sample_next_obs,
                     )
+                    window_dispatches += 2  # gather program + scanned train program
                 else:
+                    window_dispatches += 1
                     sample = rb.sample(
                         batch_size=per_rank_batch_size * fabric.local_data_parallel_size,
                         n_samples=chunk_steps,
@@ -387,7 +481,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         alpha_opt,
                         grad_counter,
                         metrics,
-                    ) = train_fn(
+                    ) = chunk_fn(
                         agent.actor_params,
                         agent.critic_params,
                         agent.target_critic_params,
@@ -402,6 +496,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     chunk_metrics.append((chunk_steps, metrics))  # device array; fetched once below
                 cumulative_per_rank_gradient_steps += chunk_steps
             if per_rank_gradient_steps > 0:
+                telemetry_train_window(window_dispatches, per_rank_gradient_steps)
                 train_step += num_processes  # one "train event" per update
                 player.update_params(agent.actor_params)
                 if cfg.metric.log_level > 0:

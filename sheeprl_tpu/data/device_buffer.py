@@ -40,6 +40,138 @@ def _is_pixel(v: np.ndarray) -> bool:
     return v.dtype == np.uint8
 
 
+# --------------------------------------------------------------------------- #
+# Pure sampling kernels.
+#
+# Everything below is a plain function of device arrays — callable from inside
+# another jitted program (the fused training supersteps scan these to draw a
+# fresh replay batch per gradient step without a host round trip) as well as
+# from the buffer's own jitted methods. The ring arrays are ``[n_envs,
+# capacity + 1, ...]`` (slot ``capacity`` is the partial-add scratch row and
+# is never sampled); validity is recomputed on device from the two tiny
+# cursor arrays ``pos``/``full``, so the mask shapes are fixed and nothing
+# recompiles as the ring fills.
+# --------------------------------------------------------------------------- #
+
+
+def _ring_capacity(bufs: Dict[str, jax.Array]) -> int:
+    # static under jit: the trailing scratch slot is excluded from sampling
+    return next(iter(bufs.values())).shape[1] - 1
+
+
+def sequence_start_mask(
+    pos: jax.Array, full: jax.Array, capacity: int, span: int
+) -> jax.Array:
+    """``[n_envs, capacity]`` bool mask of valid sequence-window starts — the
+    on-device mirror of :meth:`DeviceReplayBuffer._valid_starts` (windows of
+    ``span`` steps that do not straddle the env's write cursor)."""
+    s = jnp.arange(capacity, dtype=jnp.int32)[None, :]
+    pos = jnp.asarray(pos, jnp.int32)[:, None]
+    full = jnp.asarray(full, bool)[:, None]
+    first_end = pos - span + 1
+    second_end = jnp.where(first_end >= 0, capacity, capacity + first_end)
+    when_full = (s < jnp.maximum(first_end, 0)) | ((s >= pos) & (s < second_end))
+    return jnp.where(full, when_full, s < first_end)
+
+
+def transition_item_mask(
+    pos: jax.Array, full: jax.Array, capacity: int, sample_next_obs: bool
+) -> jax.Array:
+    """``[n_envs, capacity]`` bool mask of valid transition items — the
+    on-device mirror of :meth:`DeviceReplayBuffer._valid_items` (when
+    ``sample_next_obs`` the slot before the cursor is excluded too: its
+    successor is the oldest slot, about to be overwritten)."""
+    s = jnp.arange(capacity, dtype=jnp.int32)[None, :]
+    pos = jnp.asarray(pos, jnp.int32)[:, None]
+    full = jnp.asarray(full, bool)[:, None]
+    end = pos - (1 if sample_next_obs else 0)
+    second_end = jnp.where(end >= 0, capacity, capacity + end)
+    when_full = (s < jnp.maximum(end, 0)) | ((s >= pos) & (s < second_end))
+    return jnp.where(full, when_full, s < jnp.maximum(end, 0))
+
+
+def draw_from_mask(key: jax.Array, mask: jax.Array, n: int) -> Tuple[jax.Array, jax.Array]:
+    """Draw ``(env_idx [n], item [n])`` from a validity mask with the stock
+    sampling distribution — uniform env, then uniform over that env's valid
+    entries — on a jax RNG stream (the host paths use the buffer's numpy
+    generator; the streams differ, the distribution matches). Every env must
+    have at least one valid entry (the callers validate on host before
+    dispatch)."""
+    n_envs = mask.shape[0]
+    k_env, k_item = jax.random.split(key)
+    env_idx = jax.random.randint(k_env, (n,), 0, n_envs, dtype=jnp.int32)
+    rows = mask[env_idx].astype(jnp.int32)  # [n, capacity]
+    counts = rows.sum(axis=1)
+    u = jax.random.uniform(k_item, (n,))
+    j = jnp.minimum((u * counts.astype(jnp.float32)).astype(jnp.int32), jnp.maximum(counts - 1, 0))
+    # item = the (j+1)-th True of the env's row: uniform over valid entries
+    item = jnp.argmax(jnp.cumsum(rows, axis=1) > j[:, None], axis=1)
+    return env_idx, item.astype(jnp.int32)
+
+
+def gather_sequences(
+    bufs: Dict[str, jax.Array], env_idx: jax.Array, time_idx: jax.Array
+) -> Dict[str, jax.Array]:
+    """HBM→HBM sequence gather: ``env_idx [B]``, ``time_idx [B, T]`` →
+    ``[T, B, ...]`` values (time-major, the layout the fused train steps
+    consume)."""
+    out = {}
+    for k, b in bufs.items():
+        g = b[env_idx[:, None], time_idx]  # [B, T, ...]
+        out[k] = jnp.swapaxes(g, 0, 1)
+    return out
+
+
+def gather_transition_items(
+    bufs: Dict[str, jax.Array], env_idx: jax.Array, time_idx: jax.Array
+) -> Dict[str, jax.Array]:
+    """Flat transition gather: ``env_idx``/``time_idx [N]`` → ``[N, ...]``."""
+    return {k: b[env_idx, time_idx] for k, b in bufs.items()}
+
+
+def draw_sequence_batch(
+    bufs: Dict[str, jax.Array],
+    pos: jax.Array,
+    full: jax.Array,
+    key: jax.Array,
+    batch_size: int,
+    sequence_length: int,
+) -> Dict[str, jax.Array]:
+    """One ``[T, B, ...]`` sequence batch drawn and gathered entirely
+    in-graph — the Dreamer-family replay read of a fused superstep."""
+    capacity = _ring_capacity(bufs)
+    mask = sequence_start_mask(pos, full, capacity, sequence_length)
+    env_idx, starts = draw_from_mask(key, mask, batch_size)
+    offsets = jnp.arange(sequence_length, dtype=jnp.int32)
+    time_idx = (starts[:, None] + offsets[None, :]) % capacity
+    return gather_sequences(bufs, env_idx, time_idx)
+
+
+def draw_transition_batch(
+    bufs: Dict[str, jax.Array],
+    pos: jax.Array,
+    full: jax.Array,
+    key: jax.Array,
+    batch_size: int,
+    sample_next_obs: bool = False,
+    obs_keys: Sequence[str] = (),
+) -> Dict[str, jax.Array]:
+    """One ``[B, ...]`` uniform-transition batch drawn and gathered entirely
+    in-graph — the SAC-family replay read of a fused superstep. Matches the
+    :meth:`DeviceReplayBuffer.sample_transitions` output contract
+    (``next_<k>`` at item+1 when ``sample_next_obs``)."""
+    capacity = _ring_capacity(bufs)
+    mask = transition_item_mask(pos, full, capacity, sample_next_obs)
+    env_idx, items = draw_from_mask(key, mask, batch_size)
+    out = {k: b[env_idx, items] for k, b in bufs.items()}
+    if sample_next_obs:
+        next_idx = (items + 1) % capacity
+        for k in obs_keys:
+            if k in bufs:
+                out[f"next_{k}"] = bufs[k][env_idx, next_idx]
+    return out
+
+
 class DeviceReplayBuffer:
     """Sequential replay ring resident on an accelerator device.
 
@@ -153,20 +285,7 @@ class DeviceReplayBuffer:
                 out[k] = out[k].at[env_ids, pos].set(seg)
             return out
 
-        def gather(bufs, env_idx, time_idx):
-            # env_idx [B], time_idx [B, T] -> values [T, B, ...] (time-major,
-            # the layout the fused train steps consume)
-            out = {}
-            for k, b in bufs.items():
-                g = b[env_idx[:, None], time_idx]  # [B, T, ...]
-                out[k] = jnp.swapaxes(g, 0, 1)
-            return out
-
         obs_keys = self._obs_keys
-
-        def gather_transitions(bufs, env_idx, time_idx):
-            # flat transition gather: env_idx/time_idx [N] -> values [N, ...]
-            return {k: b[env_idx, time_idx] for k, b in bufs.items()}
 
         def gather_transitions_next(bufs, env_idx, time_idx, next_idx):
             out = {k: b[env_idx, time_idx] for k, b in bufs.items()}
@@ -193,8 +312,10 @@ class DeviceReplayBuffer:
         else:
             self._write = jax.jit(write, donate_argnums=0)
             self._amend = jax.jit(amend, donate_argnums=0)
-        self._gather = jax.jit(gather)
-        self._gather_transitions = jax.jit(gather_transitions)
+        # the gathers are the module-level pure kernels (also callable from
+        # inside a fused superstep's scan body), jitted here for the host paths
+        self._gather = jax.jit(gather_sequences)
+        self._gather_transitions = jax.jit(gather_transition_items)
         self._gather_transitions_next = jax.jit(gather_transitions_next)
 
     # ------------------------------------------------------------------ write
@@ -401,6 +522,48 @@ class DeviceReplayBuffer:
         else:
             flat = self._gather_transitions(self._bufs, ei, ti)
         return {k: v.reshape(n_samples, batch_size, *v.shape[1:]) for k, v in flat.items()}
+
+    def superstep_inputs(
+        self,
+        sequence_length: Optional[int] = None,
+        sample_next_obs: bool = False,
+    ) -> Tuple[Dict[str, jax.Array], jax.Array, jax.Array]:
+        """Operands for an in-graph replay draw: ``(bufs, pos, full)``.
+
+        A fused training superstep closes over :func:`draw_sequence_batch`
+        / :func:`draw_transition_batch` and receives these as its (static
+        for the window) sample context — only the two ``[n_envs]`` cursor
+        arrays cross the host→device link per train window. Validity is
+        checked on the host here, with the same errors as
+        :meth:`draw_indices` / :meth:`sample_transitions`, because the
+        in-graph draw cannot raise. Pass ``sequence_length`` for sequence
+        sampling, leave it ``None`` for transition sampling. The ring must
+        not be written between this call and the dispatched superstep —
+        train windows never interleave with env steps, so the loops satisfy
+        this by construction."""
+        if self._bufs is None:
+            raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
+        for env in range(self._n_envs):
+            if sequence_length is not None:
+                if len(self._valid_starts(env, int(sequence_length))) == 0:
+                    raise ValueError(
+                        f"Cannot sample a sequence of length {sequence_length} from env {env}. "
+                        f"Data added so far: {self._pos[env]}"
+                    )
+            elif len(self._valid_items(env, sample_next_obs)) == 0:
+                raise ValueError(
+                    "You want to sample the next observations, but not enough samples have been "
+                    f"added to env {env}. Make sure that at least two samples are added."
+                    if sample_next_obs
+                    else "No sample has been added to the buffer. Please add at least one sample "
+                    "calling 'self.add()'"
+                )
+        # copies: on CPU device_put may alias the host mirrors zero-copy, and
+        # add() mutates them in place while the superstep is still queued
+        pos, full = jax.device_put(
+            (self._pos.astype(np.int32), self._full.copy()), self._device
+        )
+        return self._bufs, pos, full
 
     def flag_last_truncated(self) -> Optional[np.ndarray]:
         """Set ``truncated=1`` on every env's most recent step (checkpoint

@@ -157,18 +157,28 @@ class DevicePrefetcher:
                 except queue.Full:
                     continue
 
+    def _drain(self) -> None:
+        if self._queue is None:
+            return
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+
     def close(self) -> None:
         """Stop the worker and release queued device batches."""
         if self._stop is not None:
             self._stop.set()
-        if self._queue is not None:
-            while True:
-                try:
-                    self._queue.get_nowait()
-                except queue.Empty:
-                    break
+        # first drain unblocks a worker parked in its bounded q.put (it only
+        # re-checks the stop flag between put timeouts, so it may complete
+        # one more put after the drain); the post-join drain then releases
+        # that last batch deterministically — without it an HBM batch could
+        # sit in the orphaned queue until the GC got around to it
+        self._drain()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+        self._drain()
         self._queue = None
         self._stop = None
         self._thread = None

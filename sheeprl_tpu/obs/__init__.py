@@ -31,6 +31,7 @@ from sheeprl_tpu.obs.telemetry import (
     telemetry_advance,
     telemetry_mark_warm,
     telemetry_register_flops,
+    telemetry_train_window,
 )
 
 __all__ = [
@@ -44,4 +45,5 @@ __all__ = [
     "telemetry_advance",
     "telemetry_mark_warm",
     "telemetry_register_flops",
+    "telemetry_train_window",
 ]

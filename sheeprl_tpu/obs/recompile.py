@@ -27,6 +27,10 @@ from typing import Any, Optional
 
 _LOWER_EVENT = "/jax/core/compile/jaxpr_to_mlir_module_duration"
 _BACKEND_EVENT = "/jax/core/compile/backend_compile_duration"
+# persistent-compilation-cache outcomes (plain events, no duration): one per
+# backend-compile request when ``jax_compilation_cache_dir`` is configured
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
 _PXLA_LOGGER = "jax._src.interpreters.pxla"
 
 
@@ -64,6 +68,8 @@ class CompileWatchdog:
         self._emit = emit
         self.compiles = 0
         self.recompiles = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
         self.warm = False
         self._started = False
         self._handler = _NameCaptureHandler()
@@ -85,6 +91,7 @@ class CompileWatchdog:
             self._saved_propagate = self._logger.propagate
             self._logger.propagate = False
         jax.monitoring.register_event_duration_secs_listener(self._on_event)
+        jax.monitoring.register_event_listener(self._on_plain_event)
         self._started = True
 
     def stop(self) -> None:
@@ -97,6 +104,12 @@ class CompileWatchdog:
             _mon._unregister_event_duration_listener_by_callback(self._on_event)
         except Exception:
             pass
+        try:
+            from jax._src import monitoring as _mon
+
+            _mon._unregister_event_listener_by_callback(self._on_plain_event)
+        except Exception:
+            pass
         self._logger.removeHandler(self._handler)
         if self._saved_level is not None:
             self._logger.setLevel(self._saved_level)
@@ -107,6 +120,23 @@ class CompileWatchdog:
 
     def mark_warm(self) -> None:
         self.warm = True
+
+    def _on_plain_event(self, event: str, **kwargs: Any) -> None:
+        """Persistent-compilation-cache outcome: one ``compile_cache`` event
+        per backend-compile request, so a resumed run can show its retraces
+        were served from ``fabric.compilation_cache_dir``."""
+        if event == _CACHE_HIT_EVENT:
+            self.cache_hits += 1
+            hit = True
+        elif event == _CACHE_MISS_EVENT:
+            self.cache_misses += 1
+            hit = False
+        else:
+            return
+        try:
+            self._emit("compile_cache", name=self._handler.last_name or "<unknown>", hit=hit)
+        except Exception:
+            pass
 
     def _on_event(self, event: str, duration: float, **kwargs: Any) -> None:
         if event == _LOWER_EVENT:

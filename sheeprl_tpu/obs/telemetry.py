@@ -102,6 +102,14 @@ class RunTelemetry:
         self._flops_source: Optional[Callable[[], Optional[float]]] = None
         self._flops_per_train_step: Optional[float] = None
         self._flops_resolved = False
+        # per-train-window dispatch accounting (fused-superstep observability):
+        # "window_*" accumulates since the last heartbeat, "total_*" over the run
+        self._window_train_windows = 0
+        self._window_train_dispatches = 0
+        self._window_train_gradient_steps = 0
+        self._total_train_windows = 0
+        self._total_train_dispatches = 0
+        self._total_train_gradient_steps = 0
 
     # -- core event plumbing -------------------------------------------------
 
@@ -140,6 +148,20 @@ class RunTelemetry:
     def set_flops_source(self, source: Callable[[], Optional[float]]) -> None:
         if not self._flops_resolved:
             self._flops_source = source
+
+    def record_train_window(self, dispatches: int, gradient_steps: int) -> None:
+        """One train window happened: the loop issued ``dispatches`` jitted
+        calls (gathers + EMA refreshes + train/superstep calls) to run
+        ``gradient_steps`` gradient steps.  The per-step path reports
+        O(gradient_steps) dispatches, a fused superstep reports
+        ceil(gradient_steps / K) — the O(K)→O(1) reduction the dispatch
+        counters exist to make visible (``bench.py --dispatch-stats``)."""
+        self._window_train_windows += 1
+        self._window_train_dispatches += int(dispatches)
+        self._window_train_gradient_steps += int(gradient_steps)
+        self._total_train_windows += 1
+        self._total_train_dispatches += int(dispatches)
+        self._total_train_gradient_steps += int(gradient_steps)
 
     def _resolve_flops(self) -> Optional[float]:
         if not self._flops_resolved and self._flops_source is not None:
@@ -230,6 +252,16 @@ class RunTelemetry:
             "compiles_total": self.watchdog.compiles,
         }
         scalars: Dict[str, float] = {"Counters/recompiles": float(self.watchdog.recompiles)}
+        if self._window_train_windows:
+            fields["window_train_windows"] = self._window_train_windows
+            fields["window_train_dispatches"] = self._window_train_dispatches
+            fields["window_train_gradient_steps"] = self._window_train_gradient_steps
+            scalars["Telemetry/train_dispatches_per_window"] = (
+                self._window_train_dispatches / self._window_train_windows
+            )
+            self._window_train_windows = 0
+            self._window_train_dispatches = 0
+            self._window_train_gradient_steps = 0
         if env_t > 0:
             fields["sps_env"] = env_steps / env_t
         if train_t > 0:
@@ -276,6 +308,11 @@ class RunTelemetry:
             recompiles=self.watchdog.recompiles,
             device_polls=self._device_polls,
             hbm_peak_bytes=self._hbm_peak_bytes,
+            train_windows=self._total_train_windows,
+            train_dispatches=self._total_train_dispatches,
+            train_gradient_steps=self._total_train_gradient_steps,
+            compile_cache_hits=self.watchdog.cache_hits,
+            compile_cache_misses=self.watchdog.cache_misses,
         )
         self.watchdog.stop()
         self.writer.close()
@@ -344,10 +381,22 @@ def telemetry_mark_warm() -> None:
         tel.mark_warm()
 
 
-def telemetry_register_flops(jitted_fn: Any, *args: Any) -> None:
+def telemetry_train_window(dispatches: int, gradient_steps: int) -> None:
+    """Record one train window's dispatch count (see
+    :meth:`RunTelemetry.record_train_window`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_train_window(dispatches, gradient_steps)
+
+
+def telemetry_register_flops(jitted_fn: Any, *args: Any, scale: float = 1.0) -> None:
     """Register a lazy ``compiled_flops`` source for MFU: shapes are captured
     eagerly (so no device buffers are pinned), the AOT cost analysis runs at
-    most once, at the first heartbeat that needs it."""
+    most once, at the first heartbeat that needs it.  ``scale`` converts the
+    analyzed program's cost to per-train-step flops — a fused superstep over K
+    gradient steps registers ``scale=1/K`` so the heartbeat's MFU arithmetic
+    (flops × gradient-step invocations / train time) stays consistent across
+    fused and per-step paths."""
     tel = _active_telemetry
     if tel is None:
         return
@@ -361,6 +410,7 @@ def telemetry_register_flops(jitted_fn: Any, *args: Any) -> None:
     def source() -> Optional[float]:
         from sheeprl_tpu.utils.profiler import compiled_flops
 
-        return compiled_flops(jitted_fn, *shapes)
+        flops = compiled_flops(jitted_fn, *shapes)
+        return flops * float(scale) if flops else flops
 
     tel.set_flops_source(source)

@@ -1,1 +1,7 @@
-from sheeprl_tpu.ops import distributions, math  # noqa: F401
+from sheeprl_tpu.ops import distributions, math, superstep  # noqa: F401
+from sheeprl_tpu.ops.superstep import (  # noqa: F401
+    fold_sample_key,
+    make_superstep_fn,
+    periodic_target_ema,
+    pregathered,
+)

@@ -1,0 +1,136 @@
+"""Fused training supersteps: K gradient steps in ONE jitted dispatch.
+
+The off-policy loops (Dreamer-V3, SAC, DroQ) all share the same per-step
+dispatch shape on the host: gather a replay batch, maybe refresh the target
+network, split a key, call the jitted train step — one host round trip per
+gradient step. At small model sizes those dispatch gaps dominate the train
+window. A superstep moves the whole window into XLA: ``lax.scan`` over K
+steps, the replay gather inside the scan body (the ring is static during a
+train window, so reading it in-graph is sound), the EMA target update as a
+``lax.cond`` on a carried step counter, and the per-step metric vectors
+stacked on device so the window costs ONE dispatch and ONE fetch.
+
+Carry discipline mirrors the host loops exactly so a superstep is
+numerically equivalent to K sequential train calls:
+
+- the key evolves as ``key, k = jax.random.split(key)`` per step — the same
+  stream the host loop advances — and the evolved key is returned so the
+  host stays in sync across fused/unfused windows;
+- the target refresh runs BEFORE the step's train body, gated on the carried
+  counter (``counter % freq == 0``), with the first-ever gradient step doing
+  a ``tau=1.0`` hard copy;
+- ``params`` (including the target) are carried but NOT donated — the repo
+  invariant that param buffers stay alive for concurrent readers (async
+  param streaming to the host player) holds inside the fused path too.
+  Only ``aux`` (optimizer/moments state) is donated.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# decorrelates the in-graph replay draw from the train stream: the scan body
+# hands ``gather`` the step's train key, and sampling gathers fold it with
+# this salt so index noise and gradient noise never share a stream
+SAMPLE_KEY_SALT = 0x5EED
+
+
+def fold_sample_key(key: jax.Array) -> jax.Array:
+    """Derive the replay-sampling key of one superstep iteration from its
+    train key (see :data:`SAMPLE_KEY_SALT`)."""
+    return jax.random.fold_in(key, SAMPLE_KEY_SALT)
+
+
+def pregathered(ctx: Any, key: jax.Array, step_index: jax.Array) -> Any:
+    """Host-buffer fallback gather: ``ctx`` is a pytree of ``[K, ...]``
+    arrays pre-gathered on the host (one batch per scan iteration); the scan
+    body slices out batch ``step_index``. Ignores ``key`` — the indices were
+    drawn by the buffer's own host RNG, exactly like the unfused path."""
+    del key
+    return jax.tree.map(lambda x: x[step_index], ctx)
+
+
+def periodic_target_ema(
+    counter: jax.Array,
+    source_params: Any,
+    target_params: Any,
+    freq: int,
+    tau: float,
+) -> Any:
+    """Target-network refresh on the host loop's schedule, in-graph:
+    every ``freq``-th gradient step blends ``tau * source + (1-tau) * target``,
+    and the very first gradient step of the run (``counter == 0``) hard-copies
+    (``tau = 1.0``) — the reference Dreamer-V3 warm start. No-op (identity on
+    ``target_params``) on all other steps via ``lax.cond``."""
+    tau_eff = jnp.where(counter == 0, jnp.float32(1.0), jnp.float32(tau))
+
+    def refresh(operands):
+        src, tgt = operands
+        return jax.tree.map(lambda s, t: tau_eff * s + (1 - tau_eff) * t, src, tgt)
+
+    return lax.cond(
+        (counter % freq) == 0,
+        refresh,
+        lambda operands: operands[1],
+        (source_params, target_params),
+    )
+
+
+def make_superstep_fn(
+    train_body: Callable[[Any, Any, Any, jax.Array], Tuple[Any, Any, jax.Array]],
+    gather: Callable[[Any, jax.Array, jax.Array], Any],
+    num_steps: int,
+    *,
+    pre_step: Optional[Callable[[Any, Any, jax.Array], Tuple[Any, Any]]] = None,
+):
+    """Wrap one un-jitted gradient step into a donated ``jax.jit(lax.scan)``
+    over ``num_steps`` steps.
+
+    - ``train_body(params, aux, batch, key) -> (params, aux, metrics)`` — the
+      raw single-gradient-step body (e.g. Dreamer's ``local_train`` with its
+      arguments regrouped). ``params`` is every pytree that must survive the
+      dispatch un-donated (network + target params); ``aux`` is the
+      donate-safe remainder (optimizer states, moments).
+    - ``gather(sample_ctx, key, step_index) -> batch`` — pure function that
+      produces iteration ``step_index``'s replay batch inside the scan body.
+      Use :func:`pregathered` for host-pre-gathered batches or an on-device
+      draw over ``(bufs, pos, full)`` (see ``data.device_buffer``); sampling
+      gathers must :func:`fold_sample_key` the key they receive.
+    - ``pre_step(params, aux, counter) -> (params, aux)`` — optional hook run
+      before each step's gather/train (the EMA target refresh,
+      :func:`periodic_target_ema`).
+
+    Returns a jitted ``superstep(params, aux, counter, sample_ctx, key) ->
+    (params, aux, key, metrics)`` where ``counter`` is the run's cumulative
+    gradient-step count entering the window (int32 scalar), ``key`` comes
+    back evolved by ``num_steps`` splits, and ``metrics`` is the scan-stacked
+    ``[num_steps, ...]`` per-step metric output, fetched once per window.
+    """
+    if num_steps <= 0:
+        raise ValueError(f"'num_steps' ({num_steps}) must be greater than 0")
+
+    def superstep(params, aux, counter, sample_ctx, key):
+        def body(carry, step_index):
+            params, aux, counter, key = carry
+            if pre_step is not None:
+                params, aux = pre_step(params, aux, counter)
+            key, k_train = jax.random.split(key)
+            batch = gather(sample_ctx, k_train, step_index)
+            params, aux, metrics = train_body(params, aux, batch, k_train)
+            return (params, aux, counter + 1, key), metrics
+
+        (params, aux, _, key), metrics = lax.scan(
+            body,
+            (params, aux, jnp.asarray(counter, jnp.int32), key),
+            jnp.arange(num_steps, dtype=jnp.int32),
+        )
+        return params, aux, key, metrics
+
+    # donate only aux: params stay un-donated (concurrent readers — the async
+    # param stream to the host player — may be in flight), and sample_ctx
+    # holds the replay ring, which the env loop keeps writing after the window
+    return jax.jit(superstep, donate_argnums=(1,))

@@ -102,6 +102,7 @@ class Fabric:
         distributed_coordinator: Optional[str] = None,
         num_processes: Optional[int] = None,
         process_id: Optional[int] = None,
+        compilation_cache_dir: Optional[str] = None,
     ) -> None:
         self._maybe_init_distributed(distributed_coordinator, num_processes, process_id)
         if accelerator not in ("auto", "tpu", "cpu", "gpu"):
@@ -112,6 +113,7 @@ class Fabric:
                 jax.config.update("jax_platforms", "cpu")
             except RuntimeError:
                 pass  # backend already initialized; devices below reflect it
+        self.compilation_cache_dir = self._configure_compilation_cache(compilation_cache_dir)
         self.accelerator = accelerator
         self.num_nodes = num_nodes
         self.callbacks = list(callbacks or [])
@@ -138,6 +140,29 @@ class Fabric:
             raise ValueError(f"mesh shape {shape} does not cover {n} devices")
         self.mesh = Mesh(np.asarray(self.devices).reshape(shape), axes)
         self.data_axis = axes[0]
+
+    @staticmethod
+    def _configure_compilation_cache(cache_dir: Optional[str]) -> Optional[str]:
+        """Point JAX's persistent compilation cache at
+        ``fabric.compilation_cache_dir`` (default off) so restarts and
+        resumes skip the multi-minute retrace of the train programs. The
+        min-compile-time/min-entry-size gates are zeroed so even the small
+        kernels (buffer writes, gathers) persist — the cache-outcome
+        telemetry (``compile_cache`` events) counts every request."""
+        if not cache_dir:
+            return None
+        path = os.path.abspath(os.path.expanduser(str(cache_dir)))
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        for flag, value in (
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            ("jax_persistent_cache_min_entry_size_bytes", 0),
+        ):
+            try:
+                jax.config.update(flag, value)
+            except Exception:
+                pass  # knob not present in this jax version
+        return path
 
     @staticmethod
     def _maybe_init_distributed(

@@ -99,3 +99,23 @@ def test_prefetcher_custom_place():
     out = list(pf)
     assert len(out) == 3 and len(placed) == 3
     assert all(np.array_equal(b["x"], np.ones((2,))) for b in out)
+
+
+def test_prefetch_close_leaves_no_orphaned_batch():
+    """close() drain-then-join race: a worker parked in its bounded q.put
+    only re-checks the stop flag between put timeouts, so it can complete
+    ONE more put after close()'s first drain. The post-join drain must
+    release that batch — nothing may linger in the orphaned queue."""
+    import time
+
+    pf = DevicePrefetcher(
+        lambda: {"x": np.ones((1,), np.float32)}, n_batches=100, depth=1
+    )
+    it = iter(pf)
+    next(it)  # queue refills to depth; the worker parks in its bounded put
+    time.sleep(0.3)
+    q, thread = pf._queue, pf._thread
+    pf.close()
+    assert not thread.is_alive()
+    assert q.empty(), "close() left a device batch in the orphaned queue"
+    it.close()

@@ -1,0 +1,157 @@
+"""Fused training supersteps (ops/superstep.py): a superstep over K steps is
+numerically equivalent — params, optimizer state, target-EMA schedule, key
+stream — to K sequential train calls driven by the host loop (the ISSUE's
+acceptance criterion, CPU fp32)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from sheeprl_tpu.ops.superstep import (
+    fold_sample_key,
+    make_superstep_fn,
+    periodic_target_ema,
+    pregathered,
+)
+
+EMA_FREQ = 2
+EMA_TAU = 0.25
+
+
+def _init_state(seed=0):
+    """A tiny regression 'agent': params + target params (EMA'd), adam opt
+    state as the donated aux — the same carry split the algo loops use."""
+    k = jax.random.PRNGKey(seed)
+    kw, kt = jax.random.split(k)
+    model = {"w": jax.random.normal(kw, (4, 3)), "b": jnp.zeros((3,))}
+    target = {"w": jax.random.normal(kt, (4, 3)), "b": jnp.ones((3,))}
+    tx = optax.adam(1e-2)
+    return (model, target), (tx.init(model),), tx
+
+
+def _train_body(tx):
+    def body(params, aux, batch, key):
+        model, target = params
+        (opt_state,) = aux
+
+        def loss_fn(p):
+            pred = batch["x"] @ p["w"] + p["b"]
+            # the key enters the loss like dropout/exploration noise would,
+            # so a key-schedule mismatch shows up as a numeric mismatch
+            noise = 0.01 * jax.random.normal(key, pred.shape)
+            return jnp.mean((pred + noise - batch["y"]) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(model)
+        updates, opt_state = tx.update(grads, opt_state, model)
+        model = optax.apply_updates(model, updates)
+        return (model, target), (opt_state,), loss
+
+    return body
+
+
+def _pre_step(params, aux, counter):
+    model, target = params
+    target = periodic_target_ema(counter, model, target, EMA_FREQ, EMA_TAU)
+    return (model, target), aux
+
+
+def _batches(n, seed=7):
+    k = jax.random.PRNGKey(seed)
+    kx, ky = jax.random.split(k)
+    return {
+        "x": jax.random.normal(kx, (n, 8, 4)),
+        "y": jax.random.normal(ky, (n, 8, 3)),
+    }
+
+
+def _host_loop(params, aux, counter0, batches, key, tx, n_steps):
+    """The per-step host path the superstep must reproduce: EMA before the
+    step on the cumulative-counter schedule (hard copy at step 0), one key
+    split per step, one jitted train call per step."""
+    train_fn = jax.jit(_train_body(tx))
+    model, target = params
+    for i in range(n_steps):
+        counter = counter0 + i
+        if counter % EMA_FREQ == 0:
+            tau = 1.0 if counter == 0 else EMA_TAU
+            target = jax.tree.map(lambda m, t: tau * m + (1 - tau) * t, model, target)
+        key, k_train = jax.random.split(key)
+        batch = {k: v[i] for k, v in batches.items()}
+        (model, target), aux, loss = train_fn((model, target), aux, batch, k_train)
+    return (model, target), aux, key
+
+
+def _assert_trees_close(a, b, **kw):
+    jax.tree.map(lambda x, y: np.testing.assert_allclose(x, y, **kw), a, b)
+
+
+def test_superstep_matches_sequential_train_calls():
+    n_steps = 5
+    params, aux, tx = _init_state()
+    batches = _batches(n_steps)
+    key = jax.random.PRNGKey(42)
+
+    ref_params, ref_aux, ref_key = _host_loop(params, aux, 0, batches, key, tx, n_steps)
+
+    superstep = make_superstep_fn(_train_body(tx), pregathered, n_steps, pre_step=_pre_step)
+    fused_params, fused_aux, fused_key, metrics = superstep(
+        params, aux, jnp.int32(0), batches, key
+    )
+
+    _assert_trees_close(fused_params, ref_params, rtol=1e-6, atol=1e-6)
+    _assert_trees_close(fused_aux, ref_aux, rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(fused_key), np.asarray(ref_key))
+    assert metrics.shape == (n_steps,)  # per-step losses, stacked on device
+
+
+def test_superstep_chunking_carries_the_counter_and_key():
+    """Two fused chunks (4 + 3) with the counter threaded between them equal
+    one 7-step host loop — the window-chunking the loops do for K < G."""
+    params, aux, tx = _init_state(seed=3)
+    batches = _batches(7, seed=11)
+    key = jax.random.PRNGKey(5)
+
+    ref_params, ref_aux, ref_key = _host_loop(params, aux, 0, batches, key, tx, 7)
+
+    body = _train_body(tx)
+    first = make_superstep_fn(body, pregathered, 4, pre_step=_pre_step)
+    second = make_superstep_fn(body, pregathered, 3, pre_step=_pre_step)
+    b1 = {k: v[:4] for k, v in batches.items()}
+    b2 = {k: v[4:] for k, v in batches.items()}
+    params, aux, key, _ = first(params, aux, jnp.int32(0), b1, key)
+    params, aux, key, _ = second(params, aux, jnp.int32(4), b2, key)
+
+    _assert_trees_close(params, ref_params, rtol=1e-6, atol=1e-6)
+    _assert_trees_close(aux, ref_aux, rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(key), np.asarray(ref_key))
+
+
+def test_periodic_target_ema_schedule():
+    source = {"w": jnp.full((2,), 4.0)}
+    target = {"w": jnp.full((2,), 8.0)}
+    # step 0: hard copy regardless of tau
+    out = periodic_target_ema(jnp.int32(0), source, target, 2, 0.25)
+    np.testing.assert_array_equal(np.asarray(out["w"]), 4.0)
+    # off-cadence step: unchanged
+    out = periodic_target_ema(jnp.int32(1), source, target, 2, 0.25)
+    np.testing.assert_array_equal(np.asarray(out["w"]), 8.0)
+    # on-cadence step > 0: tau blend
+    out = periodic_target_ema(jnp.int32(2), source, target, 2, 0.25)
+    np.testing.assert_allclose(np.asarray(out["w"]), 0.25 * 4.0 + 0.75 * 8.0)
+
+
+def test_fold_sample_key_is_deterministic_and_distinct():
+    key = jax.random.PRNGKey(0)
+    folded = fold_sample_key(key)
+    assert not np.array_equal(np.asarray(folded), np.asarray(key))
+    np.testing.assert_array_equal(np.asarray(folded), np.asarray(fold_sample_key(key)))
+    # and distinct from the split outputs the train body consumes
+    for part in jax.random.split(key):
+        assert not np.array_equal(np.asarray(folded), np.asarray(part))
+
+
+def test_make_superstep_fn_rejects_nonpositive_length():
+    with pytest.raises(ValueError, match="num_steps"):
+        make_superstep_fn(lambda p, a, b, k: (p, a, jnp.zeros(())), pregathered, 0)

@@ -128,3 +128,23 @@ def test_grad_pmean_matches_single_device():
         return jax.lax.pmean(jax.grad(loss)(w, x), "data")
 
     np.testing.assert_allclose(jax.jit(dp_grad)(w, x), full_grad, rtol=1e-5)
+
+
+def test_fabric_compilation_cache_dir(tmp_path):
+    """fabric.compilation_cache_dir points JAX's persistent compile cache at
+    the given directory, creating it; the default (None) leaves the global
+    config untouched."""
+    import os
+
+    saved = jax.config.jax_compilation_cache_dir
+    try:
+        cache = str(tmp_path / "xla-cache")
+        f = Fabric(devices=1, compilation_cache_dir=cache)
+        assert f.compilation_cache_dir == cache
+        assert os.path.isdir(cache)
+        assert jax.config.jax_compilation_cache_dir == cache
+        # None is a no-op: the previously configured dir stays in force
+        assert Fabric(devices=1).compilation_cache_dir is None
+        assert jax.config.jax_compilation_cache_dir == cache
+    finally:
+        jax.config.update("jax_compilation_cache_dir", saved)

@@ -269,3 +269,38 @@ def test_cache_checkpoint_roundtrip(tmp_path, monkeypatch):
     again = bench._load_cache()
     assert again["dv3"]["value"] == {"steps": 1, "seconds": 2.0}
     assert again["dv3"]["provenance"] == "unit-test"
+
+
+def test_dispatch_stats_prefers_run_end_totals(tmp_path):
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    # run_end totals include the trailing window the heartbeats never flushed
+    events = [
+        {"event": "run_start"},
+        {"event": "heartbeat", "window_train_windows": 2, "window_train_dispatches": 2,
+         "window_train_gradient_steps": 5},
+        {"event": "run_end", "train_windows": 3, "train_dispatches": 3,
+         "train_gradient_steps": 9},
+    ]
+    ds = bench.dispatch_stats(events)
+    assert ds["train_windows"] == 3
+    assert ds["dispatches_per_window"] == 1.0
+    assert ds["gradient_steps_per_dispatch"] == 3.0
+
+    # still-running stream (no run_end): fall back to summing heartbeats
+    ds = bench.dispatch_stats(events[:-1])
+    assert ds["train_windows"] == 2
+    assert ds["train_dispatches"] == 2
+
+    # and from a file path, the way --dispatch-stats consumes it
+    path = tmp_path / "telemetry.jsonl"
+    path.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+    ds = bench.dispatch_stats(str(path))
+    assert ds["dispatches_per_window"] == 1.0
+
+    # no train windows at all -> no ratios, no division by zero
+    assert "dispatches_per_window" not in bench.dispatch_stats([{"event": "run_start"}])

@@ -176,3 +176,67 @@ def test_run_lifecycle_events(telemetry):
     assert events[-1]["event"] == "run_end"
     assert "compiles_total" in events[-1] and "device_polls" in events[-1]
     assert get_telemetry() is None
+
+
+def test_watchdog_counts_compile_cache_events(telemetry):
+    """Persistent-compilation-cache outcomes arrive as plain jax.monitoring
+    events; the watchdog counts them and mirrors each as a compile_cache
+    telemetry event (fabric.compilation_cache_dir observability)."""
+    pre_hits, pre_misses = telemetry.watchdog.cache_hits, telemetry.watchdog.cache_misses
+    jax.monitoring.record_event("/jax/compilation_cache/cache_hits")
+    jax.monitoring.record_event("/jax/compilation_cache/cache_misses")
+    jax.monitoring.record_event("/jax/compilation_cache/cache_misses")
+    jax.monitoring.record_event("/jax/unrelated_event")  # ignored
+    assert telemetry.watchdog.cache_hits == pre_hits + 1
+    assert telemetry.watchdog.cache_misses == pre_misses + 2
+    cache_events = [e for e in _events(telemetry) if e["event"] == "compile_cache"]
+    assert [e["hit"] for e in cache_events[-3:]] == [True, False, False]
+
+
+def test_watchdog_stop_unregisters_cache_listener():
+    from sheeprl_tpu.obs.recompile import CompileWatchdog
+
+    wd = CompileWatchdog(lambda name, **kw: None)
+    wd.start()
+    try:
+        jax.monitoring.record_event("/jax/compilation_cache/cache_hits")
+        assert wd.cache_hits == 1
+    finally:
+        wd.stop()
+    jax.monitoring.record_event("/jax/compilation_cache/cache_hits")
+    assert wd.cache_hits == 1, "stop() left the plain-event listener registered"
+
+
+def test_train_window_counters_roll_into_heartbeat(telemetry):
+    from sheeprl_tpu.obs import telemetry_train_window
+
+    telemetry_train_window(1, 4)
+    telemetry_train_window(2, 6)
+    logger = _FakeLogger()
+    telemetry.heartbeat(
+        logger,
+        step=10,
+        env_steps=4,
+        train_steps=10,
+        train_invocations=2,
+        timer_window={"Time/train_time": 1.0},
+    )
+    hb = [e for e in _events(telemetry) if e["event"] == "heartbeat"][-1]
+    assert hb["window_train_windows"] == 2
+    assert hb["window_train_dispatches"] == 3
+    assert hb["window_train_gradient_steps"] == 10
+    scalars, _ = logger.logged[-1]
+    assert scalars["Telemetry/train_dispatches_per_window"] == pytest.approx(1.5)
+    # the window counters reset; the run totals land in run_end (see the
+    # distributed run_end assertions and bench.dispatch_stats)
+    logger2 = _FakeLogger()
+    telemetry.heartbeat(
+        logger2,
+        step=11,
+        env_steps=4,
+        train_steps=0,
+        train_invocations=0,
+        timer_window={},
+    )
+    hb2 = [e for e in _events(telemetry) if e["event"] == "heartbeat"][-1]
+    assert "window_train_windows" not in hb2
