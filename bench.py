@@ -317,6 +317,88 @@ def dispatch_stats(events_or_path) -> dict:
     return out
 
 
+def _percentile(sorted_values: list, q: float) -> float:
+    """Linear-interpolation percentile over an already-sorted list (matches
+    numpy's default method without importing numpy into the bench parent)."""
+    n = len(sorted_values)
+    if n == 1:
+        return float(sorted_values[0])
+    pos = (q / 100.0) * (n - 1)
+    lo = int(pos)
+    hi = min(lo + 1, n - 1)
+    frac = pos - lo
+    return float(sorted_values[lo] * (1.0 - frac) + sorted_values[hi] * frac)
+
+
+def env_stats_summary(events_or_path) -> dict:
+    """Rollout-pool health from a run's telemetry stream (env.backend=pool,
+    sheeprl_tpu/rollout): env step/reset latency percentiles from the
+    ``rollout/env_step``/``rollout/env_reset`` spans (with the queue-wait
+    share — dispatch + pipe wait beyond the slowest worker's busy time),
+    every ``worker_restart`` event (worker, reason, restart count) and the
+    ``masked_slot`` events for workers that exhausted their retry budget.
+    Totals prefer run_end (they cover the trailing unflushed window), falling
+    back to the event stream for a still-running run."""
+    events = (
+        read_telemetry(events_or_path) if isinstance(events_or_path, str) else list(events_or_path)
+    )
+    out: dict = {}
+
+    for span_name, key in (("rollout/env_step", "env_step"), ("rollout/env_reset", "env_reset")):
+        durs, waits = [], []
+        for e in events:
+            if e.get("event") == "span" and e.get("name") == span_name:
+                durs.append(float(e.get("dur", 0.0)))
+                wait = (e.get("attrs") or {}).get("queue_wait_s")
+                if wait is not None:
+                    waits.append(float(wait))
+        if not durs:
+            continue
+        durs.sort()
+        stats = {
+            "count": len(durs),
+            "total_s": round(sum(durs), 3),
+            "p50_ms": round(_percentile(durs, 50) * 1e3, 3),
+            "p95_ms": round(_percentile(durs, 95) * 1e3, 3),
+            "max_ms": round(durs[-1] * 1e3, 3),
+        }
+        if waits:
+            waits.sort()
+            stats["queue_wait_p50_ms"] = round(_percentile(waits, 50) * 1e3, 3)
+            stats["queue_wait_p95_ms"] = round(_percentile(waits, 95) * 1e3, 3)
+        out[key] = stats
+
+    restarts = [e for e in events if e.get("event") == "worker_restart"]
+    if restarts:
+        out["worker_restarts"] = [
+            {
+                "worker": e.get("worker"),
+                "reason": e.get("reason"),
+                "restarts": e.get("restarts"),
+                "step": e.get("step"),
+            }
+            for e in restarts
+        ]
+    masked = [e for e in events if e.get("event") == "masked_slot"]
+    if masked:
+        out["masked_slots"] = [
+            {"worker": e.get("worker"), "slots": e.get("slots"), "reason": e.get("reason")}
+            for e in masked
+        ]
+
+    totals = {"worker_restarts": len(restarts)}
+    totals["masked_slots"] = sum(
+        len(e.get("slots") or []) if isinstance(e.get("slots"), (list, tuple)) else 1 for e in masked
+    )
+    for e in events:
+        if e.get("event") == "run_end":
+            totals["worker_restarts"] = int(e.get("worker_restarts", 0) or 0)
+            totals["masked_slots"] = int(e.get("masked_slots", 0) or 0)
+            break
+    out["totals"] = totals
+    return out
+
+
 def _ppo_args(total_steps: int):
     return [
         "exp=ppo",
@@ -638,8 +720,16 @@ if __name__ == "__main__":
         help="report per-train-window device dispatch counts from a run's "
         "telemetry.jsonl (fused supersteps should show ceil(G/K) per window) and exit",
     )
+    parser.add_argument(
+        "--env-stats",
+        metavar="PATH",
+        help="report rollout-pool health from a run's telemetry.jsonl "
+        "(env step latency percentiles, worker restarts, masked slots) and exit",
+    )
     args = parser.parse_args()
-    if args.dispatch_stats:
+    if args.env_stats:
+        print(json.dumps(env_stats_summary(args.env_stats), indent=1))
+    elif args.dispatch_stats:
         print(json.dumps(dispatch_stats(args.dispatch_stats)))
     elif args.telemetry:
         print(json.dumps(telemetry_summary(args.telemetry)))

@@ -28,7 +28,7 @@ from sheeprl_tpu.algos.a2c.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import PPOPlayer, evaluate_actions
 from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.parallel.fabric import put_tree, resolve_player_device, resolve_train_device
-from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.envs import build_vector_env
 from sheeprl_tpu.obs import (
     log_sps_and_heartbeat,
     telemetry_advance,
@@ -100,21 +100,7 @@ def main(fabric, cfg: Dict[str, Any]):
     world_size = fabric.data_parallel_size  # batch-split width: the data axis (= device count on a 1-D mesh)
     num_processes = fabric.num_processes
 
-    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
-    envs = vectorized_env(
-        [
-            make_env(
-                cfg,
-                cfg.seed + rank * num_envs + i,
-                rank * num_envs,
-                log_dir if rank == 0 else None,
-                "train",
-                vector_env_idx=i,
-            )
-            for i in range(num_envs)
-        ],
-        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
-    )
+    envs = build_vector_env(cfg, rank, log_dir if rank == 0 else None, "train")
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")

@@ -23,7 +23,6 @@ The redesign (SURVEY.md §7 hard parts, all addressed here):
 from __future__ import annotations
 
 import os
-from functools import partial
 from typing import Any, Dict, Sequence
 
 import gymnasium as gym
@@ -58,8 +57,7 @@ from sheeprl_tpu.ops.superstep import (
     periodic_target_ema,
     pregathered,
 )
-from sheeprl_tpu.envs import make_env
-from sheeprl_tpu.envs.wrappers import RestartOnException
+from sheeprl_tpu.envs import build_vector_env
 from sheeprl_tpu.ops.distributions import (
     Bernoulli,
     Independent,
@@ -437,24 +435,7 @@ def main(fabric, cfg: Dict[str, Any]):
     world_size = fabric.data_parallel_size
     num_processes = fabric.num_processes  # hosts: sets the env-step accounting
 
-    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
-    envs = vectorized_env(
-        [
-            partial(
-                RestartOnException,
-                make_env(
-                    cfg,
-                    cfg.seed + rank * num_envs + i,
-                    rank * num_envs,
-                    log_dir if rank == 0 else None,
-                    "train",
-                    vector_env_idx=i,
-                ),
-            )
-            for i in range(num_envs)
-        ],
-        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
-    )
+    envs = build_vector_env(cfg, rank, log_dir if rank == 0 else None, "train", restart_on_exception=True)
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):

@@ -19,7 +19,6 @@ ONE jitted train step fuses all five optimizations of the reference's train():
 from __future__ import annotations
 
 import os
-from functools import partial
 from typing import Any, Dict, Sequence
 
 import gymnasium as gym
@@ -46,8 +45,7 @@ from sheeprl_tpu.data.device_buffer import (
     make_sequential_replay,
 )
 from sheeprl_tpu.data.prefetch import sampled_batches
-from sheeprl_tpu.envs import make_env
-from sheeprl_tpu.envs.wrappers import RestartOnException
+from sheeprl_tpu.envs import build_vector_env
 from sheeprl_tpu.ops.distributions import (
     Bernoulli,
     Independent,
@@ -492,24 +490,7 @@ def main(fabric, cfg: Dict[str, Any]):
     world_size = fabric.data_parallel_size  # batch-split width: the data axis (= device count on a 1-D mesh)
     num_processes = fabric.num_processes
 
-    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
-    envs = vectorized_env(
-        [
-            partial(
-                RestartOnException,
-                make_env(
-                    cfg,
-                    cfg.seed + rank * num_envs + i,
-                    rank * num_envs,
-                    log_dir if rank == 0 else None,
-                    "train",
-                    vector_env_idx=i,
-                ),
-            )
-            for i in range(num_envs)
-        ],
-        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
-    )
+    envs = build_vector_env(cfg, rank, log_dir if rank == 0 else None, "train", restart_on_exception=True)
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):

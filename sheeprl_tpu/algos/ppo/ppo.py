@@ -38,7 +38,7 @@ from sheeprl_tpu.algos.ppo.agent import PPOPlayer, build_agent, evaluate_actions
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
-from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.envs import build_vector_env
 from sheeprl_tpu.obs import log_sps_and_heartbeat, telemetry_advance, telemetry_register_flops
 from sheeprl_tpu.ops.math import gae
 from sheeprl_tpu.parallel.fabric import put_tree, resolve_player_device, resolve_train_device
@@ -153,22 +153,8 @@ def main(fabric, cfg: Dict[str, Any]):
 
     # environment setup (reference ppo.py:137-163); SAME_STEP autoreset keeps
     # the 0.29 semantics the algorithms were specified against
-    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
     rank = fabric.process_index
-    envs = vectorized_env(
-        [
-            make_env(
-                cfg,
-                cfg.seed + rank * cfg.env.num_envs + i,
-                rank * cfg.env.num_envs,
-                log_dir if rank == 0 else None,
-                "train",
-                vector_env_idx=i,
-            )
-            for i in range(cfg.env.num_envs)
-        ],
-        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
-    )
+    envs = build_vector_env(cfg, rank, log_dir if rank == 0 else None, "train")
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")

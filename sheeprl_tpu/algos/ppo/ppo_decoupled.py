@@ -40,7 +40,7 @@ from sheeprl_tpu.algos.ppo.agent import PPOPlayer, build_agent
 from sheeprl_tpu.algos.ppo.ppo import make_train_fn
 from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
-from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.envs import build_vector_env
 from sheeprl_tpu.ops.math import gae
 from sheeprl_tpu.parallel.collectives import broadcast_object
 from sheeprl_tpu.parallel.submesh import LocalFabric, SubMeshFabric, probe_spaces
@@ -128,14 +128,7 @@ def _player(fabric, cfg, state=None):
         last_checkpoint=state["last_checkpoint"] if state else 0,
     )
 
-    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
-    envs = vectorized_env(
-        [
-            make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i)
-            for i in range(num_envs)
-        ],
-        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
-    )
+    envs = build_vector_env(cfg, 0, log_dir, "train")
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")

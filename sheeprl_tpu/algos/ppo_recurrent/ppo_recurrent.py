@@ -43,7 +43,7 @@ from sheeprl_tpu.algos.ppo_recurrent.agent import (
 )
 from sheeprl_tpu.algos.ppo_recurrent.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
-from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.envs import build_vector_env
 from sheeprl_tpu.ops.math import gae
 from sheeprl_tpu.parallel.shard_map import shard_map
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -214,23 +214,9 @@ def main(fabric, cfg: Dict[str, Any]):
     initial_clip_coef = float(cfg.algo.clip_coef)
     initial_ent_coef = float(cfg.algo.ent_coef)
 
-    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
     rank = fabric.process_index
     num_envs = int(cfg.env.num_envs)
-    envs = vectorized_env(
-        [
-            make_env(
-                cfg,
-                cfg.seed + rank * num_envs + i,
-                rank * num_envs,
-                log_dir if rank == 0 else None,
-                "train",
-                vector_env_idx=i,
-            )
-            for i in range(num_envs)
-        ],
-        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
-    )
+    envs = build_vector_env(cfg, rank, log_dir if rank == 0 else None, "train")
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")

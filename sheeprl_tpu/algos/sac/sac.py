@@ -39,7 +39,7 @@ from sheeprl_tpu.algos.sac.agent import (
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.data.device_buffer import draw_transition_batch
-from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.envs import build_vector_env
 from sheeprl_tpu.obs import log_sps_and_heartbeat, telemetry_advance, telemetry_train_window
 from sheeprl_tpu.ops.superstep import fold_sample_key
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -213,21 +213,7 @@ def main(fabric, cfg: Dict[str, Any]):
     logger.log_hyperparams(cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg))
     print(f"Log dir: {log_dir}")
 
-    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
-    envs = vectorized_env(
-        [
-            make_env(
-                cfg,
-                cfg.seed + rank * num_envs + i,
-                rank * num_envs,
-                log_dir if rank == 0 else None,
-                "train",
-                vector_env_idx=i,
-            )
-            for i in range(num_envs)
-        ],
-        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
-    )
+    envs = build_vector_env(cfg, rank, log_dir if rank == 0 else None, "train")
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
     if not isinstance(action_space, gym.spaces.Box):

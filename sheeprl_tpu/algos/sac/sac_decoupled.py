@@ -33,7 +33,7 @@ from sheeprl_tpu.algos.sac.agent import SACPlayer, build_agent
 from sheeprl_tpu.algos.sac.sac import make_train_fn
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.data import ReplayBuffer
-from sheeprl_tpu.envs import make_env
+from sheeprl_tpu.envs import build_vector_env
 from sheeprl_tpu.parallel.collectives import broadcast_object
 from sheeprl_tpu.parallel.submesh import LocalFabric, SubMeshFabric, probe_spaces
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -88,14 +88,7 @@ def _player(fabric, cfg, state=None):
     )
     per_rank_batch_size = int(cfg.algo.per_rank_batch_size)
 
-    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
-    envs = vectorized_env(
-        [
-            make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i)
-            for i in range(num_envs)
-        ],
-        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
-    )
+    envs = build_vector_env(cfg, 0, log_dir, "train")
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
     if not isinstance(action_space, gym.spaces.Box):

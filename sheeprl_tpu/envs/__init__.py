@@ -5,7 +5,7 @@ observations are **NHWC uint8** (``[H, W, C]``) — the TPU-native layout this
 framework uses everywhere — where the reference is NCHW (utils/env.py:193).
 """
 
-from sheeprl_tpu.envs.factory import get_dummy_env, make_env
+from sheeprl_tpu.envs.factory import build_vector_env, get_dummy_env, make_env, resolve_env_backend
 from sheeprl_tpu.envs.wrappers import (
     ActionRepeat,
     FrameStack,
@@ -18,6 +18,8 @@ from sheeprl_tpu.envs.wrappers import (
 __all__ = [
     "ActionRepeat",
     "FrameStack",
+    "build_vector_env",
+    "resolve_env_backend",
     "GrayscaleRenderWrapper",
     "MaskVelocityWrapper",
     "RestartOnException",

@@ -1,16 +1,30 @@
-"""``make_env`` factory (reference: sheeprl/utils/env.py:25-227).
+"""``make_env`` / ``build_vector_env`` factories (reference: sheeprl/utils/env.py:25-227).
 
-Builds a thunk that instantiates the configured wrapper (``env.wrapper`` is a
-``_target_`` node) and applies the standard pipeline: action repeat →
-velocity masking → dict-ification → image resize/grayscale (NHWC uint8) →
-frame stacking → reward-as-observation → time limit → episode statistics →
-optional video capture. Pure host-side code; written for gymnasium >= 1.0.
+``make_env`` builds a thunk that instantiates the configured wrapper
+(``env.wrapper`` is a ``_target_`` node) and applies the standard pipeline:
+action repeat → velocity masking → dict-ification → image resize/grayscale
+(NHWC uint8) → frame stacking → reward-as-observation → time limit → episode
+statistics → optional video capture. Pure host-side code; written for
+gymnasium >= 1.0.
+
+``build_vector_env`` is the single vector-env construction point for every
+algorithm main: it owns the per-slot seed/rank arithmetic and selects the
+vectorization backend behind ``env.backend``:
+
+- ``sync``  — ``gym.vector.SyncVectorEnv`` (in-process, deterministic),
+- ``async`` — ``gym.vector.AsyncVectorEnv`` (one subprocess per env),
+- ``pool``  — :class:`sheeprl_tpu.rollout.EnvPool` (supervised shared-memory
+  worker pool with auto-restart, slot masking and step-latency telemetry),
+
+with ``env.sync_env`` kept as a deprecated alias (``backend`` null/absent →
+``sync`` when ``sync_env`` is true, else ``async`` — the historical default).
 """
 
 from __future__ import annotations
 
 import os
 import warnings
+from functools import partial
 from typing import Any, Callable, Dict, Optional
 
 import gymnasium as gym
@@ -26,10 +40,76 @@ from sheeprl_tpu.envs.wrappers import (
     ImageTransform,
     MaskVelocityWrapper,
     RenderObservation,
+    RestartOnException,
     RewardAsObservationWrapper,
 )
 
-__all__ = ["make_env", "get_dummy_env"]
+__all__ = ["build_vector_env", "make_env", "get_dummy_env", "resolve_env_backend"]
+
+_BACKENDS = ("sync", "async", "pool")
+
+
+def resolve_env_backend(cfg: Dict[str, Any]) -> str:
+    """``env.backend`` if set, else the ``env.sync_env`` deprecated alias."""
+    backend = cfg.env.get("backend", None)
+    if backend in (None, "", "null"):
+        return "sync" if bool(cfg.env.get("sync_env", False)) else "async"
+    backend = str(backend).lower()
+    if backend not in _BACKENDS:
+        raise ValueError(f"env.backend must be one of {_BACKENDS}, got {backend!r}")
+    return backend
+
+
+def build_vector_env(
+    cfg: Dict[str, Any],
+    rank: int,
+    run_name: Optional[str] = None,
+    prefix: str = "train",
+    *,
+    restart_on_exception: bool = False,
+) -> Any:  # gym.vector.VectorEnv or rollout.EnvPool (same surface)
+    """Build the training vector env for one process.
+
+    Replaces the ``SyncVectorEnv if cfg.env.sync_env else AsyncVectorEnv``
+    block every algorithm main used to hand-roll. Env ``i`` of process
+    ``rank`` gets seed ``cfg.seed + rank * num_envs + i`` and global slot
+    index ``i`` — identical to the historical per-algo arithmetic, so
+    trajectories are unchanged for any backend choice. ``SAME_STEP``
+    autoreset everywhere (the 0.29 semantics the algorithms were specified
+    against).
+
+    ``restart_on_exception`` additionally wraps each env in
+    :class:`RestartOnException` (in-process recreate on env exceptions — the
+    dreamer-family default); the pool composes with it, adding the *process*
+    failure domain on top.
+    """
+    num_envs = int(cfg.env.num_envs)
+    rank = int(rank)
+    thunks = []
+    for i in range(num_envs):
+        thunk: Callable[[], gym.Env] = make_env(
+            cfg,
+            int(cfg.seed) + rank * num_envs + i,
+            rank * num_envs,
+            run_name,
+            prefix,
+            vector_env_idx=i,
+        )
+        if restart_on_exception:
+            thunk = partial(RestartOnException, thunk)
+        thunks.append(thunk)
+
+    backend = resolve_env_backend(cfg)
+    if backend == "pool":
+        from sheeprl_tpu.rollout import EnvPool, pool_config_from_cfg
+
+        return EnvPool(
+            thunks,
+            config=pool_config_from_cfg(cfg),
+            seed_base=int(cfg.seed) + rank * num_envs,
+        )
+    vector_cls = gym.vector.SyncVectorEnv if backend == "sync" else gym.vector.AsyncVectorEnv
+    return vector_cls(thunks, autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
 
 
 def make_env(

@@ -29,9 +29,12 @@ from sheeprl_tpu.obs.telemetry import (
     get_telemetry,
     shutdown_telemetry,
     telemetry_advance,
+    telemetry_env_step,
     telemetry_mark_warm,
+    telemetry_masked_slot,
     telemetry_register_flops,
     telemetry_train_window,
+    telemetry_worker_restart,
 )
 
 __all__ = [
@@ -43,7 +46,10 @@ __all__ = [
     "shutdown_telemetry",
     "span",
     "telemetry_advance",
+    "telemetry_env_step",
     "telemetry_mark_warm",
+    "telemetry_masked_slot",
     "telemetry_register_flops",
     "telemetry_train_window",
+    "telemetry_worker_restart",
 ]

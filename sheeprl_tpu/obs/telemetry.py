@@ -10,7 +10,8 @@ Event schema (one JSON object per line, documented in howto/telemetry.md):
 every event carries ``event`` (kind), ``t`` (unix seconds), ``step``
 (policy step at emission), ``process_index`` and optionally ``name``; the
 kinds are ``run_start``, ``span``, ``compile``, ``device_poll``,
-``heartbeat``, ``bench_probe`` and ``run_end``.
+``heartbeat``, ``bench_probe``, ``worker_restart``, ``masked_slot`` and
+``run_end``.
 
 The module-level accessor :func:`get_telemetry` returns ``None`` unless a run
 configured telemetry — callers on hot paths pay one global read when the
@@ -29,6 +30,9 @@ from sheeprl_tpu.obs.recompile import CompileWatchdog
 
 _FLUSH_EVERY_EVENTS = 64
 _FLUSH_EVERY_SECONDS = 5.0
+# bound on per-heartbeat-window env-step latency samples: at sane log
+# intervals the window never fills; a runaway loop degrades to "first N"
+_ENV_STEP_RESERVOIR = 8192
 
 _active_telemetry: Optional["RunTelemetry"] = None
 
@@ -110,6 +114,13 @@ class RunTelemetry:
         self._total_train_windows = 0
         self._total_train_dispatches = 0
         self._total_train_gradient_steps = 0
+        # rollout-pool accounting (sheeprl_tpu.rollout): per-window env-step
+        # latency/queue-wait reservoirs + run totals for restarts/masked slots
+        self._env_step_durs: list = []
+        self._env_queue_waits: list = []
+        self._window_worker_restarts = 0
+        self._total_worker_restarts = 0
+        self._total_masked_slots = 0
 
     # -- core event plumbing -------------------------------------------------
 
@@ -162,6 +173,32 @@ class RunTelemetry:
         self._total_train_windows += 1
         self._total_train_dispatches += int(dispatches)
         self._total_train_gradient_steps += int(gradient_steps)
+
+    def record_env_step(self, dur_s: float, queue_wait_s: Optional[float] = None) -> None:
+        """One pooled env step happened: ``dur_s`` wall seconds end to end,
+        of which ``queue_wait_s`` were spent NOT stepping envs (dispatch +
+        pipe wait beyond the slowest worker's busy time). Feeds the
+        heartbeat's env_step_p50/p95 and queue_wait_p50/p95 fields."""
+        if len(self._env_step_durs) < _ENV_STEP_RESERVOIR:
+            self._env_step_durs.append(float(dur_s))
+            if queue_wait_s is not None:
+                self._env_queue_waits.append(float(queue_wait_s))
+
+    def record_worker_restart(self, worker: int, reason: str, restarts: int, **fields: Any) -> None:
+        """An env worker was restarted (crash or step timeout): one
+        ``worker_restart`` event + heartbeat/run_end counters."""
+        self._window_worker_restarts += 1
+        self._total_worker_restarts += 1
+        self.emit("worker_restart", worker=worker, reason=reason, restarts=restarts, **fields)
+        self.writer.flush()
+
+    def record_masked_slot(self, worker: int, slots: Any, reason: str, **fields: Any) -> None:
+        """An env worker exhausted its restart budget and its slots were
+        masked dead: one ``masked_slot`` event + run_end counter."""
+        nslots = len(slots) if isinstance(slots, (list, tuple)) else 1
+        self._total_masked_slots += nslots
+        self.emit("masked_slot", worker=worker, slots=slots, reason=reason, **fields)
+        self.writer.flush()
 
     def _resolve_flops(self) -> Optional[float]:
         if not self._flops_resolved and self._flops_source is not None:
@@ -262,6 +299,29 @@ class RunTelemetry:
             self._window_train_windows = 0
             self._window_train_dispatches = 0
             self._window_train_gradient_steps = 0
+        if self._env_step_durs:
+            import numpy as _np
+
+            durs = _np.asarray(self._env_step_durs)
+            fields["env_step_p50_ms"] = float(_np.percentile(durs, 50)) * 1e3
+            fields["env_step_p95_ms"] = float(_np.percentile(durs, 95)) * 1e3
+            fields["env_step_samples"] = int(durs.size)
+            scalars["Telemetry/env_step_p95_ms"] = fields["env_step_p95_ms"]
+            if self._env_queue_waits:
+                waits = _np.asarray(self._env_queue_waits)
+                fields["env_queue_wait_p50_ms"] = float(_np.percentile(waits, 50)) * 1e3
+                fields["env_queue_wait_p95_ms"] = float(_np.percentile(waits, 95)) * 1e3
+            self._env_step_durs = []
+            self._env_queue_waits = []
+        if self._window_worker_restarts:
+            fields["window_worker_restarts"] = self._window_worker_restarts
+            self._window_worker_restarts = 0
+        if self._total_worker_restarts:
+            fields["worker_restarts_total"] = self._total_worker_restarts
+            scalars["Counters/worker_restarts"] = float(self._total_worker_restarts)
+        if self._total_masked_slots:
+            fields["masked_slots_total"] = self._total_masked_slots
+            scalars["Counters/masked_slots"] = float(self._total_masked_slots)
         if env_t > 0:
             fields["sps_env"] = env_steps / env_t
         if train_t > 0:
@@ -313,6 +373,8 @@ class RunTelemetry:
             train_gradient_steps=self._total_train_gradient_steps,
             compile_cache_hits=self.watchdog.cache_hits,
             compile_cache_misses=self.watchdog.cache_misses,
+            worker_restarts=self._total_worker_restarts,
+            masked_slots=self._total_masked_slots,
         )
         self.watchdog.stop()
         self.writer.close()
@@ -387,6 +449,30 @@ def telemetry_train_window(dispatches: int, gradient_steps: int) -> None:
     tel = _active_telemetry
     if tel is not None:
         tel.record_train_window(dispatches, gradient_steps)
+
+
+def telemetry_env_step(dur_s: float, queue_wait_s: Optional[float] = None) -> None:
+    """Record one pooled env step's latency (see
+    :meth:`RunTelemetry.record_env_step`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_env_step(dur_s, queue_wait_s)
+
+
+def telemetry_worker_restart(worker: int, reason: str, restarts: int, **fields: Any) -> None:
+    """Record an env-worker restart (see
+    :meth:`RunTelemetry.record_worker_restart`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_worker_restart(worker, reason, restarts, **fields)
+
+
+def telemetry_masked_slot(worker: int, slots: Any, reason: str, **fields: Any) -> None:
+    """Record env slots masked dead (see
+    :meth:`RunTelemetry.record_masked_slot`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_masked_slot(worker, slots, reason, **fields)
 
 
 def telemetry_register_flops(jitted_fn: Any, *args: Any, scale: float = 1.0) -> None:

@@ -1,0 +1,79 @@
+"""Pool sizing / supervision knobs, parsed once from the composed config.
+
+Everything lives under the top-level ``rollout`` node (``configs/config.yaml``)
+so CLI overrides read ``rollout.step_timeout_s=5``; the *backend selection*
+itself is ``env.backend`` (``sync | async | pool``) because it is a property of
+the env plane, not of the pool.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, List, Mapping, Optional
+
+from sheeprl_tpu.rollout.fault_injection import FaultSpec, parse_fault_config
+
+
+@dataclass
+class PoolConfig:
+    """Supervision and sizing parameters for :class:`~sheeprl_tpu.rollout.pool.EnvPool`.
+
+    ``num_workers=None`` means one worker per env capped at the host's CPU
+    count — EnvPool-style batched stepping only pays off once envs outnumber
+    cores, so by default every env gets its own failure domain.
+    """
+
+    num_workers: Optional[int] = None
+    step_timeout_s: float = 60.0
+    spawn_timeout_s: float = 120.0
+    heartbeat_grace_s: Optional[float] = None  # default: step_timeout_s
+    max_restarts: int = 3
+    backoff_base_s: float = 0.5
+    backoff_max_s: float = 10.0
+    copy_obs: bool = True
+    start_method: str = "spawn"
+    faults: List[FaultSpec] = field(default_factory=list)
+
+    def resolve_num_workers(self, num_envs: int) -> int:
+        if self.num_workers is not None:
+            n = int(self.num_workers)
+            if n < 1:
+                raise ValueError(f"rollout.num_workers must be >= 1, got {n}")
+            return min(n, num_envs)
+        return max(1, min(num_envs, os.cpu_count() or 1))
+
+    @property
+    def heartbeat_grace(self) -> float:
+        return self.step_timeout_s if self.heartbeat_grace_s is None else float(self.heartbeat_grace_s)
+
+
+def pool_config_from_cfg(cfg: Mapping[str, Any]) -> PoolConfig:
+    """Build a :class:`PoolConfig` from the composed run config's ``rollout``
+    node (absent node → all defaults, faults disabled)."""
+    node = _get(cfg, "rollout") or {}
+    fault_node = _get(node, "fault_injection") or {}
+    faults: List[FaultSpec] = []
+    if bool(_get(fault_node, "enabled", False)):
+        faults = parse_fault_config(_get(fault_node, "faults") or [])
+    num_workers = _get(node, "num_workers", None)
+    return PoolConfig(
+        num_workers=int(num_workers) if num_workers is not None else None,
+        step_timeout_s=float(_get(node, "step_timeout_s", 60.0)),
+        spawn_timeout_s=float(_get(node, "spawn_timeout_s", 120.0)),
+        heartbeat_grace_s=_get(node, "heartbeat_grace_s", None),
+        max_restarts=int(_get(node, "max_restarts", 3)),
+        backoff_base_s=float(_get(node, "backoff_base_s", 0.5)),
+        backoff_max_s=float(_get(node, "backoff_max_s", 10.0)),
+        copy_obs=bool(_get(node, "copy_obs", True)),
+        start_method=str(_get(node, "start_method", "spawn")),
+        faults=faults,
+    )
+
+
+def _get(node: Any, key: str, default: Any = None) -> Any:
+    if node is None:
+        return default
+    if hasattr(node, "get"):
+        return node.get(key, default)
+    return getattr(node, key, default)

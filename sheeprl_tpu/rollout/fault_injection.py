@@ -1,0 +1,108 @@
+"""Deterministic fault injection for the env-worker pool.
+
+Faults are *scheduled by the parent* and *executed by the worker*: the pool
+keeps a monotone step counter (number of completed ``step()`` calls) and
+attaches any fault whose ``at_step`` matches the current counter to the step
+command it sends that worker. Parent-side scheduling is what makes the
+harness deterministic across worker restarts — a crashed worker cannot lose
+the record of which faults already fired, because it never owned it.
+
+Config shape (``rollout.fault_injection`` in the composed config)::
+
+    rollout:
+      fault_injection:
+        enabled: true
+        faults:
+          - {kind: crash, worker: 0, at_step: 50}
+          - {kind: hang,  worker: 1, at_step: 120}
+          - {kind: slow,  worker: 0, at_step: 200, duration_s: 0.5}
+
+``kind``:
+- ``crash`` — the worker ``os._exit(13)``s before stepping its batch; the
+  supervisor sees the dead process and restarts it.
+- ``hang`` — the worker sleeps ``duration_s`` (default: effectively forever)
+  before stepping; the supervisor's step timeout fires and the worker is
+  killed + restarted.
+- ``slow`` — the worker sleeps ``duration_s`` (default 1s) and then steps
+  normally; shows up as a step-latency spike in telemetry, no restart.
+
+``at_step`` is 0-based: the fault fires during the ``at_step``-th call to
+``EnvPool.step()`` after the last ``reset()`` did NOT reset it — the counter
+is monotone over the pool's lifetime. Each fault fires exactly once.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Mapping, Sequence
+
+_KINDS = ("crash", "hang", "slow")
+
+
+@dataclass
+class FaultSpec:
+    kind: str
+    worker: int
+    at_step: int
+    duration_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        self.kind = str(self.kind).lower()
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; expected one of {_KINDS}")
+        self.worker = int(self.worker)
+        self.at_step = int(self.at_step)
+        self.duration_s = float(self.duration_s)
+        if self.worker < 0:
+            raise ValueError(f"fault worker index must be >= 0, got {self.worker}")
+        if self.at_step < 0:
+            raise ValueError(f"fault at_step must be >= 0, got {self.at_step}")
+
+    def to_wire(self) -> Dict[str, Any]:
+        """Plain-dict form sent over the worker pipe (std-picklable)."""
+        return {"kind": self.kind, "duration_s": self.duration_s}
+
+
+def parse_fault_config(node: Sequence[Mapping[str, Any]]) -> List[FaultSpec]:
+    faults = []
+    for i, entry in enumerate(node):
+        if not hasattr(entry, "get"):
+            raise ValueError(f"rollout.fault_injection.faults[{i}] must be a mapping, got {entry!r}")
+        if "kind" not in entry or "worker" not in entry or "at_step" not in entry:
+            raise ValueError(
+                f"rollout.fault_injection.faults[{i}] needs kind/worker/at_step, got {dict(entry)!r}"
+            )
+        faults.append(
+            FaultSpec(
+                kind=entry["kind"],
+                worker=entry["worker"],
+                at_step=entry["at_step"],
+                duration_s=float(entry.get("duration_s", 0.0) or 0.0),
+            )
+        )
+    return faults
+
+
+class FaultSchedule:
+    """Tracks which faults already fired; queried once per pool step."""
+
+    def __init__(self, faults: Sequence[FaultSpec]) -> None:
+        self._pending: List[FaultSpec] = sorted(faults, key=lambda f: f.at_step)
+
+    def __bool__(self) -> bool:
+        return bool(self._pending)
+
+    def pop_due(self, step: int) -> Dict[int, List[FaultSpec]]:
+        """Return {worker_index: [faults]} due at pool step ``step`` and mark
+        them fired. Faults scheduled for a step the pool already passed (e.g.
+        ``at_step`` during a window where the worker was being restarted) fire
+        on the next step so nothing is silently dropped."""
+        due: Dict[int, List[FaultSpec]] = {}
+        remaining: List[FaultSpec] = []
+        for f in self._pending:
+            if f.at_step <= step:
+                due.setdefault(f.worker, []).append(f)
+            else:
+                remaining.append(f)
+        self._pending = remaining
+        return due

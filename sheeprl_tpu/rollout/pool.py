@@ -1,0 +1,330 @@
+"""``EnvPool`` — supervised shared-memory vector env, ``gym.vector`` drop-in.
+
+The surface the algorithm mains use is identical to gymnasium's vector envs
+under ``AutoresetMode.SAME_STEP``: ``reset(seed=...)``, ``step(actions)``,
+``single_observation_space`` / ``single_action_space``, batched
+``observation_space`` / ``action_space``, ``close()`` — including the
+``final_obs`` / ``final_info`` info batching contract (``_add_info`` with
+``_key`` masks). With faults disabled and the same seeds, trajectories are
+bit-identical to ``SyncVectorEnv`` (asserted by
+``tests/test_rollout/test_pool_parity.py``).
+
+What is different is underneath: env slots are partitioned over worker
+processes, observations travel through preallocated shared memory instead of
+pipes, and a :class:`~sheeprl_tpu.rollout.supervisor.Supervisor` keeps the
+run alive through worker crashes and hangs:
+
+- a failed worker is restarted with exponential backoff; its recreated envs
+  are reset (deterministically reseeded) and the in-flight step completes
+  with ``truncated=True`` for its slots, the reset observation standing in
+  for ``final_obs`` so truncation bootstraps stay well-formed;
+- a worker that exhausts ``rollout.max_restarts`` is *masked*: its slots
+  report one final ``terminated=True`` and then zeros/False forever — the
+  run degrades instead of deadlocking, and the ``masked_slot`` telemetry
+  counter makes the degradation visible.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import gymnasium as gym
+import numpy as np
+from gymnasium.vector.utils import batch_space, iterate
+
+from sheeprl_tpu.rollout.config import PoolConfig
+from sheeprl_tpu.rollout.fault_injection import FaultSchedule
+from sheeprl_tpu.rollout.shm import ShmObsBuffers
+from sheeprl_tpu.rollout.supervisor import Supervisor, WorkerDied, WorkerHandle, WorkerTimeout
+
+
+class _InfoBatcher:
+    """Reuses gymnasium's ``VectorEnv._add_info`` (``_key`` masks, recursive
+    dicts, object-array ``final_obs``) without inheriting the whole class."""
+
+    _add_info = gym.vector.VectorEnv._add_info
+
+    def __init__(self, num_envs: int) -> None:
+        self.num_envs = num_envs
+
+
+class EnvPool:
+    """Process-pool vector env over shared-memory observation buffers."""
+
+    metadata: Dict[str, Any] = {"autoreset_mode": gym.vector.AutoresetMode.SAME_STEP}
+    render_mode = None
+
+    def __init__(
+        self,
+        env_fns: Sequence[Any],
+        *,
+        config: Optional[PoolConfig] = None,
+        seed_base: int = 0,
+    ) -> None:
+        import cloudpickle
+
+        if len(env_fns) == 0:
+            raise ValueError("EnvPool needs at least one env_fn")
+        self.config = config or PoolConfig()
+        self.num_envs = len(env_fns)
+        self._seed_base = int(seed_base)
+        self.closed = False
+
+        num_workers = self.config.resolve_num_workers(self.num_envs)
+        slot_parts = np.array_split(np.arange(self.num_envs), num_workers)
+        self._handles: List[WorkerHandle] = [
+            WorkerHandle(w, [int(s) for s in part], cloudpickle.dumps([env_fns[s] for s in part]))
+            for w, part in enumerate(slot_parts)
+        ]
+        self._slot_to_worker = {s: h.index for h in self._handles for s in h.slots}
+        self._sup = Supervisor(
+            self.config,
+            num_workers,
+            on_restart=self._on_restart,
+            on_mask=self._on_mask,
+        )
+
+        # boot all workers concurrently: launch every process first, then run
+        # the ready handshakes (imports dominate startup; they overlap)
+        for handle in self._handles:
+            self._sup.launch(handle)
+        spaces = [self._sup.handshake(handle) for handle in self._handles]
+        self.single_observation_space, self.single_action_space = spaces[0]
+        for w, (obs_sp, act_sp) in enumerate(spaces[1:], start=1):
+            if obs_sp != self.single_observation_space or act_sp != self.single_action_space:
+                raise RuntimeError(
+                    f"env worker {w} reports different spaces than worker 0 — all pool envs "
+                    "must share one observation/action space"
+                )
+        self.observation_space = batch_space(self.single_observation_space, self.num_envs)
+        self.action_space = batch_space(self.single_action_space, self.num_envs)
+
+        self._shm = ShmObsBuffers(self.single_observation_space, self.num_envs)
+        for handle in self._handles:
+            self._sup.attach(handle, self._shm.specs)
+
+        self._faults = FaultSchedule(self.config.faults)
+        self._step_count = 0
+        self._last_seeds: List[Optional[int]] = [None] * self.num_envs
+        self._masked = np.zeros(self.num_envs, dtype=np.bool_)
+        self._rewards = np.zeros(self.num_envs, dtype=np.float64)
+        self._terminations = np.zeros(self.num_envs, dtype=np.bool_)
+        self._truncations = np.zeros(self.num_envs, dtype=np.bool_)
+        self.restart_counts = [0] * num_workers
+        self.masked_slots: List[int] = []
+
+    # ------------------------------------------------------------ properties
+    @property
+    def num_workers(self) -> int:
+        return len(self._handles)
+
+    @property
+    def video_slots(self) -> List[int]:
+        """Global slot indices owning a ``RecordVideo`` recorder (reported by
+        the workers at handshake; exactly ``[0]`` when ``env.capture_video``
+        is on for rank 0, regardless of slot→worker placement)."""
+        return sorted(s for h in self._handles for s in h.video_slots)
+
+    # -------------------------------------------------------------- gym API
+    def reset(
+        self,
+        *,
+        seed: Union[int, Sequence[Optional[int]], None] = None,
+        options: Optional[dict] = None,
+    ):
+        self._assert_open()
+        if seed is None:
+            seeds: List[Optional[int]] = [None] * self.num_envs
+        elif isinstance(seed, int):
+            seeds = [seed + i for i in range(self.num_envs)]
+        else:
+            seeds = list(seed)
+            if len(seeds) != self.num_envs:
+                raise ValueError(f"expected {self.num_envs} seeds, got {len(seeds)}")
+        self._last_seeds = seeds
+
+        t0 = time.perf_counter()
+        batcher = _InfoBatcher(self.num_envs)
+        infos: Dict[str, Any] = {}
+        busy = 0.0
+        for handle in self._alive_handles():
+            self._send(handle, ("reset", [seeds[s] for s in handle.slots], options))
+        slot_infos: Dict[int, dict] = {}
+        for handle in list(self._alive_handles()):
+            reply = self._collect(handle, phase="reset")
+            if reply is None:  # worker masked during this reset
+                continue
+            _, pairs, busy_s = reply
+            busy = max(busy, busy_s)
+            for slot, info in pairs:
+                slot_infos[slot] = info
+        for slot in range(self.num_envs):
+            if slot in slot_infos:
+                infos = batcher._add_info(infos, slot_infos[slot], slot)
+        self._terminations[:] = False
+        self._truncations[:] = False
+        self._emit_span("rollout/env_reset", t0, busy)
+        return self._shm.read(self.config.copy_obs), infos
+
+    def step(self, actions):
+        self._assert_open()
+        per_slot_actions = list(iterate(self.action_space, actions))
+        due_faults = self._faults.pop_due(self._step_count)
+        self._step_count += 1
+
+        t0 = time.perf_counter()
+        self._rewards[:] = 0.0
+        self._terminations[:] = False
+        self._truncations[:] = False
+        busy = 0.0
+        restarted: Dict[int, dict] = {}  # slot -> final_info for truncated in-flight episodes
+        masked_now: List[int] = []
+
+        for handle in self._alive_handles():
+            wire_faults = [f.to_wire() for f in due_faults.get(handle.index, [])]
+            self._send(handle, ("step", [per_slot_actions[s] for s in handle.slots], wire_faults))
+
+        results: Dict[int, tuple] = {}
+        for handle in list(self._handles):
+            if handle.masked or handle.conn is None:
+                continue
+            reply = self._collect(handle, phase="step")
+            if reply is None:
+                if handle.masked:
+                    masked_now.extend(handle.slots)
+                else:  # restarted: in-flight episodes truncated, envs reset
+                    for slot in handle.slots:
+                        restarted[slot] = {"worker_restart": True}
+                continue
+            _, worker_results, busy_s = reply
+            busy = max(busy, busy_s)
+            for slot, result in zip(handle.slots, worker_results):
+                results[slot] = result
+
+        batcher = _InfoBatcher(self.num_envs)
+        infos: Dict[str, Any] = {}
+        for slot in range(self.num_envs):
+            if slot in results:
+                reward, terminated, truncated, env_info, final = results[slot]
+                self._rewards[slot] = reward
+                self._terminations[slot] = terminated
+                self._truncations[slot] = truncated
+                if final is not None:
+                    final_obs, final_info = final
+                    infos = batcher._add_info(infos, {"final_obs": final_obs, "final_info": final_info}, slot)
+                infos = batcher._add_info(infos, env_info, slot)
+            elif slot in restarted:
+                # the worker died mid-episode: its envs were recreated and
+                # reset during the restart (the reset obs is already in shm);
+                # report the lost episode as truncated, with the reset obs
+                # standing in for final_obs so value bootstraps stay defined
+                self._truncations[slot] = True
+                final_obs = {k: v[slot].copy() for k, v in self._shm.views.items()}
+                infos = batcher._add_info(
+                    infos, {"final_obs": final_obs, "final_info": restarted[slot]}, slot
+                )
+            elif slot in masked_now:
+                # last signal from a slot being masked: close the episode
+                self._shm.zero_slot(slot)
+                self._terminations[slot] = True
+            # already-masked slots: zeros / all-False, nothing to do
+
+        dur = time.perf_counter() - t0
+        self._emit_span("rollout/env_step", t0, busy, dur=dur)
+        return (
+            self._shm.read(self.config.copy_obs),
+            np.copy(self._rewards),
+            np.copy(self._terminations),
+            np.copy(self._truncations),
+            infos,
+        )
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        for handle in self._handles:
+            try:
+                self._sup.shutdown(handle)
+            except Exception:
+                pass
+        self._shm.close()
+
+    def __del__(self) -> None:  # pragma: no cover - interpreter-shutdown path
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ internals
+    def _assert_open(self) -> None:
+        if self.closed:
+            raise RuntimeError("EnvPool is closed")
+
+    def _alive_handles(self):
+        return (h for h in self._handles if not h.masked)
+
+    def _send(self, handle: WorkerHandle, msg: tuple) -> None:
+        try:
+            handle.conn.send(msg)
+        except (BrokenPipeError, OSError):
+            pass  # surfaces as WorkerDied in _collect
+
+    def _collect(self, handle: WorkerHandle, phase: str):
+        """Wait for ``handle``'s reply, running the restart/mask policy on
+        failure. Returns the reply, or ``None`` if the worker was restarted
+        (its slots truncated, envs reset) or masked during this call."""
+        while True:
+            try:
+                return self._sup.wait_reply(handle)
+            except (WorkerDied, WorkerTimeout) as err:
+                reason = "timeout" if isinstance(err, WorkerTimeout) else "crash"
+                if handle.restarts >= self.config.max_restarts:
+                    self._sup.mask(handle, reason)
+                    return None
+                if phase == "reset":
+                    # replay the in-flight reset verbatim: same seeds, so a
+                    # crash during reset is invisible to determinism
+                    reset_seeds = [self._last_seeds[s] for s in handle.slots]
+                else:
+                    reset_seeds = [self._restart_seed(s, handle.restarts + 1) for s in handle.slots]
+                try:
+                    self._sup.restart(handle, f"{reason} during {phase}", reset_seeds)
+                    return None
+                except (WorkerDied, WorkerTimeout):
+                    continue  # replacement failed too: loop against the budget
+
+    def _restart_seed(self, slot: int, generation: int) -> int:
+        base = self._last_seeds[slot]
+        if base is None:
+            base = self._seed_base + slot
+        return int(base) + 7919 * generation
+
+    # ------------------------------------------------------------- telemetry
+    def _on_restart(self, worker: int, reason: str, restarts: int) -> None:
+        self.restart_counts[worker] = restarts
+        from sheeprl_tpu.obs import telemetry_worker_restart
+
+        telemetry_worker_restart(worker=worker, reason=reason, restarts=restarts)
+
+    def _on_mask(self, worker: int, slots: Sequence[int], reason: str) -> None:
+        for slot in slots:
+            if slot not in self.masked_slots:
+                self.masked_slots.append(slot)
+            self._masked[slot] = True
+        from sheeprl_tpu.obs import telemetry_masked_slot
+
+        telemetry_masked_slot(worker=worker, slots=list(slots), reason=reason)
+
+    def _emit_span(self, name: str, t0: float, busy_s: float, dur: Optional[float] = None) -> None:
+        from sheeprl_tpu.obs import get_telemetry
+
+        tel = get_telemetry()
+        if tel is None:
+            return
+        dur = time.perf_counter() - t0 if dur is None else dur
+        queue_wait = max(0.0, dur - busy_s)
+        tel.emit_span(name, time.time() - dur, dur, {"busy_s": busy_s, "queue_wait_s": queue_wait})
+        if name == "rollout/env_step":
+            tel.record_env_step(dur, queue_wait)

@@ -1,0 +1,139 @@
+"""Shared-memory observation buffers for the env-worker pool.
+
+One ``SharedMemory`` block per observation key, laid out ``[num_envs, *obs
+shape]``. Workers write their slots in place after every step/reset; the
+parent holds full-pool numpy views — reading a step's observations is zero
+syscalls and zero copies (``EnvPool`` copies on return only when
+``rollout.copy_obs=True``, the gymnasium-compatible default).
+
+The parent owns the blocks (creates and unlinks); workers attach by name and
+only ``close()``. Attaching suppresses ``multiprocessing.resource_tracker``
+registration — on CPython < 3.13 every attach is (wrongly) registered for
+cleanup, so a dying worker would otherwise unlink a segment the parent still
+serves (and spawn children share the parent's tracker process, so a worker
+*unregistering* after the fact would clobber the parent's own registration).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from multiprocessing import shared_memory
+from typing import Dict, List, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+
+@dataclass
+class ShmSpec:
+    """Wire-format description of one shared block (std-picklable)."""
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: str  # np.dtype string, e.g. "uint8"
+
+
+def obs_layout(single_observation_space: gym.spaces.Dict, num_envs: int) -> Dict[str, Tuple[Tuple[int, ...], np.dtype]]:
+    """Per-key ``(shape, dtype)`` for the pooled buffers. The pool only
+    supports ``Dict``-of-``Box`` observation spaces — which is what
+    ``make_env`` guarantees (dict-ification is part of its pipeline)."""
+    if not isinstance(single_observation_space, gym.spaces.Dict):
+        raise TypeError(
+            f"EnvPool requires a Dict observation space (make_env guarantees one), "
+            f"got {type(single_observation_space).__name__}"
+        )
+    layout = {}
+    for key, space in single_observation_space.spaces.items():
+        if not isinstance(space, gym.spaces.Box):
+            raise TypeError(
+                f"EnvPool shared-memory buffers require Box subspaces; key {key!r} is "
+                f"{type(space).__name__} — use env.backend=sync/async for this env"
+            )
+        layout[key] = ((num_envs, *space.shape), np.dtype(space.dtype))
+    return layout
+
+
+class ShmObsBuffers:
+    """Parent-side owner of the per-key shared blocks + full-pool views."""
+
+    def __init__(self, single_observation_space: gym.spaces.Dict, num_envs: int) -> None:
+        self.num_envs = int(num_envs)
+        self._blocks: Dict[str, shared_memory.SharedMemory] = {}
+        self.views: Dict[str, np.ndarray] = {}
+        self.specs: Dict[str, ShmSpec] = {}
+        for key, (shape, dtype) in obs_layout(single_observation_space, num_envs).items():
+            nbytes = max(1, int(np.prod(shape)) * dtype.itemsize)
+            block = shared_memory.SharedMemory(create=True, size=nbytes)
+            self._blocks[key] = block
+            self.views[key] = np.ndarray(shape, dtype=dtype, buffer=block.buf)
+            self.views[key][...] = 0
+            self.specs[key] = ShmSpec(name=block.name, shape=tuple(shape), dtype=dtype.str)
+
+    def read(self, copy: bool) -> Dict[str, np.ndarray]:
+        if copy:
+            return {k: v.copy() for k, v in self.views.items()}
+        return dict(self.views)
+
+    def zero_slot(self, slot: int) -> None:
+        for v in self.views.values():
+            v[slot] = 0
+
+    def close(self) -> None:
+        # drop the numpy views before closing the mmaps: an exported buffer
+        # keeps memoryview references alive and SharedMemory.close() raises
+        self.views = {}
+        for block in self._blocks.values():
+            try:
+                block.close()
+                block.unlink()
+            except FileNotFoundError:
+                pass
+        self._blocks = {}
+
+
+class ShmSlotViews:
+    """Worker-side attachment: numpy views restricted to this worker's slots."""
+
+    def __init__(self, specs: Dict[str, ShmSpec]) -> None:
+        self._blocks: List[shared_memory.SharedMemory] = []
+        self._full: Dict[str, np.ndarray] = {}
+        for key, spec in specs.items():
+            block = _attach_untracked(spec.name)
+            self._blocks.append(block)
+            self._full[key] = np.ndarray(spec.shape, dtype=np.dtype(spec.dtype), buffer=block.buf)
+
+    def write(self, slot: int, obs: Dict[str, np.ndarray]) -> None:
+        for key, view in self._full.items():
+            view[slot] = obs[key]
+
+    def close(self) -> None:
+        self._full = {}
+        for block in self._blocks:
+            try:
+                block.close()
+            except Exception:
+                pass
+        self._blocks = []
+
+
+def _attach_untracked(name: str) -> shared_memory.SharedMemory:
+    """Attach to an existing segment without registering it for cleanup.
+
+    CPython < 3.13 registers *every* ``SharedMemory`` instance with the
+    resource tracker, attach included (bpo-39959; fixed by ``track=False`` in
+    3.13). Briefly no-op ``resource_tracker.register`` instead of
+    unregistering afterwards: spawn children share the parent's tracker, so an
+    unregister from a worker would erase the parent's own registration and
+    turn the parent's later ``unlink()`` into a tracker KeyError.
+    """
+    try:  # pragma: no cover - tracker layout is a CPython internal
+        from multiprocessing import resource_tracker
+
+        original = resource_tracker.register
+        resource_tracker.register = lambda *a, **k: None  # type: ignore[assignment]
+        try:
+            return shared_memory.SharedMemory(name=name)
+        finally:
+            resource_tracker.register = original  # type: ignore[assignment]
+    except Exception:
+        return shared_memory.SharedMemory(name=name)

@@ -1,0 +1,228 @@
+"""Worker lifecycle: spawn, handshake, supervised waits, restart, masking.
+
+The supervisor is the parent-side half of the pool's fault tolerance:
+
+- **crash detection** — a dead process or broken pipe while waiting for a
+  reply raises :class:`WorkerDied`;
+- **hang detection** — replies carry a deadline that *extends while the
+  worker heartbeats* (workers beat after every env step inside a batch, so a
+  worker legitimately stepping 8 slow envs is distinguished from one wedged
+  inside a single ``env.step``); a stale heartbeat past
+  ``rollout.step_timeout_s`` raises :class:`WorkerTimeout`;
+- **restart** — kill, exponential backoff (``backoff_base_s * 2**(n-1)``
+  capped at ``backoff_max_s``), respawn, re-attach shm, reset the recreated
+  envs. Restarts are budgeted by ``rollout.max_restarts`` *per worker*;
+- **masking** — a worker over budget is torn down for good and its slots are
+  reported to the pool, which serves zeros for them instead of hanging the
+  run.
+
+Every ``Process.start()`` happens under :func:`_spawn_environ`, which applies
+:func:`~sheeprl_tpu.rollout.worker.sanitize_worker_environ` to the *parent's*
+environ for the duration of the fork/spawn — the child snapshots its environ
+at start, and its very first imports (this package → possibly jax) happen
+before ``worker_main`` can sanitize anything itself.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from sheeprl_tpu.rollout.shm import ShmSpec
+from sheeprl_tpu.rollout.worker import sanitize_worker_environ, worker_main
+
+
+class WorkerDied(RuntimeError):
+    def __init__(self, worker: int, detail: str = "") -> None:
+        super().__init__(f"env worker {worker} died{': ' + detail if detail else ''}")
+        self.worker = worker
+        self.detail = detail
+
+
+class WorkerTimeout(RuntimeError):
+    def __init__(self, worker: int, waited_s: float) -> None:
+        super().__init__(f"env worker {worker} exceeded the step timeout ({waited_s:.1f}s without progress)")
+        self.worker = worker
+        self.waited_s = waited_s
+
+
+class WorkerHandle:
+    """One worker process and its bookkeeping."""
+
+    def __init__(self, index: int, slots: Sequence[int], thunk_blob: bytes) -> None:
+        self.index = index
+        self.slots = list(slots)
+        self.thunk_blob = thunk_blob
+        self.proc = None
+        self.conn = None
+        self.restarts = 0
+        self.masked = False
+        self.video_slots: List[int] = []
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.is_alive()
+
+
+@contextlib.contextmanager
+def _spawn_environ():
+    """Sanitized-environ window around ``Process.start()`` (see module doc)."""
+    from sheeprl_tpu.rollout.worker import _COORDINATOR_VARS
+
+    touched = ("JAX_PLATFORMS", "SHEEPRL_TPU_ENV_WORKER", *_COORDINATOR_VARS)
+    saved: Dict[str, Optional[str]] = {key: os.environ.get(key) for key in touched}
+    try:
+        sanitize_worker_environ()
+        yield
+    finally:
+        for key, value in saved.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+
+class Supervisor:
+    def __init__(self, config, num_workers: int, on_restart=None, on_mask=None) -> None:
+        import multiprocessing as mp
+
+        self.config = config
+        self._ctx = mp.get_context(config.start_method)
+        # lock-free doubles: one heartbeat timestamp per worker, written by
+        # the worker after every env step and read by the waiting parent
+        self.heartbeats = self._ctx.Array("d", num_workers, lock=False)
+        self.on_restart = on_restart  # callback(worker, reason, restarts)
+        self.on_mask = on_mask  # callback(worker, slots, reason)
+        self._shm_specs: Optional[Dict[str, ShmSpec]] = None
+
+    # ------------------------------------------------------------- lifecycle
+    def launch(self, handle: WorkerHandle) -> None:
+        """Start ``handle``'s process (no handshake — boots overlap when the
+        pool launches every worker before waiting on any of them)."""
+        parent_conn, child_conn = self._ctx.Pipe(duplex=True)
+        proc = self._ctx.Process(
+            target=worker_main,
+            args=(child_conn, self.heartbeats, handle.index, handle.slots, handle.thunk_blob),
+            name=f"envpool-worker-{handle.index}",
+            daemon=True,
+        )
+        with _spawn_environ():
+            proc.start()
+        child_conn.close()
+        handle.proc = proc
+        handle.conn = parent_conn
+        self.heartbeats[handle.index] = time.time()
+
+    def handshake(self, handle: WorkerHandle) -> Tuple[Any, Any]:
+        """Wait for the ready message; returns ``(observation_space,
+        action_space)`` as reported by the worker's env 0."""
+        reply = self.wait_reply(handle, timeout=self.config.spawn_timeout_s)
+        if reply[0] != "ready":
+            raise WorkerDied(handle.index, f"bad handshake: {reply[0]!r}")
+        _, obs_space, act_space, video_slots = reply
+        handle.video_slots = list(video_slots)
+        return obs_space, act_space
+
+    def spawn(self, handle: WorkerHandle) -> Tuple[Any, Any]:
+        self.launch(handle)
+        return self.handshake(handle)
+
+    def attach(self, handle: WorkerHandle, specs: Dict[str, ShmSpec]) -> None:
+        self._shm_specs = specs
+        handle.conn.send(("attach", specs))
+        reply = self.wait_reply(handle, timeout=self.config.spawn_timeout_s)
+        if reply[0] != "attached":
+            raise WorkerDied(handle.index, f"bad attach reply: {reply[0]!r}")
+
+    def kill(self, handle: WorkerHandle) -> None:
+        if handle.conn is not None:
+            try:
+                handle.conn.close()
+            except Exception:
+                pass
+            handle.conn = None
+        if handle.proc is not None:
+            if handle.proc.is_alive():
+                handle.proc.terminate()
+                handle.proc.join(timeout=2.0)
+                if handle.proc.is_alive():
+                    handle.proc.kill()
+                    handle.proc.join(timeout=2.0)
+            handle.proc = None
+
+    def shutdown(self, handle: WorkerHandle, timeout: float = 2.0) -> None:
+        """Graceful close; falls back to kill."""
+        if handle.conn is not None and handle.alive:
+            try:
+                handle.conn.send(("close",))
+                self.wait_reply(handle, timeout=timeout)
+            except Exception:
+                pass
+        self.kill(handle)
+
+    # ----------------------------------------------------------------- waits
+    def wait_reply(self, handle: WorkerHandle, timeout: Optional[float] = None) -> Tuple[Any, ...]:
+        """Block until ``handle`` replies. The deadline is heartbeat-aware:
+        it extends to ``last_heartbeat + timeout`` while the worker shows
+        progress, so per-batch work scales with envs-per-worker without a
+        matching timeout bump."""
+        timeout = self.config.step_timeout_s if timeout is None else float(timeout)
+        grace = self.config.heartbeat_grace
+        start = time.time()
+        conn = handle.conn
+        while True:
+            if conn.poll(0.02):
+                try:
+                    reply = conn.recv()
+                except (EOFError, OSError) as e:
+                    raise WorkerDied(handle.index, repr(e))
+                if reply[0] == "error":
+                    raise WorkerDied(handle.index, reply[1])
+                return reply
+            if not handle.alive:
+                # drain any message written right before death
+                if conn.poll(0):
+                    continue
+                raise WorkerDied(handle.index, f"exitcode={getattr(handle.proc, 'exitcode', None)}")
+            now = time.time()
+            # `timeout` is the budget with no heartbeats at all (a boot gets
+            # spawn_timeout_s even though nothing beats yet); each heartbeat
+            # then pushes the deadline out by `grace`.
+            deadline = max(start + timeout, self.heartbeats[handle.index] + grace)
+            if now > deadline:
+                raise WorkerTimeout(handle.index, now - start)
+
+    # --------------------------------------------------------------- restart
+    def backoff_s(self, restarts: int) -> float:
+        return min(self.config.backoff_max_s, self.config.backoff_base_s * (2 ** max(0, restarts - 1)))
+
+    def restart(self, handle: WorkerHandle, reason: str, reset_seeds: Sequence[Optional[int]]) -> List[Tuple[int, dict]]:
+        """Kill + backoff + respawn + re-attach + reset ``handle``'s envs.
+
+        Returns the reset infos ``[(global_slot, info)]`` — the pool uses the
+        freshly-reset observations (already in shm) to complete the in-flight
+        step with ``truncated=True``. Raises ``WorkerDied``/``WorkerTimeout``
+        if the replacement itself fails (the caller loops against the retry
+        budget)."""
+        self.kill(handle)
+        handle.restarts += 1
+        if self.on_restart is not None:
+            self.on_restart(handle.index, reason, handle.restarts)
+        time.sleep(self.backoff_s(handle.restarts))
+        self.spawn(handle)
+        if self._shm_specs is None:
+            raise RuntimeError("restart before shared-memory allocation")
+        self.attach(handle, self._shm_specs)
+        handle.conn.send(("reset", list(reset_seeds), None))
+        reply = self.wait_reply(handle, timeout=self.config.spawn_timeout_s)
+        if reply[0] != "reset_done":
+            raise WorkerDied(handle.index, f"bad restart reset reply: {reply[0]!r}")
+        return reply[1]
+
+    def mask(self, handle: WorkerHandle, reason: str) -> None:
+        self.kill(handle)
+        handle.masked = True
+        if self.on_mask is not None:
+            self.on_mask(handle.index, handle.slots, reason)

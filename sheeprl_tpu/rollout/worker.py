@@ -1,0 +1,174 @@
+"""Env-worker child process: step a batch of env slots, write obs to shm.
+
+Protocol (pickled tuples over a duplex ``multiprocessing.Pipe``)::
+
+    parent -> worker                      worker -> parent
+    --------------------------------------------------------------------
+                                          ("ready", obs_space, act_space,
+                                                    video_slots)
+    ("attach", {key: ShmSpec})            ("attached",)
+    ("reset", [seed|None]*slots, options) ("reset_done", [(slot, info)], busy_s)
+    ("step", [action]*slots, [fault])     ("step_done", [per-slot result], busy_s)
+    ("close",)                            ("bye",)
+
+A per-slot step result is ``(reward, terminated, truncated, env_info,
+final)`` where ``final`` is ``None`` or ``(final_obs, final_info)`` — exactly
+the payload gymnasium's ``SyncVectorEnv`` feeds ``_add_info`` under
+``AutoresetMode.SAME_STEP`` (step; on termination/truncation record the final
+pair, reset immediately, expose the reset obs). Replicating that shape in the
+worker is what makes the pool bit-identical to ``SyncVectorEnv`` for the same
+seeds.
+
+TPU hygiene: :func:`sanitize_worker_environ` pins ``JAX_PLATFORMS=cpu`` and
+strips every distributed-coordinator variable, so a worker whose env stack
+imports jax transitively can never initialize the TPU runtime out from under
+the learner, nor join (and wedge) the learner's process group. The parent
+applies the same sanitizer to its own environ *around* ``Process.start()``
+(see ``supervisor._spawn_environ``) because the child imports this package —
+and therefore possibly jax — before ``worker_main`` runs.
+
+Crashes in env code surface as an ``("error", traceback)`` message followed
+by a nonzero exit; the supervisor treats both paths (message or silent death)
+identically.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Sequence
+
+_COORDINATOR_VARS = (
+    "SHEEPRL_TPU_COORDINATOR",
+    "SHEEPRL_TPU_NUM_PROCESSES",
+    "SHEEPRL_TPU_PROCESS_ID",
+    "JAX_COORDINATOR_ADDRESS",
+    "JAX_NUM_PROCESSES",
+    "JAX_PROCESS_ID",
+    "COORDINATOR_ADDRESS",
+)
+
+
+def sanitize_worker_environ(environ: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Pin ``environ`` (default ``os.environ``) to a learner-safe state: jax
+    restricted to the CPU backend, no distributed init, and a marker so any
+    code that cares can tell it runs inside an env worker."""
+    env = os.environ if environ is None else environ
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SHEEPRL_TPU_ENV_WORKER"] = "1"
+    for var in _COORDINATOR_VARS:
+        env.pop(var, None)
+    return env
+
+
+def _has_video_recorder(env: Any) -> bool:
+    import gymnasium as gym
+
+    while isinstance(env, gym.Wrapper):
+        if isinstance(env, gym.wrappers.RecordVideo):
+            return True
+        env = env.env
+    return False
+
+
+def _execute_fault(fault: Dict[str, Any], hb, worker_index: int) -> None:
+    kind = fault.get("kind")
+    if kind == "crash":
+        # skip atexit/finalizers: a SIGKILL-like death is exactly what the
+        # supervisor must recover from
+        os._exit(13)
+    elif kind == "hang":
+        # stop heartbeating too — a hung env can't make progress; sleep in
+        # small slices so a terminate() lands promptly
+        deadline = time.time() + (float(fault.get("duration_s") or 0.0) or 3600.0)
+        while time.time() < deadline:
+            time.sleep(0.05)
+    elif kind == "slow":
+        dur = float(fault.get("duration_s") or 0.0) or 1.0
+        deadline = time.time() + dur
+        while time.time() < deadline:
+            hb[worker_index] = time.time()
+            time.sleep(min(0.05, dur))
+
+
+def worker_main(
+    conn,
+    hb,
+    worker_index: int,
+    global_slots: Sequence[int],
+    thunk_blob: bytes,
+) -> None:
+    """Child-process entrypoint (module-level: spawn pickles it by name)."""
+    sanitize_worker_environ()
+    shm_views = None
+    envs: List[Any] = []
+    try:
+        import cloudpickle
+
+        thunks = cloudpickle.loads(thunk_blob)
+        envs = [thunk() for thunk in thunks]
+        video_slots = [slot for env, slot in zip(envs, global_slots) if _has_video_recorder(env)]
+        hb[worker_index] = time.time()
+        conn.send(("ready", envs[0].observation_space, envs[0].action_space, video_slots))
+
+        from sheeprl_tpu.rollout.shm import ShmSlotViews
+
+        while True:
+            msg = conn.recv()
+            hb[worker_index] = time.time()
+            cmd = msg[0]
+            if cmd == "attach":
+                shm_views = ShmSlotViews(msg[1])
+                conn.send(("attached",))
+            elif cmd == "reset":
+                _, seeds, options = msg
+                t0 = time.perf_counter()
+                infos = []
+                for env, slot, seed in zip(envs, global_slots, seeds):
+                    obs, info = env.reset(seed=seed, options=options)
+                    shm_views.write(slot, obs)
+                    infos.append((slot, info))
+                    hb[worker_index] = time.time()
+                conn.send(("reset_done", infos, time.perf_counter() - t0))
+            elif cmd == "step":
+                _, actions, faults = msg
+                for fault in faults:
+                    _execute_fault(fault, hb, worker_index)
+                t0 = time.perf_counter()
+                results = []
+                for env, slot, action in zip(envs, global_slots, actions):
+                    obs, reward, terminated, truncated, env_info = env.step(action)
+                    final = None
+                    if terminated or truncated:
+                        final = (obs, env_info)
+                        obs, env_info = env.reset()
+                    shm_views.write(slot, obs)
+                    results.append((reward, bool(terminated), bool(truncated), env_info, final))
+                    hb[worker_index] = time.time()
+                conn.send(("step_done", results, time.perf_counter() - t0))
+            elif cmd == "close":
+                conn.send(("bye",))
+                break
+            else:  # pragma: no cover - protocol bug, not a runtime path
+                raise RuntimeError(f"unknown pool command {cmd!r}")
+    except (EOFError, KeyboardInterrupt):
+        pass
+    except Exception:
+        try:
+            conn.send(("error", traceback.format_exc()))
+        except Exception:
+            pass
+        os._exit(1)
+    finally:
+        if shm_views is not None:
+            shm_views.close()
+        for env in envs:
+            try:
+                env.close()
+            except Exception:
+                pass
+        try:
+            conn.close()
+        except Exception:
+            pass

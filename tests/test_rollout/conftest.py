@@ -1,0 +1,63 @@
+"""Shared config builder for the rollout-pool suite.
+
+Every pool test boots real spawn workers (each imports the package, hence
+jax-on-cpu: a few seconds per boot), so the suite keeps one pool per test
+and small toy envs. PixelCatcher is the env under test — pure numpy,
+deterministic under seeding, pixel Dict obs like the real workloads.
+"""
+
+from sheeprl_tpu.utils.utils import dotdict
+
+TOY_WRAPPER = {
+    "_target_": "sheeprl_tpu.envs.toy.PixelCatcher",
+    "id": "toy",
+    "size": 16,
+    "paddle_width": 4,
+}
+
+
+def toy_cfg(
+    backend="pool",
+    num_envs=4,
+    num_workers=2,
+    faults=None,
+    max_restarts=3,
+    step_timeout_s=30.0,
+    capture_video=False,
+    seed=7,
+):
+    return dotdict(
+        {
+            "seed": seed,
+            "env": {
+                "id": "toy",
+                "num_envs": num_envs,
+                "frame_stack": 1,
+                "sync_env": True,
+                "backend": backend,
+                "screen_size": 16,
+                "action_repeat": 1,
+                "grayscale": False,
+                "clip_rewards": False,
+                "capture_video": capture_video,
+                "frame_stack_dilation": 1,
+                "max_episode_steps": None,
+                "reward_as_observation": False,
+                "wrapper": dict(TOY_WRAPPER),
+            },
+            "algo": {"cnn_keys": {"encoder": ["rgb"]}, "mlp_keys": {"encoder": []}},
+            "rollout": {
+                "num_workers": num_workers,
+                "step_timeout_s": step_timeout_s,
+                "spawn_timeout_s": 120.0,
+                "heartbeat_grace_s": None,
+                "max_restarts": max_restarts,
+                # fast backoff: these tests assert recovery, not pacing
+                "backoff_base_s": 0.05,
+                "backoff_max_s": 0.2,
+                "copy_obs": True,
+                "start_method": "spawn",
+                "fault_injection": {"enabled": faults is not None, "faults": faults or []},
+            },
+        }
+    )

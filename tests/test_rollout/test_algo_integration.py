@@ -1,0 +1,61 @@
+"""The ISSUE acceptance path end to end: a real algorithm main trained over
+``env.backend=pool`` with an injected worker crash completes normally, and
+``bench.py --env-stats`` surfaces the restart from the run's telemetry."""
+
+import json
+import os
+
+import bench
+from sheeprl_tpu.cli import run
+
+
+def _args(tmp_path):
+    return [
+        "exp=ppo",
+        "dry_run=True",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "algo.rollout_steps=32",
+        "algo.per_rank_batch_size=8",
+        "algo.update_epochs=2",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.encoder.cnn_features_dim=16",
+        "algo.encoder.mlp_features_dim=8",
+        "env.num_envs=2",
+        "algo.run_test=False",
+        "checkpoint.save_last=False",
+        "metric.log_level=1",
+        "metric.telemetry.enabled=True",
+        f"log_base_dir={tmp_path}/logs",
+        # the subsystem under test: pooled workers, one injected crash
+        "env.backend=pool",
+        "rollout.num_workers=2",
+        "rollout.step_timeout_s=30.0",
+        "rollout.backoff_base_s=0.05",
+        "rollout.backoff_max_s=0.2",
+        "rollout.fault_injection.enabled=True",
+        "rollout.fault_injection.faults=[{kind: crash, worker: 0, at_step: 5}]",
+    ]
+
+
+def test_ppo_over_pool_with_crash_completes_and_reports(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(_args(tmp_path))
+
+    jsonls = []
+    for root, _, files in os.walk(tmp_path):
+        jsonls += [os.path.join(root, f) for f in files if f == "telemetry.jsonl"]
+    assert len(jsonls) == 1, jsonls
+    stats = bench.env_stats_summary(jsonls[0])
+
+    # the run finished (run() returning IS the exact-step-count proof: the
+    # rollout loop iterates a fixed schedule and a lost step would deadlock
+    # or crash it) and the crash is visible in the artifacts
+    assert stats["totals"]["worker_restarts"] >= 1
+    assert stats["totals"]["masked_slots"] == 0
+    assert any(r["reason"].startswith("crash") for r in stats["worker_restarts"])
+    assert stats["env_step"]["count"] >= 32
+    assert stats["env_step"]["p95_ms"] > 0
+    # and the stream stays machine-readable through the normal CLI entrypoint
+    assert json.dumps(stats)

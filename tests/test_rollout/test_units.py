@@ -1,0 +1,159 @@
+"""Process-free units: fault parsing/scheduling, config, shm layout, backend
+selection, backoff policy, worker environ sanitization."""
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs import resolve_env_backend
+from sheeprl_tpu.rollout import FaultSpec, FaultSchedule, PoolConfig, parse_fault_config, pool_config_from_cfg
+from sheeprl_tpu.rollout.shm import ShmObsBuffers, obs_layout
+from sheeprl_tpu.rollout.supervisor import Supervisor
+from sheeprl_tpu.rollout.worker import _COORDINATOR_VARS, sanitize_worker_environ
+from sheeprl_tpu.utils.utils import dotdict
+
+from .conftest import toy_cfg
+
+
+# ---------------------------------------------------------- fault injection
+def test_parse_fault_config():
+    faults = parse_fault_config(
+        [
+            {"kind": "crash", "worker": 0, "at_step": 5},
+            {"kind": "hang", "worker": 1, "at_step": 2, "duration_s": 3.0},
+        ]
+    )
+    assert [f.kind for f in faults] == ["crash", "hang"]
+    assert faults[1].duration_s == 3.0
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        {"kind": "explode", "worker": 0, "at_step": 1},
+        {"kind": "crash", "worker": -1, "at_step": 1},
+        {"kind": "crash", "worker": 0, "at_step": -2},
+    ],
+)
+def test_parse_fault_config_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_fault_config([bad])
+
+
+def test_fault_schedule_fires_once_and_late():
+    schedule = FaultSchedule(
+        parse_fault_config(
+            [
+                {"kind": "crash", "worker": 0, "at_step": 3},
+                {"kind": "slow", "worker": 1, "at_step": 3, "duration_s": 0.1},
+                {"kind": "crash", "worker": 0, "at_step": 10},
+            ]
+        )
+    )
+    assert schedule.pop_due(0) == {}
+    due = schedule.pop_due(3)
+    assert sorted(due) == [0, 1] and due[0][0].kind == "crash" and due[1][0].kind == "slow"
+    # each spec fires exactly once
+    assert schedule.pop_due(3) == {}
+    # a fault scheduled earlier than the current step is late, not lost
+    due = schedule.pop_due(12)
+    assert due[0][0].at_step == 10
+
+
+def test_fault_spec_wire_roundtrip():
+    spec = FaultSpec(kind="slow", worker=2, at_step=7, duration_s=0.25)
+    wire = spec.to_wire()
+    assert wire["kind"] == "slow" and wire["duration_s"] == 0.25
+
+
+# ------------------------------------------------------------------- config
+def test_pool_config_from_cfg_reads_rollout_node():
+    cfg = toy_cfg(faults=[{"kind": "crash", "worker": 0, "at_step": 1}], max_restarts=5)
+    pc = pool_config_from_cfg(cfg)
+    assert pc.max_restarts == 5
+    assert pc.num_workers == 2
+    assert len(pc.faults) == 1 and pc.faults[0].kind == "crash"
+
+
+def test_pool_config_defaults_without_node():
+    pc = pool_config_from_cfg(dotdict({"env": {"num_envs": 4}}))
+    assert pc.max_restarts == 3 and pc.faults == []
+
+
+def test_pool_config_faults_gated_by_enabled():
+    cfg = toy_cfg(faults=[{"kind": "crash", "worker": 0, "at_step": 1}])
+    cfg.rollout.fault_injection.enabled = False
+    assert pool_config_from_cfg(cfg).faults == []
+
+
+def test_resolve_num_workers():
+    assert PoolConfig(num_workers=3).resolve_num_workers(8) == 3
+    assert PoolConfig(num_workers=16).resolve_num_workers(4) == 4  # capped at envs
+    assert PoolConfig().resolve_num_workers(2) <= 2
+    with pytest.raises(ValueError):
+        PoolConfig(num_workers=0).resolve_num_workers(4)
+
+
+def test_heartbeat_grace_defaults_to_step_timeout():
+    assert PoolConfig(step_timeout_s=7.0).heartbeat_grace == 7.0
+    assert PoolConfig(step_timeout_s=7.0, heartbeat_grace_s=2.0).heartbeat_grace == 2.0
+
+
+# ------------------------------------------------------------------ backend
+def test_resolve_env_backend_alias_and_override():
+    cfg = toy_cfg(backend=None)
+    cfg.env.sync_env = True
+    assert resolve_env_backend(cfg) == "sync"
+    cfg.env.sync_env = False
+    assert resolve_env_backend(cfg) == "async"
+    cfg.env.backend = "pool"
+    assert resolve_env_backend(cfg) == "pool"
+    cfg.env.backend = "turbo"
+    with pytest.raises(ValueError):
+        resolve_env_backend(cfg)
+
+
+# ---------------------------------------------------------------------- shm
+def test_obs_layout_requires_dict_of_box():
+    space = gym.spaces.Dict(
+        {"rgb": gym.spaces.Box(0, 255, (8, 8, 3), np.uint8), "state": gym.spaces.Box(-1, 1, (4,), np.float32)}
+    )
+    layout = obs_layout(space, num_envs=3)
+    assert layout["rgb"] == ((3, 8, 8, 3), np.dtype(np.uint8))
+    assert layout["state"] == ((3, 4), np.dtype(np.float32))
+    with pytest.raises(TypeError):
+        obs_layout(gym.spaces.Box(0, 255, (8, 8, 3), np.uint8), num_envs=3)
+    with pytest.raises(TypeError):
+        obs_layout(gym.spaces.Dict({"d": gym.spaces.Discrete(4)}), num_envs=3)
+
+
+def test_shm_buffers_roundtrip_and_zero():
+    space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (4, 4, 1), np.uint8)})
+    buf = ShmObsBuffers(space, num_envs=2)
+    try:
+        buf.views["rgb"][1] = 9
+        out = buf.read(copy=True)
+        assert out["rgb"][1].max() == 9
+        buf.views["rgb"][1] = 7
+        assert out["rgb"][1].max() == 9  # copy=True detaches from the shm
+        buf.zero_slot(1)
+        assert buf.views["rgb"][1].max() == 0
+    finally:
+        buf.close()
+
+
+# ------------------------------------------------------------- supervision
+def test_backoff_is_exponential_and_capped():
+    sup = Supervisor(PoolConfig(backoff_base_s=0.5, backoff_max_s=3.0), num_workers=1)
+    assert [sup.backoff_s(n) for n in (1, 2, 3, 4, 10)] == [0.5, 1.0, 2.0, 3.0, 3.0]
+
+
+def test_sanitize_worker_environ():
+    env = {var: "x" for var in _COORDINATOR_VARS}
+    env["JAX_PLATFORMS"] = "tpu"
+    env["HOME"] = "/root"
+    out = sanitize_worker_environ(env)
+    assert out["JAX_PLATFORMS"] == "cpu"
+    assert out["SHEEPRL_TPU_ENV_WORKER"] == "1"
+    assert out["HOME"] == "/root"
+    assert not any(var in out for var in _COORDINATOR_VARS)

@@ -1,0 +1,113 @@
+"""Worker-process hygiene: TPU/coordinator env sanitization and the
+capture_video single-recorder guarantee under every backend."""
+
+import os
+
+import gymnasium as gym
+import numpy as np
+
+from sheeprl_tpu.envs import build_vector_env
+from sheeprl_tpu.rollout import EnvPool, PoolConfig
+
+from .conftest import toy_cfg
+
+
+def test_worker_environ_is_sanitized_and_parent_restored(monkeypatch):
+    # pose as a TPU learner mid-distributed-init: the worker must see none of
+    # this (JAX pinned to cpu, coordinator vars stripped, worker marker set)
+    monkeypatch.setenv("SHEEPRL_TPU_COORDINATOR", "10.0.0.1:8476")
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:8476")
+
+    class EnvProbe(gym.Env):
+        """Reports the environ it was constructed under as its observation."""
+
+        observation_space = gym.spaces.Dict({"state": gym.spaces.Box(0.0, 1.0, (4,), np.float32)})
+        action_space = gym.spaces.Discrete(2)
+
+        def __init__(self):
+            self._flags = np.array(
+                [
+                    os.environ.get("JAX_PLATFORMS") == "cpu",
+                    "SHEEPRL_TPU_COORDINATOR" not in os.environ,
+                    "JAX_COORDINATOR_ADDRESS" not in os.environ,
+                    os.environ.get("SHEEPRL_TPU_ENV_WORKER") == "1",
+                ],
+                dtype=np.float32,
+            )
+
+        def reset(self, *, seed=None, options=None):
+            return {"state": self._flags.copy()}, {}
+
+        def step(self, action):
+            return {"state": self._flags.copy()}, 0.0, False, False, {}
+
+    envs = EnvPool([EnvProbe, EnvProbe], config=PoolConfig(num_workers=1))
+    try:
+        obs, _ = envs.reset(seed=0)
+        assert obs["state"].shape == (2, 4)
+        assert np.all(obs["state"] == 1.0), f"worker environ not sanitized: {obs['state']}"
+    finally:
+        envs.close()
+    # the sanitized window is scoped to Process.start(): the learner's own
+    # environ (and so its TPU/distributed setup) is untouched afterwards
+    assert os.environ["SHEEPRL_TPU_COORDINATOR"] == "10.0.0.1:8476"
+    assert os.environ["JAX_COORDINATOR_ADDRESS"] == "10.0.0.1:8476"
+
+
+class _StubRecorder(gym.wrappers.RecordVideo):
+    """Instantiable RecordVideo stand-in (moviepy is absent in CI, and the
+    real wrapper refuses to construct without it). isinstance checks — the
+    worker's recorder detection — still see a RecordVideo."""
+
+    def __init__(self, env, *args, **kwargs):
+        gym.Wrapper.__init__(self, env)
+        self.recording = False
+        self.recorded_frames = []
+
+
+def test_capture_video_gating_sync_backend(monkeypatch, tmp_path):
+    monkeypatch.setattr(gym.wrappers, "RecordVideo", _StubRecorder)
+    cfg = toy_cfg(backend="sync", capture_video=True)
+
+    def recorders(envs):
+        found = []
+        for i, env in enumerate(envs.envs):
+            node = env
+            while isinstance(node, gym.Wrapper):
+                if isinstance(node, _StubRecorder):
+                    found.append(i)
+                    break
+                node = node.env
+        return found
+
+    rank0 = build_vector_env(cfg, 0, str(tmp_path), "train")
+    try:
+        assert recorders(rank0) == [0]  # exactly one recorder: slot 0
+    finally:
+        rank0.close()
+    rank1 = build_vector_env(cfg, 1, None, "train")
+    try:
+        assert recorders(rank1) == []  # non-zero ranks never record
+    finally:
+        rank1.close()
+
+
+def test_pool_reports_video_slots():
+    from sheeprl_tpu.envs.toy import PixelCatcher
+
+    def make(slot):
+        def thunk():
+            env = PixelCatcher(seed=slot, size=16, paddle_width=4)
+            if slot == 0:
+                env = _StubRecorder(env)
+            return env
+
+        return thunk
+
+    envs = EnvPool([make(i) for i in range(4)], config=PoolConfig(num_workers=2))
+    try:
+        # slot 0 lands on worker 0, yet the report is global-slot indexed:
+        # exactly one recorder across the whole pool, at slot 0
+        assert envs.video_slots == [0]
+    finally:
+        envs.close()
