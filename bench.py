@@ -293,11 +293,13 @@ def dispatch_stats(events_or_path) -> dict:
         read_telemetry(events_or_path) if isinstance(events_or_path, str) else list(events_or_path)
     )
     windows = dispatches = gradient_steps = 0
+    fallbacks: dict = {}
     for e in events:
         if e.get("event") == "run_end":
             windows = int(e.get("train_windows", 0) or 0)
             dispatches = int(e.get("train_dispatches", 0) or 0)
             gradient_steps = int(e.get("train_gradient_steps", 0) or 0)
+            fallbacks = dict(e.get("fused_fallbacks", {}) or {})
             break
     else:
         for e in events:
@@ -305,6 +307,9 @@ def dispatch_stats(events_or_path) -> dict:
                 windows += int(e.get("window_train_windows", 0) or 0)
                 dispatches += int(e.get("window_train_dispatches", 0) or 0)
                 gradient_steps += int(e.get("window_train_gradient_steps", 0) or 0)
+            elif e.get("event") == "fused_fallback":
+                reason = str(e.get("reason", "<unknown>"))
+                fallbacks[reason] = fallbacks.get(reason, 0) + 1
     out = {
         "train_windows": windows,
         "train_dispatches": dispatches,
@@ -314,6 +319,10 @@ def dispatch_stats(events_or_path) -> dict:
         out["dispatches_per_window"] = round(dispatches / windows, 3)
     if dispatches:
         out["gradient_steps_per_dispatch"] = round(gradient_steps / dispatches, 3)
+    if fallbacks:
+        # WHY a run dispatched per-step instead of fusing (ops/superstep.py
+        # fused_fallback): reason -> count, e.g. {"host_buffer": 1}
+        out["fused_fallbacks"] = fallbacks
     return out
 
 

@@ -53,9 +53,11 @@ from sheeprl_tpu.data.device_buffer import (
 from sheeprl_tpu.data.prefetch import sampled_batches
 from sheeprl_tpu.ops.superstep import (
     fold_sample_key,
+    fused_fallback,
     make_superstep_fn,
     periodic_target_ema,
     pregathered,
+    reset_fused_fallback_warnings,
 )
 from sheeprl_tpu.envs import build_vector_env
 from sheeprl_tpu.ops.distributions import (
@@ -374,11 +376,16 @@ def make_fused_train_fn(
     actions_dim: Sequence[int],
     gather,
     num_steps: int,
+    ctx_spec=None,
 ):
     """``num_steps`` gradient steps — replay gather, EMA target refresh and
     train body — fused into ONE donated dispatch (``algo.fused_gradient_steps``;
-    see :mod:`sheeprl_tpu.ops.superstep`). Single-device only: the scan body
-    is the raw ``local_train``, not the shard_map'd program.
+    see :mod:`sheeprl_tpu.ops.superstep`). On a pure data-parallel mesh the
+    whole scan runs under shard_map over ``fabric.data_axis``: the body is
+    the same ``local_train`` the per-step sharded path uses (it pmeans
+    gradients and metrics), ``gather`` must draw shard-locally
+    (``fold_sample_key(..., axis_name=fabric.data_axis)``), and ``ctx_spec``
+    gives the sample context's partition spec.
 
     The jitted fn's signature is ``(params, aux, counter, sample_ctx, key) ->
     (params, aux, key, metrics[num_steps, len(METRIC_ORDER)])`` with
@@ -387,11 +394,6 @@ def make_fused_train_fn(
     local_train, use_shard_map = make_train_step(
         fabric, wm, actor, critic, world_tx, actor_tx, critic_tx, cfg, is_continuous, actions_dim
     )
-    if use_shard_map:
-        raise ValueError(
-            "fused supersteps need a single-device run; got "
-            f"world_size={fabric.world_size}"
-        )
     freq = max(1, int(cfg.algo.critic.per_rank_target_network_update_freq))
     tau = float(cfg.algo.critic.tau)
 
@@ -409,7 +411,15 @@ def make_fused_train_fn(
         t_p = periodic_target_ema(counter, c_p, t_p, freq, tau)
         return (wm_p, a_p, c_p, t_p), aux
 
-    return make_superstep_fn(train_body, gather, num_steps, pre_step=pre_step)
+    return make_superstep_fn(
+        train_body,
+        gather,
+        num_steps,
+        pre_step=pre_step,
+        mesh=fabric.mesh if use_shard_map else None,
+        data_axis=fabric.data_axis if use_shard_map else None,
+        ctx_spec=ctx_spec,
+    )
 
 
 @register_algorithm()
@@ -559,17 +569,31 @@ def main(fabric, cfg: Dict[str, Any]):
     # replay gather, EMA target refresh and K gradient steps in ONE donated
     # XLA program (ops.superstep). 0 keeps the per-step path above.
     fused_k = int(cfg.algo.get("fused_gradient_steps", 0) or 0)
-    if fused_k > 0 and fabric.world_size * fabric.num_processes > 1:
-        import warnings
-
-        warnings.warn(
-            "algo.fused_gradient_steps needs a single-process single-device "
-            "run; falling back to the per-step train path",
-            stacklevel=2,
-        )
-        fused_k = 0
+    if fused_k > 0:
+        reset_fused_fallback_warnings()
+        if fabric.num_processes > 1:
+            fused_fallback(
+                "multi_process",
+                "algo.fused_gradient_steps cannot span processes "
+                f"(num_processes={fabric.num_processes}); falling back to the "
+                "per-step train path",
+            )
+            fused_k = 0
+        elif fabric.world_size > 1 and fabric.model_axis is not None:
+            fused_fallback(
+                "model_axis",
+                "algo.fused_gradient_steps is pure data-parallel, but this run "
+                f"shards params over model_axis={fabric.model_axis!r}; falling "
+                "back to the per-step (GSPMD) train path",
+            )
+            fused_k = 0
+    # on a (pure-DP) mesh the superstep runs under shard_map: each device
+    # draws/consumes its own per_rank batch shard and the scan body pmeans
+    fused_sharded = fused_k > 0 and fabric.world_size > 1
     fused_fns: Dict[int, Any] = {}  # one compiled superstep per distinct scan length
     fused_batch_size = per_rank_batch_size * fabric.local_data_parallel_size
+    fused_draw_size = fused_batch_size // (fabric.data_parallel_size if fused_sharded else 1)
+    fused_axis = fabric.data_axis if fused_sharded else None
 
     if use_device_rb:
 
@@ -577,11 +601,26 @@ def main(fabric, cfg: Dict[str, Any]):
             del i  # fresh indices come from the folded per-step key
             bufs, pos, full = ctx
             return draw_sequence_batch(
-                bufs, pos, full, fold_sample_key(gather_key), fused_batch_size, sequence_length
+                bufs,
+                pos,
+                full,
+                fold_sample_key(gather_key, axis_name=fused_axis),
+                fused_draw_size,
+                sequence_length,
             )
 
     else:
         fused_gather = pregathered
+
+    fused_ctx_spec = None
+    if fused_sharded:
+        # ring: (bufs, pos, full) all env-axis sharded; pregathered stack:
+        # [n, T, B, ...] sharded along the batch axis
+        fused_ctx_spec = (
+            (P(fused_axis), P(fused_axis), P(fused_axis))
+            if use_device_rb
+            else P(None, None, fused_axis)
+        )
 
     def get_fused_fn(n: int):
         fn = fused_fns.get(n)
@@ -599,18 +638,21 @@ def main(fabric, cfg: Dict[str, Any]):
                 actions_dim,
                 fused_gather,
                 n,
+                ctx_spec=fused_ctx_spec,
             )
         return fn
 
     def fused_pregather_ctx(n: int):
         # host-buffer fallback: draw the chunk's n batches with the buffer's
         # own RNG (the unfused sampling distribution and stream) and ship
-        # them once as a stacked [n, T, B, ...] pytree
+        # them once as a stacked [n, T, B, ...] pytree — batch-axis sharded
+        # on a mesh so the shard_map'd superstep slices it without a copy
         from sheeprl_tpu.data.buffers import to_device
 
         sample = rb.sample(fused_batch_size, sequence_length=sequence_length, n_samples=n)
         return to_device(
-            {k: (v if k in cnn_keys else v.astype(np.float32)) for k, v in sample.items()}
+            {k: (v if k in cnn_keys else v.astype(np.float32)) for k, v in sample.items()},
+            sharding=fabric.sharding(None, None, fused_axis) if fused_sharded else None,
         )
 
     key = jax.random.PRNGKey(int(cfg.seed))

@@ -11,7 +11,6 @@ per-critic, per-step.
 from __future__ import annotations
 
 import os
-import warnings
 from typing import Any, Dict
 
 import gymnasium as gym
@@ -34,7 +33,7 @@ from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.data.device_buffer import draw_transition_batch
 from sheeprl_tpu.envs import build_vector_env
 from sheeprl_tpu.obs import telemetry_train_window
-from sheeprl_tpu.ops.superstep import fold_sample_key
+from sheeprl_tpu.ops.superstep import fold_sample_key, fused_fallback, reset_fused_fallback_warnings
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -65,7 +64,26 @@ def make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg, *, fused_le
     # in ONE dispatch per chunk. The actor update stays one dispatch.
     fused = fused_length is not None
     if fused and multi_device:
-        raise ValueError("fused in-scan gather supersteps need a single-device run")
+        # fused + mesh = pure data-parallel shard_map (main() has already
+        # fallen back for model_axis / multi-process runs): the ring context
+        # arrives env-axis sharded and every device scans its own in-graph
+        # draws of a per-shard batch
+        if fabric.model_axis is not None or fabric.num_processes != 1:
+            raise ValueError(
+                "fused in-scan gather supersteps need a single-process pure "
+                f"data-parallel run; got model_axis={fabric.model_axis!r}, "
+                f"num_processes={fabric.num_processes}"
+            )
+        if int(fused_batch_size) % fabric.data_parallel_size:
+            raise ValueError(
+                f"fused_batch_size ({fused_batch_size}) must divide by "
+                f"data_parallel_size ({fabric.data_parallel_size})"
+            )
+    fused_draw_size = (
+        int(fused_batch_size) // (fabric.data_parallel_size if multi_device else 1)
+        if fused
+        else None
+    )
 
     def pmean(x):
         return lax.pmean(x, data_axis) if multi_device else x
@@ -119,8 +137,10 @@ def make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg, *, fused_le
                 # draw key = carried key folded with the sample salt, so the
                 # index noise stays decorrelated from the dropout/gradient
                 # noise critic_step derives from the same key via split
+                # the carried key was already folded with axis_index on a
+                # mesh, so the salted draw is per-shard decorrelated for free
                 batch = draw_transition_batch(
-                    bufs, pos, full, fold_sample_key(carry[-1]), fused_batch_size
+                    bufs, pos, full, fold_sample_key(carry[-1]), fused_draw_size
                 )
                 return critic_step(carry, batch)
 
@@ -166,10 +186,16 @@ def make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg, *, fused_le
 
     critic_fn, actor_fn = local_critic_scan, local_actor_update
     if multi_device:
+        # critic_data slot: pre-gathered [G, B, ...] stacks shard along the
+        # batch axis; a fused ring context (bufs, pos, full) shards along the
+        # env axis, matching the DeviceReplayBuffer's placement
+        critic_data_spec = (
+            (P(data_axis), P(data_axis), P(data_axis)) if fused else P(None, data_axis)
+        )
         critic_fn = shard_map(
             local_critic_scan,
             mesh=fabric.mesh,
-            in_specs=(P(), P(), P(), P(), P(), P(None, data_axis), P()),
+            in_specs=(P(), P(), P(), P(), P(), critic_data_spec, P()),
             out_specs=(P(), P(), P(), P()),
         )
         actor_fn = shard_map(
@@ -277,21 +303,30 @@ def main(fabric, cfg: Dict[str, Any]):
     # gather INSIDE the scanned critic chunk so one train window of G critic
     # steps issues ceil(G / K) dispatches (the actor update stays one)
     fused_k = int(cfg.algo.get("fused_gradient_steps", 0) or 0)
-    if fused_k > 0 and not use_device_rb:
-        warnings.warn(
-            "algo.fused_gradient_steps needs the device replay buffer (buffer.device) to draw "
-            "batches inside the scanned chunk; the host-buffer path already runs each chunk as "
-            "one dispatch. Falling back to the per-chunk host gather.",
-            stacklevel=2,
-        )
-        fused_k = 0
-    if fused_k > 0 and fabric.world_size * fabric.num_processes > 1:
-        warnings.warn(
-            "algo.fused_gradient_steps needs a single-process, single-device run; falling back "
-            "to the per-chunk gather path.",
-            stacklevel=2,
-        )
-        fused_k = 0
+    if fused_k > 0:
+        reset_fused_fallback_warnings()
+        if not use_device_rb:
+            fused_fallback(
+                "host_buffer",
+                "algo.fused_gradient_steps needs the device replay buffer (buffer.device) to "
+                "draw batches inside the scanned chunk; the host-buffer path already runs each "
+                "chunk as one dispatch. Falling back to the per-chunk host gather.",
+            )
+            fused_k = 0
+        elif fabric.num_processes > 1:
+            fused_fallback(
+                "multi_process",
+                "algo.fused_gradient_steps cannot span processes "
+                f"(num_processes={fabric.num_processes}); falling back to the per-chunk gather path.",
+            )
+            fused_k = 0
+        elif fabric.world_size > 1 and fabric.model_axis is not None:
+            fused_fallback(
+                "model_axis",
+                "algo.fused_gradient_steps is pure data-parallel, but this run shards params "
+                f"over model_axis={fabric.model_axis!r}; falling back to the per-chunk gather path.",
+            )
+            fused_k = 0
 
     critic_fn, actor_fn = make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg)
 

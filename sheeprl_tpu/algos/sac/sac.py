@@ -41,7 +41,7 @@ from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.data.device_buffer import draw_transition_batch
 from sheeprl_tpu.envs import build_vector_env
 from sheeprl_tpu.obs import log_sps_and_heartbeat, telemetry_advance, telemetry_train_window
-from sheeprl_tpu.ops.superstep import fold_sample_key
+from sheeprl_tpu.ops.superstep import fold_sample_key, fused_fallback, reset_fused_fallback_warnings
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -75,7 +75,26 @@ def make_train_fn(
     # EMA all land in ONE dispatch per chunk (ops/superstep.py rationale)
     fused = fused_length is not None
     if fused and multi_device:
-        raise ValueError("fused in-scan gather supersteps need a single-device run")
+        # fused + mesh = pure data-parallel shard_map (main() has already
+        # fallen back for model_axis / multi-process runs): the ring context
+        # arrives env-axis sharded and every device scans its own in-graph
+        # draws of a per-shard batch
+        if fabric.model_axis is not None or fabric.num_processes != 1:
+            raise ValueError(
+                "fused in-scan gather supersteps need a single-process pure "
+                f"data-parallel run; got model_axis={fabric.model_axis!r}, "
+                f"num_processes={fabric.num_processes}"
+            )
+        if int(fused_batch_size) % fabric.data_parallel_size:
+            raise ValueError(
+                f"fused_batch_size ({fused_batch_size}) must divide by "
+                f"data_parallel_size ({fabric.data_parallel_size})"
+            )
+    fused_draw_size = (
+        int(fused_batch_size) // (fabric.data_parallel_size if multi_device else 1)
+        if fused
+        else None
+    )
     # EMA cadence in gradient steps (reference sac.py:56 ties it to updates)
     ema_every = max(1, int(cfg.algo.critic.target_network_frequency) // max(1, int(cfg.env.num_envs)))
 
@@ -154,12 +173,15 @@ def make_train_fn(
                 # the draw key is the carried key folded with the sample salt,
                 # so the index noise never correlates with the gradient noise
                 # one_step derives from the same key via split
+                # the carried key was already folded with axis_index on a
+                # mesh (local_train's first line), so the salted draw is
+                # per-shard decorrelated for free
                 batch = draw_transition_batch(
                     bufs,
                     pos,
                     full,
                     fold_sample_key(carry[-1]),
-                    fused_batch_size,
+                    fused_draw_size,
                     sample_next_obs=fused_sample_next_obs,
                     obs_keys=("observations",),
                 )
@@ -177,10 +199,16 @@ def make_train_fn(
         )
 
     if multi_device:
+        # data slot: pre-gathered [G, B, ...] stacks shard along the batch
+        # axis; a fused ring context (bufs, pos, full) shards along the env
+        # axis, matching the DeviceReplayBuffer's placement
+        data_spec = (
+            (P(data_axis), P(data_axis), P(data_axis)) if fused else P(None, data_axis)
+        )
         train_fn = shard_map(
             local_train,
             mesh=fabric.mesh,
-            in_specs=(P(), P(), P(), P(), P(), P(), P(), P(), P(None, data_axis), P()),
+            in_specs=(P(), P(), P(), P(), P(), P(), P(), P(), data_spec, P()),
             out_specs=(P(), P(), P(), P(), P(), P(), P(), P(), P()),
         )
     else:
@@ -292,21 +320,30 @@ def main(fabric, cfg: Dict[str, Any]):
     # gather INSIDE the scanned chunk so one train window of G steps issues
     # ceil(G / K) dispatches with no host round trip in between
     fused_k = int(cfg.algo.get("fused_gradient_steps", 0) or 0)
-    if fused_k > 0 and not use_device_rb:
-        warnings.warn(
-            "algo.fused_gradient_steps needs the device replay buffer (buffer.device) to draw "
-            "batches inside the scanned chunk; the host-buffer path already runs each chunk as "
-            "one dispatch. Falling back to the per-chunk host gather.",
-            stacklevel=2,
-        )
-        fused_k = 0
-    if fused_k > 0 and fabric.world_size * fabric.num_processes > 1:
-        warnings.warn(
-            "algo.fused_gradient_steps needs a single-process, single-device run; falling back "
-            "to the per-chunk gather path.",
-            stacklevel=2,
-        )
-        fused_k = 0
+    if fused_k > 0:
+        reset_fused_fallback_warnings()
+        if not use_device_rb:
+            fused_fallback(
+                "host_buffer",
+                "algo.fused_gradient_steps needs the device replay buffer (buffer.device) to draw "
+                "batches inside the scanned chunk; the host-buffer path already runs each chunk as "
+                "one dispatch. Falling back to the per-chunk host gather.",
+            )
+            fused_k = 0
+        elif fabric.num_processes > 1:
+            fused_fallback(
+                "multi_process",
+                "algo.fused_gradient_steps cannot span processes "
+                f"(num_processes={fabric.num_processes}); falling back to the per-chunk gather path.",
+            )
+            fused_k = 0
+        elif fabric.world_size > 1 and fabric.model_axis is not None:
+            fused_fallback(
+                "model_axis",
+                "algo.fused_gradient_steps is pure data-parallel, but this run shards params "
+                f"over model_axis={fabric.model_axis!r}; falling back to the per-chunk gather path.",
+            )
+            fused_k = 0
 
     train_fn = make_train_fn(fabric, agent, actor_tx, critic_tx, alpha_tx, cfg)
 
@@ -452,9 +489,16 @@ def main(fabric, cfg: Dict[str, Any]):
                         data = fabric.make_global(data, (None, fabric.data_axis))
                     else:
                         # async HBM staging: device_put returns immediately and
-                        # XLA orders the copy before the fused train step reads it
+                        # XLA orders the copy before the fused train step reads
+                        # it; on a mesh the stack goes up pre-sharded along the
+                        # batch axis (the train fn's in_spec), not replicated
                         from sheeprl_tpu.data.buffers import to_device
-                        data = to_device(data)
+                        data = to_device(
+                            data,
+                            sharding=fabric.sharding(None, fabric.data_axis)
+                            if fabric.world_size > 1
+                            else None,
+                        )
                 with timer("Time/train_time"):
                     key, train_key = jax.random.split(key)
                     (

@@ -24,6 +24,15 @@ partial ``add`` (every write is a fixed-shape scatter, so one compiled
 program serves full and partial adds alike). Writes donate the buffer state
 to XLA, which aliases the update in place — adding a step never copies the
 ring.
+
+On a pure data-parallel mesh the ring shards along the env axis
+(``NamedSharding`` over ``data_axis``, ``n_envs`` divisible by the axis
+size): every device owns a contiguous block of env rows, ``add`` scatters
+each device's env slice into its own shard under ``shard_map`` (per-device
+cursor arithmetic, no cross-device traffic), and the pure sampling kernels
+run shard-locally at fixed shapes — both from the host paths (gathers come
+out batch-sharded, ready for the data-parallel train step) and from inside
+a fused superstep's scan (each device draws its own batch shard).
 """
 
 from __future__ import annotations
@@ -34,6 +43,9 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.parallel.shard_map import shard_map
 
 
 def _is_pixel(v: np.ndarray) -> bool:
@@ -176,10 +188,20 @@ class DeviceReplayBuffer:
     """Sequential replay ring resident on an accelerator device.
 
     Drop-in for the ``EnvIndependentReplayBuffer``/``SequentialReplayBuffer``
-    pair in single-process, single-device training loops: same ``add``
-    signature (``[1, n, ...]`` step dicts, optional env ``indices``), same
-    sampling distribution, but ``sample_batches`` yields device-resident
+    pair in single-process training loops: same ``add`` signature
+    (``[1, n, ...]`` step dicts, optional env ``indices``), same sampling
+    distribution, but ``sample_batches`` yields device-resident
     ``[T, B, ...]`` batches gathered on-chip.
+
+    Pass ``mesh``/``data_axis`` (a pure data-parallel mesh; ``n_envs``
+    divisible by the axis size) to shard the ring along the env axis: each
+    device owns ``n_envs / shards`` contiguous env rows, writes and gathers
+    run shard-locally under ``shard_map``, batches come out sharded along
+    the batch axis, and the env draw becomes stratified — exactly
+    ``batch / shards`` samples per device block, uniform within the block
+    (the per-env marginal stays uniform; batch sizes must divide by the
+    shard count). :meth:`superstep_inputs` then hands a fused superstep a
+    context it can consume under the same sharding with zero resharding.
     """
 
     def __init__(
@@ -189,6 +211,8 @@ class DeviceReplayBuffer:
         obs_keys: Sequence[str] = ("observations",),
         device: Optional[jax.Device] = None,
         seed: Optional[int] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        data_axis: Optional[str] = None,
     ) -> None:
         if buffer_size <= 0:
             raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
@@ -198,6 +222,23 @@ class DeviceReplayBuffer:
         self._n_envs = int(n_envs)
         self._obs_keys = tuple(obs_keys)
         self._device = device
+        self._mesh = None
+        self._data_axis = None
+        self._n_shards = 1
+        self._sharding: Optional[NamedSharding] = None
+        if mesh is not None and data_axis is not None and int(mesh.shape[data_axis]) > 1:
+            shards = int(mesh.shape[data_axis])
+            if device is not None:
+                raise ValueError("pass either 'device' or 'mesh'/'data_axis', not both")
+            if n_envs % shards:
+                raise ValueError(
+                    f"a sharded ring needs n_envs ({n_envs}) divisible by the "
+                    f"'{data_axis}' mesh axis size ({shards})"
+                )
+            self._mesh = mesh
+            self._data_axis = data_axis
+            self._n_shards = shards
+            self._sharding = NamedSharding(mesh, P(data_axis))
         self._rng = np.random.default_rng(seed)
         # host mirrors of the per-env ring cursors (the device never needs
         # to report them back)
@@ -237,8 +278,32 @@ class DeviceReplayBuffer:
     def device(self) -> Optional[jax.Device]:
         return self._device
 
+    @property
+    def sharded(self) -> bool:
+        return self._n_shards > 1
+
+    @property
+    def n_shards(self) -> int:
+        return self._n_shards
+
     def __len__(self) -> int:
         return self._buffer_size
+
+    def __repr__(self) -> str:
+        # the placement clause is load-bearing for debuggability: tests and
+        # bug reports assert the ring landed where the resolver said it would
+        if self.sharded:
+            placement = (
+                f"placement=sharded(axis={self._data_axis!r}, shards={self._n_shards}, "
+                f"envs_per_shard={self._n_envs // self._n_shards})"
+            )
+        else:
+            dev = self._device if self._device is not None else "default"
+            placement = f"placement=single({dev})"
+        return (
+            f"DeviceReplayBuffer(buffer_size={self._buffer_size}, n_envs={self._n_envs}, "
+            f"allocated={self._bufs is not None}, {placement})"
+        )
 
     # ------------------------------------------------------------- allocation
     def _allocate(self, data: Dict[str, np.ndarray]) -> None:
@@ -256,7 +321,7 @@ class DeviceReplayBuffer:
                 smalls.append(k)
                 dtype = jnp.float32
             shape = (self._n_envs, cap1, *item)
-            bufs[k] = jax.device_put(jnp.zeros(shape, dtype), self._device)
+            bufs[k] = jax.device_put(jnp.zeros(shape, dtype), self._sharding or self._device)
         offset = 0
         for k in smalls:
             item = tuple(np.asarray(data[k]).shape[2:])
@@ -269,7 +334,10 @@ class DeviceReplayBuffer:
         self._build_kernels()
 
     def _build_kernels(self) -> None:
-        n_envs = self._n_envs
+        # under shard_map every operand arrives as its per-device block, so
+        # the kernels index with the LOCAL env count — per-device cursor
+        # arithmetic falls out of the same code that serves the 1-device ring
+        n_envs = self._n_envs // self._n_shards
         small_slices = dict(self._small_slices)
         pixel_keys = self._pixel_keys
         small_keys = self._small_keys
@@ -305,18 +373,51 @@ class DeviceReplayBuffer:
 
         import os
 
+        gather_seq = gather_sequences
+        gather_items = gather_transition_items
+        gather_next = gather_transitions_next
+        if self.sharded:
+            mesh, ax = self._mesh, self._data_axis
+            # write: every operand (ring, staging arrays, cursor vector) is
+            # env-axis sharded, so each device scatters its own env block —
+            # no collective appears in the program
+            write = shard_map(write, mesh, in_specs=(P(ax), P(ax), P(ax), P(ax)), out_specs=P(ax))
+            # host-path gathers: the draw is stratified per shard (see
+            # draw_indices), index arrays arrive batch-axis sharded with
+            # SHARD-LOCAL env ids, and the batch comes out pre-sharded along
+            # the batch axis — exactly the layout the data-parallel train
+            # step consumes
+            gather_seq = shard_map(
+                gather_seq, mesh, in_specs=(P(ax), P(ax), P(ax)), out_specs=P(None, ax)
+            )
+            gather_items = shard_map(
+                gather_items, mesh, in_specs=(P(ax), P(None, ax), P(None, ax)), out_specs=P(None, ax)
+            )
+            gather_next = shard_map(
+                gather_next,
+                mesh,
+                in_specs=(P(ax), P(None, ax), P(None, ax), P(None, ax)),
+                out_specs=P(None, ax),
+            )
+
         if os.environ.get("SHEEPRL_TPU_RING_NO_DONATE"):
             # debug switch: in-place aliasing off — every write copies the ring
             self._write = jax.jit(write)
-            self._amend = jax.jit(amend)
         else:
             self._write = jax.jit(write, donate_argnums=0)
-            self._amend = jax.jit(amend, donate_argnums=0)
+        # amend is the rare failure-recovery patch path (one env, one slot):
+        # on a sharded ring the plain jit lets GSPMD route the scalar scatter
+        # to whichever shard owns the env row — not worth a shard_map
+        self._amend = (
+            jax.jit(amend)
+            if os.environ.get("SHEEPRL_TPU_RING_NO_DONATE")
+            else jax.jit(amend, donate_argnums=0)
+        )
         # the gathers are the module-level pure kernels (also callable from
         # inside a fused superstep's scan body), jitted here for the host paths
-        self._gather = jax.jit(gather_sequences)
-        self._gather_transitions = jax.jit(gather_transition_items)
-        self._gather_transitions_next = jax.jit(gather_transitions_next)
+        self._gather = jax.jit(gather_seq)
+        self._gather_transitions = jax.jit(gather_items)
+        self._gather_transitions_next = jax.jit(gather_next)
 
     # ------------------------------------------------------------------ write
     def add(
@@ -372,14 +473,19 @@ class DeviceReplayBuffer:
                 o0, o1, _ = self._small_slices[k]
                 smalls[env, o0:o1] = np.asarray(data[k][0, col], np.float32).reshape(-1)
 
-        if (self._device or jax.devices()[0]).platform == "cpu":
+        ref_device = (
+            self._mesh.devices.flat[0] if self._mesh is not None else (self._device or jax.devices()[0])
+        )
+        if ref_device.platform == "cpu":
             # PJRT CPU device_put may alias aligned numpy buffers zero-copy;
             # the staging arrays are refilled on the next add() while the
             # donated write may still be queued — hand the transfer copies
             pixels = {k: v.copy() for k, v in pixels.items()}
             smalls = smalls.copy()
             pos = pos.copy()
-        dev_args = jax.device_put((pixels, smalls, jnp.asarray(pos)), self._device)
+        # on a sharded ring the staging arrays are env-major too, so one
+        # sharded device_put scatters each device's env slice onto its shard
+        dev_args = jax.device_put((pixels, smalls, jnp.asarray(pos)), self._sharding or self._device)
         self._bufs = self._write(self._bufs, *dev_args)
         for env in indices:
             self._pos[env] += 1
@@ -404,6 +510,24 @@ class DeviceReplayBuffer:
         )
 
     # ----------------------------------------------------------------- sample
+    def _draw_env_idx(self, n: int) -> np.ndarray:
+        """Env split of a host-side draw. Single-device: uniform over envs
+        (multinomial counts, the stock distribution). Sharded: stratified —
+        batch block ``s`` draws uniformly from shard ``s``'s env rows, so the
+        gathered batch partitions cleanly along the batch axis (fixed
+        per-shard sample counts; the per-env marginal stays uniform because
+        every shard owns the same number of envs)."""
+        if not self.sharded:
+            return self._rng.integers(0, self._n_envs, (n,), dtype=np.intp)
+        if n % self._n_shards:
+            raise ValueError(
+                f"a sharded ring draws fixed per-shard batch blocks: batch size "
+                f"({n}) must divide by the shard count ({self._n_shards})"
+            )
+        n_local = self._n_envs // self._n_shards
+        block = np.repeat(np.arange(self._n_shards, dtype=np.intp), n // self._n_shards)
+        return block * n_local + self._rng.integers(0, n_local, (n,), dtype=np.intp)
+
     def _valid_starts(self, env: int, span: int) -> np.ndarray:
         """Window starts for one env that do not straddle its write cursor —
         the same validity rule as ``SequentialReplayBuffer.sample``
@@ -429,7 +553,7 @@ class DeviceReplayBuffer:
             raise ValueError(f"'batch_size' ({batch_size}) must be greater than 0")
         if self._bufs is None:
             raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
-        env_idx = self._rng.integers(0, self._n_envs, (batch_size,), dtype=np.intp)
+        env_idx = self._draw_env_idx(batch_size)
         starts = np.empty((batch_size,), np.intp)
         for env in np.unique(env_idx):
             valid = self._valid_starts(int(env), sequence_length)
@@ -455,8 +579,13 @@ class DeviceReplayBuffer:
         for _ in range(n_samples):
             env_idx, starts = self.draw_indices(batch_size, sequence_length)
             time_idx = (starts[:, None] + offsets[None, :]) % self._buffer_size
+            if self.sharded:
+                # the sharded gather indexes each device's env block, so the
+                # (per-block stratified) env ids are rebased shard-locally
+                env_idx = env_idx % (self._n_envs // self._n_shards)
             ei, ti = jax.device_put(
-                (env_idx.astype(np.int32), time_idx.astype(np.int32)), self._device
+                (env_idx.astype(np.int32), time_idx.astype(np.int32)),
+                self._sharding or self._device,
             )
             yield self._gather(self._bufs, ei, ti)
 
@@ -495,7 +624,12 @@ class DeviceReplayBuffer:
         if self._bufs is None:
             raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
         n = batch_size * n_samples
-        env_idx = self._rng.integers(0, self._n_envs, (n,), dtype=np.intp)
+        if self.sharded:
+            # stratify each sample row independently so every [batch] row
+            # partitions into equal per-shard blocks (see _draw_env_idx)
+            env_idx = np.concatenate([self._draw_env_idx(batch_size) for _ in range(n_samples)])
+        else:
+            env_idx = self._rng.integers(0, self._n_envs, (n,), dtype=np.intp)
         items = np.empty((n,), np.intp)
         for env in np.unique(env_idx):
             valid = self._valid_items(int(env), sample_next_obs)
@@ -513,6 +647,23 @@ class DeviceReplayBuffer:
                 )
             rows = np.nonzero(env_idx == env)[0]
             items[rows] = valid[self._rng.integers(0, len(valid), size=(len(rows),), dtype=np.intp)]
+        if self.sharded:
+            # 2-D [n_samples, batch] indices (shard-local env ids), sharded
+            # along the batch axis: the gather returns the final
+            # [n_samples, batch, ...] layout pre-sharded — no on-device
+            # reshape of a sharded axis
+            row_spec = NamedSharding(self._mesh, P(None, self._data_axis))
+            shape2 = (n_samples, batch_size)
+            env_local = (env_idx % (self._n_envs // self._n_shards)).astype(np.int32)
+            ei, ti = jax.device_put(
+                (env_local.reshape(shape2), items.astype(np.int32).reshape(shape2)), row_spec
+            )
+            if sample_next_obs:
+                ni = jax.device_put(
+                    ((items + 1) % self._buffer_size).astype(np.int32).reshape(shape2), row_spec
+                )
+                return self._gather_transitions_next(self._bufs, ei, ti, ni)
+            return self._gather_transitions(self._bufs, ei, ti)
         ei, ti = jax.device_put(
             (env_idx.astype(np.int32), items.astype(np.int32)), self._device
         )
@@ -559,9 +710,11 @@ class DeviceReplayBuffer:
                     "calling 'self.add()'"
                 )
         # copies: on CPU device_put may alias the host mirrors zero-copy, and
-        # add() mutates them in place while the superstep is still queued
+        # add() mutates them in place while the superstep is still queued.
+        # On a sharded ring the cursors land env-axis sharded like the bufs,
+        # so the superstep's shard_map hands each device its own cursor block
         pos, full = jax.device_put(
-            (self._pos.astype(np.int32), self._full.copy()), self._device
+            (self._pos.astype(np.int32), self._full.copy()), self._sharding or self._device
         )
         return self._bufs, pos, full
 
@@ -622,6 +775,12 @@ class DeviceReplayBuffer:
         self._small_keys = state["small_keys"]
         self._pixel_keys = state["pixel_keys"]
         self._device = None  # re-pinned by the restoring process
+        # meshes do not pickle: a restored ring comes back single-device and
+        # the restoring run's jitted consumers reshard it on first use
+        self._mesh = None
+        self._data_axis = None
+        self._n_shards = 1
+        self._sharding = None
         self._bufs = None
         self._write = self._gather = self._amend = None
         self._gather_transitions = self._gather_transitions_next = None
@@ -812,25 +971,40 @@ def resolve_device_buffer(
 ) -> bool:
     """Decide whether this run keeps replay in HBM.
 
-    ``buffer.device`` true/false forces the choice (true still requires a
-    single-process single-device run — the ring is not sharded); ``auto``
-    additionally requires a non-CPU backend and an estimated footprint under
-    ``buffer.device_max_bytes``.
+    The ring has two placements: single-device, and sharded along the env
+    axis of a pure data-parallel mesh. ``buffer.device=true`` forces HBM and
+    raises when neither placement fits (multi-process runs, ``model_axis``
+    meshes, or ``n_envs`` not divisible by the data-axis size); ``auto``
+    picks HBM when a placement fits AND the backend is not CPU AND the
+    estimated footprint stays under ``buffer.device_max_bytes`` (on a
+    sharded ring that budget is per the whole mesh — each device holds
+    ``1/data_parallel_size`` of it).
     """
     spec = cfg.buffer.get("device", "auto")
-    supported = fabric.world_size == 1 and fabric.num_processes == 1
+    unsupported_reason = None
+    if fabric.num_processes != 1:
+        unsupported_reason = (
+            f"the ring cannot span processes (num_processes={fabric.num_processes})"
+        )
+    elif fabric.world_size > 1 and fabric.model_axis is not None:
+        unsupported_reason = (
+            f"the sharded ring needs a pure data-parallel mesh, but this run "
+            f"shards params over model_axis={fabric.model_axis!r}"
+        )
+    elif fabric.world_size > 1 and n_envs % fabric.data_parallel_size:
+        unsupported_reason = (
+            f"the sharded ring splits env rows evenly across the data axis, but "
+            f"n_envs={n_envs} does not divide by data_parallel_size={fabric.data_parallel_size}"
+        )
     if spec in (True, "true", "True"):
-        if not supported:
-            raise ValueError(
-                "buffer.device=true needs a single-process, single-device run; "
-                f"got world_size={fabric.world_size}, num_processes={fabric.num_processes}"
-            )
+        if unsupported_reason is not None:
+            raise ValueError(f"buffer.device=true is impossible here: {unsupported_reason}")
         return True
     if spec in (False, "false", "False", None):
         return False
     if spec != "auto":
         raise ValueError(f"unknown buffer.device spec {spec!r}; use auto/true/false")
-    if not supported or jax.default_backend() == "cpu":
+    if unsupported_reason is not None or jax.default_backend() == "cpu":
         return False
     est = (
         estimated_bytes
@@ -838,6 +1012,16 @@ def resolve_device_buffer(
         else estimate_ring_bytes(obs_space, actions_dim, buffer_size, n_envs)
     )
     return est <= int(cfg.buffer.get("device_max_bytes", 8_000_000_000))
+
+
+def _mesh_kwargs(fabric: Any) -> Dict[str, Any]:
+    """Constructor kwargs that place the ring on ``fabric``'s mesh: the
+    env-axis sharding on a (>1 device) pure data-parallel mesh, single-device
+    otherwise — :func:`resolve_device_buffer` has already rejected every
+    topology the ring cannot serve."""
+    if fabric.world_size > 1:
+        return {"mesh": fabric.mesh, "data_axis": fabric.data_axis}
+    return {}
 
 
 def make_sequential_replay(
@@ -857,7 +1041,15 @@ def make_sequential_replay(
     from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 
     if resolve_device_buffer(cfg, fabric, obs_space, actions_dim, buffer_size, num_envs):
-        return DeviceReplayBuffer(buffer_size, n_envs=num_envs, obs_keys=obs_keys, seed=seed)
+        rb = DeviceReplayBuffer(
+            buffer_size,
+            n_envs=num_envs,
+            obs_keys=obs_keys,
+            seed=seed,
+            **(_mesh_kwargs(fabric)),
+        )
+        assert ("sharded" in repr(rb)) == (fabric.world_size > 1), repr(rb)
+        return rb
     return EnvIndependentReplayBuffer(
         buffer_size,
         n_envs=num_envs,
@@ -896,7 +1088,15 @@ def make_transition_replay(
     if resolve_device_buffer(
         cfg, fabric, obs_space, actions_dim, buffer_size, num_envs, estimated_bytes=est
     ):
-        return DeviceReplayBuffer(buffer_size, n_envs=num_envs, obs_keys=obs_keys, seed=seed)
+        rb = DeviceReplayBuffer(
+            buffer_size,
+            n_envs=num_envs,
+            obs_keys=obs_keys,
+            seed=seed,
+            **(_mesh_kwargs(fabric)),
+        )
+        assert ("sharded" in repr(rb)) == (fabric.world_size > 1), repr(rb)
+        return rb
     return ReplayBuffer(
         buffer_size,
         num_envs,

@@ -78,10 +78,18 @@ def sampled_batches(
         return
 
     local = rb.sample(batch_size, sequence_length=sequence_length, n_samples=n_samples)
+    # the prefetch-off path honours the same placement contract as the
+    # prefetcher: on a (single-process) mesh, batches go up pre-sharded over
+    # the data axis instead of landing replicated and resharding inside jit
+    sharding = None
+    if getattr(fabric, "num_processes", 1) == 1 and getattr(fabric, "world_size", 1) > 1:
+        sharding = fabric.sharding(None, fabric.data_axis)
     for i in range(n_samples):
         batch = stage(local, i)
         if getattr(fabric, "num_processes", 1) > 1:
             batch = fabric.make_global(batch, (None, fabric.data_axis))
+        elif sharding is not None:
+            batch = to_device(batch, sharding=sharding)
         yield batch
 
 

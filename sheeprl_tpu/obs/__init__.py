@@ -30,6 +30,7 @@ from sheeprl_tpu.obs.telemetry import (
     shutdown_telemetry,
     telemetry_advance,
     telemetry_env_step,
+    telemetry_fused_fallback,
     telemetry_mark_warm,
     telemetry_masked_slot,
     telemetry_register_flops,
@@ -47,6 +48,7 @@ __all__ = [
     "span",
     "telemetry_advance",
     "telemetry_env_step",
+    "telemetry_fused_fallback",
     "telemetry_mark_warm",
     "telemetry_masked_slot",
     "telemetry_register_flops",

@@ -121,6 +121,8 @@ class RunTelemetry:
         self._window_worker_restarts = 0
         self._total_worker_restarts = 0
         self._total_masked_slots = 0
+        # why fused supersteps fell back to per-step dispatch (reason -> count)
+        self._fused_fallbacks: Dict[str, int] = {}
 
     # -- core event plumbing -------------------------------------------------
 
@@ -198,6 +200,15 @@ class RunTelemetry:
         nslots = len(slots) if isinstance(slots, (list, tuple)) else 1
         self._total_masked_slots += nslots
         self.emit("masked_slot", worker=worker, slots=slots, reason=reason, **fields)
+        self.writer.flush()
+
+    def record_fused_fallback(self, reason: str, detail: str = "", **fields: Any) -> None:
+        """``algo.fused_gradient_steps`` was requested but this run dispatches
+        per-step: one structured ``fused_fallback`` event + run_end counter,
+        so ``bench.py --dispatch-stats`` can say *why* a run shows zero fused
+        windows instead of silently reporting O(K) dispatches."""
+        self._fused_fallbacks[reason] = self._fused_fallbacks.get(reason, 0) + 1
+        self.emit("fused_fallback", reason=reason, detail=detail, **fields)
         self.writer.flush()
 
     def _resolve_flops(self) -> Optional[float]:
@@ -375,6 +386,7 @@ class RunTelemetry:
             compile_cache_misses=self.watchdog.cache_misses,
             worker_restarts=self._total_worker_restarts,
             masked_slots=self._total_masked_slots,
+            fused_fallbacks=dict(self._fused_fallbacks),
         )
         self.watchdog.stop()
         self.writer.close()
@@ -465,6 +477,14 @@ def telemetry_worker_restart(worker: int, reason: str, restarts: int, **fields: 
     tel = _active_telemetry
     if tel is not None:
         tel.record_worker_restart(worker, reason, restarts, **fields)
+
+
+def telemetry_fused_fallback(reason: str, detail: str = "", **fields: Any) -> None:
+    """Record a fused-superstep fallback on the active telemetry (see
+    :meth:`RunTelemetry.record_fused_fallback`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_fused_fallback(reason, detail, **fields)
 
 
 def telemetry_masked_slot(worker: int, slots: Any, reason: str, **fields: Any) -> None:

@@ -23,15 +23,29 @@ numerically equivalent to K sequential train calls:
   invariant that param buffers stay alive for concurrent readers (async
   param streaming to the host player) holds inside the fused path too.
   Only ``aux`` (optimizer/moments state) is donated.
+
+On a pure data-parallel mesh the whole superstep (scan included) runs under
+``parallel.shard_map`` over ``fabric.data_axis``: params/opt carries stay
+replicated (the train body ``pmean``s its gradients, matching the per-step
+sharded path's reduction semantics), the replay context is sharded along the
+env axis so every device samples and gathers shard-locally at fixed shapes,
+and the per-step metric vectors are already ``pmean``-reduced by the train
+body before the scan stacks them — the window is still ONE dispatch and ONE
+(replicated) fetch, now spanning the slice.
 """
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from sheeprl_tpu.obs.telemetry import telemetry_fused_fallback
+from sheeprl_tpu.parallel.shard_map import shard_map
 
 # decorrelates the in-graph replay draw from the train stream: the scan body
 # hands ``gather`` the step's train key, and sampling gathers fold it with
@@ -39,10 +53,47 @@ from jax import lax
 SAMPLE_KEY_SALT = 0x5EED
 
 
-def fold_sample_key(key: jax.Array) -> jax.Array:
+def fold_sample_key(key: jax.Array, axis_name: Optional[str] = None) -> jax.Array:
     """Derive the replay-sampling key of one superstep iteration from its
-    train key (see :data:`SAMPLE_KEY_SALT`)."""
-    return jax.random.fold_in(key, SAMPLE_KEY_SALT)
+    train key (see :data:`SAMPLE_KEY_SALT`). Inside a ``shard_map``ped
+    superstep pass ``axis_name`` so the salted key is additionally folded
+    with ``lax.axis_index`` — each device then draws its own batch shard
+    from a decorrelated stream while the carried key stays replicated."""
+    key = jax.random.fold_in(key, SAMPLE_KEY_SALT)
+    if axis_name is not None:
+        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+    return key
+
+
+# ---------------------------------------------------------------------------
+# Fused-fallback bookkeeping (warn once per reason per run + telemetry event)
+# ---------------------------------------------------------------------------
+
+_warned_fallback_reasons: set = set()
+
+
+def reset_fused_fallback_warnings() -> None:
+    """Re-arm the warn-once filter; the algo mains call this when a run
+    starts so back-to-back in-process runs each warn again."""
+    _warned_fallback_reasons.clear()
+
+
+def fused_fallback(reason: str, detail: str) -> None:
+    """Record that ``algo.fused_gradient_steps`` could not fuse this run and
+    it dispatches per-step instead.
+
+    Emits a structured ``fused_fallback`` telemetry event (always — so
+    ``bench.py --dispatch-stats`` can report *why* a run shows zero fused
+    windows) and raises a ``UserWarning`` exactly once per ``reason`` per
+    run. Known reasons: ``"host_buffer"`` (SAC-family in-scan gather needs
+    the device replay ring), ``"model_axis"`` (fused supersteps are pure
+    data-parallel; GSPMD model sharding keeps the per-step path), and
+    ``"multi_process"`` (the scan cannot span process boundaries).
+    """
+    telemetry_fused_fallback(reason, detail)
+    if reason not in _warned_fallback_reasons:
+        _warned_fallback_reasons.add(reason)
+        warnings.warn(detail, UserWarning, stacklevel=3)
 
 
 def pregathered(ctx: Any, key: jax.Array, step_index: jax.Array) -> Any:
@@ -86,6 +137,9 @@ def make_superstep_fn(
     num_steps: int,
     *,
     pre_step: Optional[Callable[[Any, Any, jax.Array], Tuple[Any, Any]]] = None,
+    mesh=None,
+    data_axis: Optional[str] = None,
+    ctx_spec=None,
 ):
     """Wrap one un-jitted gradient step into a donated ``jax.jit(lax.scan)``
     over ``num_steps`` steps.
@@ -103,6 +157,14 @@ def make_superstep_fn(
     - ``pre_step(params, aux, counter) -> (params, aux)`` — optional hook run
       before each step's gather/train (the EMA target refresh,
       :func:`periodic_target_ema`).
+    - ``mesh`` / ``data_axis`` / ``ctx_spec`` — pass all three on a pure
+      data-parallel mesh to run the whole scan under ``shard_map`` over
+      ``data_axis``. ``ctx_spec`` is the ``PartitionSpec`` pytree prefix for
+      ``sample_ctx`` (the sharded replay ring's ``(P(axis), P(axis),
+      P(axis))`` or a pre-gathered ``P(None, None, axis)`` batch stack);
+      every carry stays replicated, so the ``train_body`` MUST ``pmean`` its
+      gradients/metrics over ``data_axis`` and in-scan gathers must fold the
+      sampling key with ``axis_name=data_axis``.
 
     Returns a jitted ``superstep(params, aux, counter, sample_ctx, key) ->
     (params, aux, key, metrics)`` where ``counter`` is the run's cumulative
@@ -129,6 +191,19 @@ def make_superstep_fn(
             jnp.arange(num_steps, dtype=jnp.int32),
         )
         return params, aux, key, metrics
+
+    if mesh is not None:
+        if data_axis is None or ctx_spec is None:
+            raise ValueError("sharded supersteps need both 'data_axis' and 'ctx_spec'")
+        # carries (params/aux/counter/key) are replicated; only the replay
+        # context is sharded. The train body's pmean keeps the replicated
+        # out_specs sound, exactly like the per-step sharded train fns.
+        superstep = shard_map(
+            superstep,
+            mesh,
+            in_specs=(P(), P(), P(), ctx_spec, P()),
+            out_specs=(P(), P(), P(), P()),
+        )
 
     # donate only aux: params stay un-donated (concurrent readers — the async
     # param stream to the host player — may be in flight), and sample_ctx

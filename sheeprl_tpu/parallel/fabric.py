@@ -260,6 +260,14 @@ class Fabric:
         """This process's share of the data axis (its sampling quota)."""
         return max(1, self.local_device_count // self.model_parallel_size)
 
+    @property
+    def pure_data_parallel(self) -> bool:
+        """True when the whole mesh is one process × one data axis — the only
+        topology where explicit-collective SPMD (``shard_map`` supersteps,
+        the sharded replay ring) is sound: no param axis to cut across, and
+        every shard of the scan lives in this process's dispatch."""
+        return self.num_processes == 1 and self.model_axis is None
+
     # ------------------------------------------------------------------ #
     # placement
     # ------------------------------------------------------------------ #

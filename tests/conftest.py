@@ -94,6 +94,52 @@ def run_two_process(code: str, argv=(), cwd=None, extra_env=None, timeout=540):
     return run_multi_process(code, argv=argv, cwd=cwd, extra_env=extra_env, timeout=timeout, nproc=2)
 
 
+@pytest.fixture()
+def multichip_run():
+    """Run a module-qualified helper over a virtual ``n_devices`` CPU mesh in
+    a FRESH subprocess (the ``__graft_entry__`` ``_SHEEPRL_TPU_DRYRUN_CHILD``
+    pattern): this pytest process is pinned to 8 virtual devices at import
+    time, so tests that need a different mesh size (e.g. the 4-device vs
+    1-device sharded-superstep equivalence pair, marked ``multichip``) fork a
+    child with its own ``--xla_force_host_platform_device_count``. Usage::
+
+        out = multichip_run("tests.test_parallel.test_x:helper", 4, str(tmp))
+
+    ``target`` is ``module:function``; extra args are passed through as
+    strings. Returns the child's combined stdout/stderr, asserting rc == 0."""
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "import sys, importlib, jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "mod, fn = sys.argv[1].split(':')\n"
+        "getattr(importlib.import_module(mod), fn)(*sys.argv[2:])\n"
+    )
+
+    def run(target: str, n_devices: int, *argv, timeout: int = 540, extra_env=None):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={int(n_devices)}"
+        env["_SHEEPRL_TPU_DRYRUN_CHILD"] = "1"
+        env["PYTHONPATH"] = os.pathsep.join(p for p in (repo_root, env.get("PYTHONPATH")) if p)
+        env.update(extra_env or {})
+        proc = subprocess.run(
+            [sys.executable, "-c", code, target, *map(str, argv)],
+            env=env,
+            cwd=repo_root,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            timeout=timeout,
+        )
+        assert proc.returncode == 0, f"multichip child ({target}, {n_devices} devices) failed:\n{proc.stdout[-4000:]}"
+        return proc.stdout
+
+    return run
+
+
 @pytest.fixture(autouse=True)
 def _no_env_leaks():
     """Fail a test that leaks SHEEPRL_TPU_* env vars (reference conftest.py:20-61)."""

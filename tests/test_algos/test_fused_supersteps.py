@@ -83,13 +83,53 @@ def test_dreamer_v3_fused_host_buffer_pregathers(tmp_path, monkeypatch):
     assert find_checkpoints(tmp_path)
 
 
-def test_dreamer_v3_fused_multi_device_falls_back_with_warning(tmp_path, monkeypatch):
-    """On the 8-device test mesh the fused path must warn and fall back to
-    the per-step train fn, not crash inside shard_map."""
+def test_dreamer_v3_fused_multi_device_single_dispatch_per_window(tmp_path, monkeypatch, recwarn):
+    """ISSUE acceptance: on a pure data-parallel mesh the fused path no
+    longer falls back — the whole K-step scan runs under shard_map over the
+    sharded device ring, each window is ONE dispatch, and no fallback
+    warning or ``fused_fallback`` telemetry event is emitted."""
     monkeypatch.chdir(tmp_path)
-    with pytest.warns(UserWarning, match="single-process single-device"):
-        run(dv3_args(tmp_path) + ["algo.fused_gradient_steps=4"])
+    args = [
+        a
+        for a in dv3_args(tmp_path)
+        if a != "dry_run=True" and not a.startswith("buffer.size=")
+    ]
+    run(
+        args
+        + [
+            "fabric.devices=2",
+            "buffer.device=True",
+            "buffer.size=64",
+            "algo.total_steps=8",
+            "algo.learning_starts=2",
+            "algo.fused_gradient_steps=256",
+        ]
+        + TELEMETRY
+    )
     assert find_checkpoints(tmp_path)
+    assert not [
+        w for w in recwarn if "falling back" in str(w.message)
+    ], [str(w.message) for w in recwarn]
+
+    end, path = _run_end(tmp_path)
+    assert end["train_windows"] >= 2
+    assert end["train_dispatches"] == end["train_windows"]
+    assert end["train_gradient_steps"] > end["train_windows"]
+    assert not end.get("fused_fallbacks")
+
+    ds = _bench().dispatch_stats(path)
+    assert ds["dispatches_per_window"] == 1.0
+    assert "fused_fallbacks" not in ds
+
+
+def test_dreamer_v3_fused_multi_device_host_buffer_pregathers(tmp_path, monkeypatch, recwarn):
+    """The host-buffer pregather fallback fuses on a mesh too: the stacked
+    [K, T, B] batches go up batch-axis sharded and the shard_map'd scan
+    slices them without warning or falling back."""
+    monkeypatch.chdir(tmp_path)
+    run(dv3_args(tmp_path) + ["fabric.devices=2", "algo.fused_gradient_steps=2"])
+    assert find_checkpoints(tmp_path)
+    assert not [w for w in recwarn if "falling back" in str(w.message)]
 
 
 def test_sac_fused_device_buffer_single_dispatch_per_window(tmp_path, monkeypatch):
@@ -116,11 +156,20 @@ def test_sac_fused_device_buffer_single_dispatch_per_window(tmp_path, monkeypatc
 
 def test_sac_fused_host_buffer_falls_back_with_warning(tmp_path, monkeypatch):
     """SAC's host-buffer path already scans each chunk in one jit, so
-    fused_gradient_steps without buffer.device warns and is ignored."""
+    fused_gradient_steps without buffer.device warns (once) and is ignored —
+    and the reason lands in run_end / ``bench.py --dispatch-stats`` so a
+    per-step run is diagnosable after the fact."""
     monkeypatch.chdir(tmp_path)
     with pytest.warns(UserWarning, match="device replay buffer"):
-        run(sac_args(tmp_path) + ["fabric.devices=1", "algo.fused_gradient_steps=4"])
+        run(
+            sac_args(tmp_path)
+            + ["fabric.devices=1", "algo.fused_gradient_steps=4"]
+            + TELEMETRY
+        )
     assert find_checkpoints(tmp_path)
+    end, path = _run_end(tmp_path)
+    assert end["fused_fallbacks"] == {"host_buffer": 1}
+    assert _bench().dispatch_stats(path)["fused_fallbacks"] == {"host_buffer": 1}
 
 
 def test_droq_fused_device_buffer_dispatch_budget(tmp_path, monkeypatch):

@@ -57,3 +57,5 @@ def test_dryrun_parent_never_imports_jax():
     assert proc.returncode == 0, f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n{proc.stderr[-2000:]}"
     assert "PARENT-NEVER-IMPORTED-JAX" in proc.stdout
     assert "fused train step OK" in proc.stdout
+    # the K=2 fused superstep window over the sharded ring compiled and ran
+    assert "fused superstep OK" in proc.stdout
