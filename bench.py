@@ -408,6 +408,94 @@ def env_stats_summary(events_or_path) -> dict:
     return out
 
 
+def resilience_stats(events_or_path) -> dict:
+    """Checkpoint/rollback health from a run's telemetry stream
+    (sheeprl_tpu/resilience, howto/resilience.md): ``ckpt/snapshot`` (the only
+    part that blocks the train loop under ``checkpoint.async_save``) and
+    ``ckpt/write`` span percentiles with the async/sync dispatch split,
+    every ``ckpt_committed``/``ckpt_skipped`` step, the ``nan_rollback``
+    events (restored path, remaining budget), ``preempt`` signals and
+    ``resume_fallback``/``auto_resume`` decisions. Totals prefer run_end
+    (they cover the trailing unflushed window), falling back to the event
+    stream for a still-running or preempted run."""
+    events = (
+        read_telemetry(events_or_path) if isinstance(events_or_path, str) else list(events_or_path)
+    )
+    out: dict = {}
+
+    for span_name, key in (("ckpt/snapshot", "snapshot"), ("ckpt/write", "write")):
+        durs, sync_count = [], 0
+        for e in events:
+            if e.get("event") == "span" and e.get("name") == span_name:
+                durs.append(float(e.get("dur", 0.0)))
+                if (e.get("attrs") or {}).get("sync"):
+                    sync_count += 1
+        if not durs:
+            continue
+        durs.sort()
+        stats = {
+            "count": len(durs),
+            "total_s": round(sum(durs), 3),
+            "p50_ms": round(_percentile(durs, 50) * 1e3, 3),
+            "p95_ms": round(_percentile(durs, 95) * 1e3, 3),
+            "max_ms": round(durs[-1] * 1e3, 3),
+        }
+        if key == "write":
+            stats["sync_count"] = sync_count
+            stats["async_count"] = len(durs) - sync_count
+        out[key] = stats
+
+    commits = [e for e in events if e.get("event") == "ckpt_committed"]
+    if commits:
+        out["committed_steps"] = [int(e.get("ckpt_step", 0) or 0) for e in commits]
+        if any(e.get("emergency") for e in commits):
+            out["emergency_steps"] = [
+                int(e.get("ckpt_step", 0) or 0) for e in commits if e.get("emergency")
+            ]
+    skipped = [e for e in events if e.get("event") == "ckpt_skipped"]
+    if skipped:
+        out["skipped_steps"] = [int(e.get("ckpt_step", 0) or 0) for e in skipped]
+    rollbacks = [e for e in events if e.get("event") == "nan_rollback"]
+    if rollbacks:
+        out["nan_rollbacks"] = [
+            {
+                "update": e.get("update"),
+                "path": e.get("path"),
+                "reason": e.get("reason"),
+                "remaining": e.get("remaining"),
+            }
+            for e in rollbacks
+        ]
+    preempts = [e for e in events if e.get("event") == "preempt"]
+    if preempts:
+        out["preempts"] = [{"signum": e.get("signum"), "step": e.get("step")} for e in preempts]
+    fallbacks = [e for e in events if e.get("event") == "resume_fallback"]
+    if fallbacks:
+        out["resume_fallbacks"] = [
+            {"path": e.get("path"), "error": e.get("error")} for e in fallbacks
+        ]
+    resumed = [e for e in events if e.get("event") == "auto_resume"]
+    if resumed:
+        out["auto_resume"] = [
+            {"path": e.get("path"), "ckpt_step": e.get("ckpt_step")} for e in resumed
+        ]
+
+    totals = {
+        "ckpt_commits": len(commits),
+        "ckpt_skipped": len(skipped),
+        "nan_rollbacks": len(rollbacks),
+        "preemptions": len(preempts),
+        "resume_fallbacks": len(fallbacks),
+    }
+    for e in events:
+        if e.get("event") == "run_end":
+            for k in totals:
+                totals[k] = int(e.get(k, 0) or 0)
+            break
+    out["totals"] = totals
+    return out
+
+
 def _ppo_args(total_steps: int):
     return [
         "exp=ppo",
@@ -735,8 +823,17 @@ if __name__ == "__main__":
         help="report rollout-pool health from a run's telemetry.jsonl "
         "(env step latency percentiles, worker restarts, masked slots) and exit",
     )
+    parser.add_argument(
+        "--resilience-stats",
+        metavar="PATH",
+        help="report checkpoint/rollback health from a run's telemetry.jsonl "
+        "(ckpt snapshot/write span percentiles, skipped saves, NaN rollbacks, "
+        "preemptions, auto-resume decisions) and exit",
+    )
     args = parser.parse_args()
-    if args.env_stats:
+    if args.resilience_stats:
+        print(json.dumps(resilience_stats(args.resilience_stats), indent=1))
+    elif args.env_stats:
         print(json.dumps(env_stats_summary(args.env_stats), indent=1))
     elif args.dispatch_stats:
         print(json.dumps(dispatch_stats(args.dispatch_stats)))

@@ -36,6 +36,7 @@ from sheeprl_tpu.obs import (
     telemetry_register_flops,
 )
 from sheeprl_tpu.ops.math import gae
+from sheeprl_tpu.resilience import RunResilience
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -94,6 +95,7 @@ def main(fabric, cfg: Dict[str, Any]):
     fabric.logger = logger
     logger.log_hyperparams(cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg))
     print(f"Log dir: {log_dir}")
+    resil = RunResilience(fabric, cfg, log_dir)
 
     rank = fabric.process_index
     num_envs = int(cfg.env.num_envs)
@@ -181,8 +183,27 @@ def main(fabric, cfg: Dict[str, Any]):
     next_obs, _ = envs.reset(seed=cfg.seed)
     next_obs = prepare_obs(next_obs, num_envs=num_envs)
 
+    def ckpt_state_fn(completed_update: int) -> Dict[str, Any]:
+        return {
+            "agent": jax.device_get(params),
+            "opt_state": jax.device_get(opt_state),
+            "update": completed_update,
+            "batch_size": int(cfg.algo.per_rank_batch_size) * world_size,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+        }
+
+    def ckpt_path_fn(step: int) -> str:
+        return os.path.join(log_dir, "checkpoint", f"ckpt_{step}_{rank}.ckpt")
+
+    preempted = False
     for update in range(start_update, num_updates + 1):
         telemetry_advance(policy_step)
+        if resil.preempt_requested():
+            last_checkpoint = policy_step
+            resil.emergency_checkpoint(ckpt_path_fn(policy_step), ckpt_state_fn(update - 1))
+            preempted = True
+            break
         if update == start_update + 1:
             # no bench probe in this loop — warm the recompile watchdog here
             telemetry_mark_warm()
@@ -245,6 +266,15 @@ def main(fabric, cfg: Dict[str, Any]):
         with timer("Time/train_time"):
             params, opt_state, metrics = train_fn(params, opt_state, flat)
             metrics = jax.block_until_ready(metrics)
+        if not resil.check_finite(np.asarray(metrics), update):
+            # restore the newest committed checkpoint and fork the action key
+            # away from the stream that diverged; the loop keeps advancing
+            restored = resil.rollback(update=update)
+            params = resil.place_like(restored["agent"], params)
+            opt_state = resil.place_like(restored["opt_state"], opt_state)
+            player_key = resil.resalt_key(player_key)
+            player.update_params(params)
+            continue
         player.params = params
         train_step += num_processes
         if update == start_update:
@@ -270,18 +300,12 @@ def main(fabric, cfg: Dict[str, Any]):
             update == num_updates and cfg.checkpoint.save_last
         ):
             last_checkpoint = policy_step
-            ckpt_state = {
-                "agent": jax.device_get(params),
-                "opt_state": jax.device_get(opt_state),
-                "update": update,
-                "batch_size": int(cfg.algo.per_rank_batch_size) * world_size,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
-            }
-            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
-            fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
+            fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path_fn(policy_step), state=ckpt_state_fn(update))
 
     envs.close()
-    if fabric.is_global_zero and cfg.algo.run_test:
+    if fabric.is_global_zero and cfg.algo.run_test and not preempted:
         test(player, fabric, cfg, log_dir)
     logger.finalize()
+    resil.close()
+    if preempted:
+        resil.exit_preempted()

@@ -75,6 +75,7 @@ from sheeprl_tpu.obs import (
     telemetry_train_window,
 )
 from sheeprl_tpu.ops.math import MomentsState, compute_lambda_values, init_moments, update_moments
+from sheeprl_tpu.resilience import RunResilience
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -377,6 +378,7 @@ def make_fused_train_fn(
     gather,
     num_steps: int,
     ctx_spec=None,
+    check_finite: bool = False,
 ):
     """``num_steps`` gradient steps — replay gather, EMA target refresh and
     train body — fused into ONE donated dispatch (``algo.fused_gradient_steps``;
@@ -390,7 +392,9 @@ def make_fused_train_fn(
     The jitted fn's signature is ``(params, aux, counter, sample_ctx, key) ->
     (params, aux, key, metrics[num_steps, len(METRIC_ORDER)])`` with
     ``params = (wm, actor, critic, target_critic)`` (un-donated) and ``aux =
-    (world_opt, actor_opt, critic_opt, moments_state)`` (donated)."""
+    (world_opt, actor_opt, critic_opt, moments_state)`` (donated).
+    ``check_finite=True`` appends the superstep's ``[num_steps]`` finite
+    vector (resilience NaN sentinel) as a fifth output."""
     local_train, use_shard_map = make_train_step(
         fabric, wm, actor, critic, world_tx, actor_tx, critic_tx, cfg, is_continuous, actions_dim
     )
@@ -419,6 +423,7 @@ def make_fused_train_fn(
         mesh=fabric.mesh if use_shard_map else None,
         data_axis=fabric.data_axis if use_shard_map else None,
         ctx_spec=ctx_spec,
+        check_finite=check_finite,
     )
 
 
@@ -437,6 +442,7 @@ def main(fabric, cfg: Dict[str, Any]):
     fabric.logger = logger
     logger.log_hyperparams(cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg))
     print(f"Log dir: {log_dir}")
+    resil = RunResilience(fabric, cfg, log_dir)
 
     rank = fabric.process_index
     num_envs = int(cfg.env.num_envs)
@@ -639,6 +645,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 fused_gather,
                 n,
                 ctx_spec=fused_ctx_spec,
+                check_finite=resil.finite_checks,
             )
         return fn
 
@@ -680,6 +687,59 @@ def main(fabric, cfg: Dict[str, Any]):
     step_data["is_first"] = np.ones_like(step_data["terminated"])
     player.init_states()
 
+    def ckpt_state_fn(completed_update: int) -> Dict[str, Any]:
+        return {
+            "world_model": jax.device_get(wm_params),
+            "actor": jax.device_get(actor_params),
+            "critic": jax.device_get(critic_params),
+            "target_critic": jax.device_get(target_critic_params),
+            "world_optimizer": jax.device_get(world_opt),
+            "actor_optimizer": jax.device_get(actor_opt),
+            "critic_optimizer": jax.device_get(critic_opt),
+            "moments": {
+                "low": np.asarray(jax.device_get(moments_state.low)),
+                "high": np.asarray(jax.device_get(moments_state.high)),
+            },
+            "ratio": ratio.state_dict(),
+            "update": completed_update,
+            "batch_size": per_rank_batch_size * world_size,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "rng_key": jax.device_get(key),
+            "player_rng_key": jax.device_get(player_key),
+        }
+
+    def ckpt_path_fn(step: int) -> str:
+        return os.path.join(log_dir, "checkpoint", f"ckpt_{step}_{rank}.ckpt")
+
+    def nan_rollback(at_update: int) -> None:
+        # restore the full train state (params, target, the three optimizers,
+        # return-normalizer moments, replay ratio) from the newest committed
+        # checkpoint and fork the sample key away from the stream that
+        # diverged; the env/replay side is NOT rolled back — the buffer only
+        # ever holds observations, which a NaN train step cannot poison
+        nonlocal wm_params, actor_params, critic_params, target_critic_params
+        nonlocal world_opt, actor_opt, critic_opt, moments_state, key
+        restored = resil.rollback(update=at_update)
+        wm_params = resil.place_like(restored["world_model"], wm_params)
+        actor_params = resil.place_like(restored["actor"], actor_params)
+        critic_params = resil.place_like(restored["critic"], critic_params)
+        target_critic_params = resil.place_like(restored["target_critic"], target_critic_params)
+        world_opt = resil.place_like(restored["world_optimizer"], world_opt)
+        actor_opt = resil.place_like(restored["actor_optimizer"], actor_opt)
+        critic_opt = resil.place_like(restored["critic_optimizer"], critic_opt)
+        moments_state = MomentsState(
+            low=resil.place_like(np.asarray(restored["moments"]["low"]), moments_state.low),
+            high=resil.place_like(np.asarray(restored["moments"]["high"]), moments_state.high),
+        )
+        ratio.load_state_dict(restored["ratio"])
+        if "rng_key" in restored:
+            key = resil.place_like(restored["rng_key"], key)
+        key = resil.resalt_key(key)
+        pending_metrics.clear()  # the poisoned window must not reach the logger
+        player.update_params(wm_params, actor_params)
+
+    preempted = False
     cumulative_per_rank_gradient_steps = 0
     pending_metrics: list = []  # device-resident metric vectors, fetched at log time
     # the loop never blocks on the accelerator; the fence keeps it at most a
@@ -697,6 +757,18 @@ def main(fabric, cfg: Dict[str, Any]):
     last_grad_steps = 0  # heartbeat window: train_fn invocations since last log
     for update in range(start_step, num_updates + 1):
         telemetry_advance(policy_step)
+        if resil.preempt_requested():
+            # drain the dispatch queue before snapshotting: the state fn's
+            # device_get would otherwise fetch mid-flight donated buffers
+            fence.drain()
+            last_checkpoint = policy_step
+            resil.emergency_checkpoint(
+                ckpt_path_fn(policy_step),
+                ckpt_state_fn(update - 1),
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+            preempted = True
+            break
         probe.mark_warm(update, learning_starts, policy_step, work=cumulative_per_rank_gradient_steps)
         policy_step += num_envs * num_processes
 
@@ -807,6 +879,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 # fused path: the whole window is ceil(G / K) superstep
                 # dispatches — gather + EMA + train scanned inside XLA
                 window_dispatches = 0
+                window_finite: list = []  # [chunk] bool vectors, one per dispatch
                 with timer("Time/train_time"):
                     n_left = per_rank_gradient_steps
                     while n_left > 0:
@@ -835,7 +908,15 @@ def main(fabric, cfg: Dict[str, Any]):
                                 (params, aux, counter, ctx, key),
                             )
                             bench_superstep = (superstep, chunk, shapes)
-                        params, aux, key, metrics = superstep(params, aux, counter, ctx, key)
+                        if resil.finite_checks:
+                            # the sentinel rides the same dispatch: a [chunk]
+                            # finite vector instead of an extra program
+                            params, aux, key, metrics, chunk_finite = superstep(
+                                params, aux, counter, ctx, key
+                            )
+                            window_finite.append(chunk_finite)
+                        else:
+                            params, aux, key, metrics = superstep(params, aux, counter, ctx, key)
                         wm_params, actor_params, critic_params, target_critic_params = params
                         world_opt, actor_opt, critic_opt, moments_state = aux
                         cumulative_per_rank_gradient_steps += chunk
@@ -850,6 +931,14 @@ def main(fabric, cfg: Dict[str, Any]):
                 telemetry_train_window(window_dispatches, per_rank_gradient_steps)
                 player.update_params(wm_params, actor_params)
                 fence.push(metrics)
+                # one tiny fetch per window: the [chunk] finite vectors the
+                # superstep computed in-dispatch, reduced on the host
+                if not resil.window_ok(
+                    all(bool(np.all(np.asarray(jax.device_get(f)))) for f in window_finite),
+                    update,
+                ):
+                    nan_rollback(update)
+                    continue
             elif per_rank_gradient_steps > 0:
                 # each process samples its share of the global batch
                 # batch i+1's host->HBM transfer overlaps gradient step i
@@ -932,6 +1021,14 @@ def main(fabric, cfg: Dict[str, Any]):
                     # loop (one chip round trip per train block); the queue
                     # drains at log time instead
                     pending_metrics.append(metrics)
+                if resil.finite_checks and not resil.check_finite(
+                    # the window's LAST metric vector: NaNs in params propagate
+                    # to every later loss, so one fetch per window suffices
+                    np.asarray(jax.device_get(metrics)),
+                    update,
+                ):
+                    nan_rollback(update)
+                    continue
 
         # ---------------- logging ---------------- #
         if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or update == num_updates):
@@ -970,31 +1067,10 @@ def main(fabric, cfg: Dict[str, Any]):
             update == num_updates and cfg.checkpoint.save_last
         ):
             last_checkpoint = policy_step
-            ckpt_state = {
-                "world_model": jax.device_get(wm_params),
-                "actor": jax.device_get(actor_params),
-                "critic": jax.device_get(critic_params),
-                "target_critic": jax.device_get(target_critic_params),
-                "world_optimizer": jax.device_get(world_opt),
-                "actor_optimizer": jax.device_get(actor_opt),
-                "critic_optimizer": jax.device_get(critic_opt),
-                "moments": {
-                    "low": np.asarray(jax.device_get(moments_state.low)),
-                    "high": np.asarray(jax.device_get(moments_state.high)),
-                },
-                "ratio": ratio.state_dict(),
-                "update": update,
-                "batch_size": per_rank_batch_size * world_size,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
-                "rng_key": jax.device_get(key),
-                "player_rng_key": jax.device_get(player_key),
-            }
-            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
             fabric.call(
                 "on_checkpoint_coupled",
-                ckpt_path=ckpt_path,
-                state=ckpt_state,
+                ckpt_path=ckpt_path_fn(policy_step),
+                state=ckpt_state_fn(update),
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
@@ -1034,6 +1110,9 @@ def main(fabric, cfg: Dict[str, Any]):
     # model registration use the last update's weights
     player.flush_stream_attrs()
     envs.close()
-    if fabric.is_global_zero and cfg.algo.run_test:
+    if fabric.is_global_zero and cfg.algo.run_test and not preempted:
         test(player, fabric, cfg, log_dir, greedy=False)
     logger.finalize()
+    resil.close()
+    if preempted:
+        resil.exit_preempted()

@@ -42,6 +42,7 @@ from sheeprl_tpu.envs import build_vector_env
 from sheeprl_tpu.obs import log_sps_and_heartbeat, telemetry_advance, telemetry_register_flops
 from sheeprl_tpu.ops.math import gae
 from sheeprl_tpu.parallel.fabric import put_tree, resolve_player_device, resolve_train_device
+from sheeprl_tpu.resilience import RunResilience
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -147,6 +148,9 @@ def main(fabric, cfg: Dict[str, Any]):
     fabric.logger = logger
     logger.log_hyperparams(cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg))
     print(f"Log dir: {log_dir}")
+
+    # preemption watcher + non-finite sentinel + checkpoint rollback
+    resil = RunResilience(fabric, cfg, log_dir)
 
     initial_clip_coef = float(cfg.algo.clip_coef)
     initial_ent_coef = float(cfg.algo.ent_coef)
@@ -290,9 +294,35 @@ def main(fabric, cfg: Dict[str, Any]):
     # compile-heavy first update — shared contract in utils.SteadyStateProbe
     from sheeprl_tpu.utils.utils import SteadyStateProbe
 
+    def ckpt_state_fn(completed_update: int) -> Dict[str, Any]:
+        # shared by the periodic save, the preemption drain's emergency save
+        # and (structurally) the rollback restore — reads the loop's CURRENT
+        # bindings at call time
+        return {
+            "agent": jax.device_get(params),
+            "opt_state": jax.device_get(opt_state),
+            "update": completed_update,
+            "batch_size": int(cfg.algo.per_rank_batch_size) * world_size,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "rng_key": jax.device_get(key),
+            "player_rng_key": jax.device_get(player_key),
+        }
+
+    def ckpt_path_fn(step: int) -> str:
+        return os.path.join(log_dir, "checkpoint", f"ckpt_{step}_{rank}.ckpt")
+
+    preempted = False
     probe = SteadyStateProbe()
     for update in range(start_update, num_updates + 1):
         telemetry_advance(policy_step)
+        if resil.preempt_requested():
+            # update has NOT run yet: the emergency checkpoint records
+            # update-1 so auto-resume replays from exactly this boundary
+            last_checkpoint = policy_step
+            resil.emergency_checkpoint(ckpt_path_fn(policy_step), ckpt_state_fn(update - 1))
+            preempted = True
+            break
         if update == start_update + 1:
             probe.mark(policy_step)
         rollout = {k: [] for k in (*obs_keys, "dones", "values", "actions", "logprobs", "rewards")}
@@ -389,6 +419,19 @@ def main(fabric, cfg: Dict[str, Any]):
                 np.float32(ent_coef),
             )
             metrics = jax.block_until_ready(metrics)
+        if not resil.check_finite(np.asarray(metrics), update):
+            # restore the newest committed checkpoint in place of the
+            # poisoned params/opt state, fork the sample key away from the
+            # stream that diverged, and move on to the next update — the
+            # loop's counters keep advancing so the run still completes
+            restored = resil.rollback(update=update)
+            params = resil.place_like(restored["agent"], params)
+            opt_state = resil.place_like(restored["opt_state"], opt_state)
+            if "rng_key" in restored:
+                key = resil.place_like(restored["rng_key"], key)
+            key = resil.resalt_key(key)
+            player.update_params(params)
+            continue
         player.update_params(params)
         train_step += world_size
         if update == start_update:
@@ -431,23 +474,15 @@ def main(fabric, cfg: Dict[str, Any]):
             update == num_updates and cfg.checkpoint.save_last
         ):
             last_checkpoint = policy_step
-            ckpt_state = {
-                "agent": jax.device_get(params),
-                "opt_state": jax.device_get(opt_state),
-                "update": update,
-                "batch_size": int(cfg.algo.per_rank_batch_size) * world_size,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
-                "rng_key": jax.device_get(key),
-                "player_rng_key": jax.device_get(player_key),
-            }
-            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
-            fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
+            fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path_fn(policy_step), state=ckpt_state_fn(update))
 
     # the params fetch is a real device sync (everything dispatched before
     # it has executed once it materializes)
     probe.finish(policy_step, sync=lambda: jax.device_get(jax.tree.leaves(params)[0]))
     envs.close()
-    if fabric.is_global_zero and cfg.algo.run_test:
+    if fabric.is_global_zero and cfg.algo.run_test and not preempted:
         test(player, fabric, cfg, log_dir)
     logger.finalize()
+    resil.close()
+    if preempted:
+        resil.exit_preempted()

@@ -162,6 +162,18 @@ class SACPlayer(HostPlayerParams):
         return np.asarray(self._sample(self.params, obs, put_tree(key, self.device)))
 
 
+def finite_action_bounds(action_space: gymnasium.spaces.Box) -> Tuple[Tuple[float, ...], Tuple[float, ...]]:
+    """Per-dimension (low, high) with non-finite bounds clamped to ±1: an
+    unbounded Box means "no rescale", and a literal ``inf`` scale would turn
+    the tanh-squashed action (and every loss downstream) into NaN."""
+    low = np.asarray(action_space.low, np.float32).ravel()
+    high = np.asarray(action_space.high, np.float32).ravel()
+    unbounded = ~(np.isfinite(low) & np.isfinite(high))
+    low = np.where(unbounded, -1.0, low).astype(np.float32)
+    high = np.where(unbounded, 1.0, high).astype(np.float32)
+    return tuple(low.tolist()), tuple(high.tolist())
+
+
 def build_agent(
     fabric: Any,
     cfg: Dict[str, Any],
@@ -175,11 +187,12 @@ def build_agent(
     obs_dim = int(sum(np.prod(obs_space[k].shape) for k in cfg["algo"]["mlp_keys"]["encoder"]))
     dtype = fabric.precision.compute_dtype
 
+    action_low, action_high = finite_action_bounds(action_space)
     actor = SACActor(
         action_dim=act_dim,
         hidden_size=int(cfg["algo"]["actor"]["hidden_size"]),
-        action_low=tuple(np.asarray(action_space.low, np.float32).ravel().tolist()),
-        action_high=tuple(np.asarray(action_space.high, np.float32).ravel().tolist()),
+        action_low=action_low,
+        action_high=action_high,
         dtype=dtype,
     )
     n_critics = int(cfg["algo"]["critic"]["n"])

@@ -42,6 +42,7 @@ from sheeprl_tpu.data.device_buffer import draw_transition_batch
 from sheeprl_tpu.envs import build_vector_env
 from sheeprl_tpu.obs import log_sps_and_heartbeat, telemetry_advance, telemetry_train_window
 from sheeprl_tpu.ops.superstep import fold_sample_key, fused_fallback, reset_fused_fallback_warnings
+from sheeprl_tpu.resilience import RunResilience
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -240,6 +241,7 @@ def main(fabric, cfg: Dict[str, Any]):
     fabric.logger = logger
     logger.log_hyperparams(cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg))
     print(f"Log dir: {log_dir}")
+    resil = RunResilience(fabric, cfg, log_dir)
 
     envs = build_vector_env(cfg, rank, log_dir if rank == 0 else None, "train")
     action_space = envs.single_action_space
@@ -401,10 +403,42 @@ def main(fabric, cfg: Dict[str, Any]):
     obs, _ = envs.reset(seed=cfg.seed)
     cumulative_per_rank_gradient_steps = 0
     step_data: Dict[str, np.ndarray] = {}
+
+    def ckpt_state_fn(completed_update: int) -> Dict[str, Any]:
+        return {
+            "agent": {
+                "actor": jax.device_get(agent.actor_params),
+                "critics": jax.device_get(agent.critic_params),
+                "target_critics": jax.device_get(agent.target_critic_params),
+                "log_alpha": jax.device_get(agent.log_alpha),
+            },
+            "qf_optimizer": jax.device_get(critic_opt),
+            "actor_optimizer": jax.device_get(actor_opt),
+            "alpha_optimizer": jax.device_get(alpha_opt),
+            "ratio": ratio.state_dict(),
+            "update": completed_update,
+            "batch_size": per_rank_batch_size * world_size,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+        }
+
+    def ckpt_path_fn(step: int) -> str:
+        return os.path.join(log_dir, "checkpoint", f"ckpt_{step}_{rank}.ckpt")
+
+    preempted = False
     # steady-state throughput probe (SHEEPRL_TPU_BENCH_JSON contract)
     probe = SteadyStateProbe()
     for update in range(start_step, num_updates + 1):
         telemetry_advance(policy_step)
+        if resil.preempt_requested():
+            last_checkpoint = policy_step
+            resil.emergency_checkpoint(
+                ckpt_path_fn(policy_step),
+                ckpt_state_fn(update - 1),
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+            preempted = True
+            break
         probe.mark_warm(update, learning_starts, policy_step, work=cumulative_per_rank_gradient_steps)
         policy_step += num_envs * num_processes
 
@@ -528,9 +562,29 @@ def main(fabric, cfg: Dict[str, Any]):
             if per_rank_gradient_steps > 0:
                 telemetry_train_window(window_dispatches, per_rank_gradient_steps)
                 train_step += num_processes  # one "train event" per update
+                # one fetch serves both the sentinel and the aggregator
+                window_metrics = weighted_chunk_metrics(chunk_metrics)
+                if not resil.check_finite(window_metrics, update):
+                    # restore the newest committed checkpoint over the whole
+                    # train state (params + all three optimizers) and fork
+                    # the sample key away from the stream that diverged
+                    restored = resil.rollback(update=update)
+                    ra = restored["agent"]
+                    agent.actor_params = resil.place_like(ra["actor"], agent.actor_params)
+                    agent.critic_params = resil.place_like(ra["critics"], agent.critic_params)
+                    agent.target_critic_params = resil.place_like(
+                        ra["target_critics"], agent.target_critic_params
+                    )
+                    agent.log_alpha = resil.place_like(ra["log_alpha"], agent.log_alpha)
+                    actor_opt = resil.place_like(restored["actor_optimizer"], actor_opt)
+                    critic_opt = resil.place_like(restored["qf_optimizer"], critic_opt)
+                    alpha_opt = resil.place_like(restored["alpha_optimizer"], alpha_opt)
+                    key = resil.resalt_key(key)
+                    player.update_params(agent.actor_params)
+                    continue
                 player.update_params(agent.actor_params)
                 if cfg.metric.log_level > 0:
-                    metrics = weighted_chunk_metrics(chunk_metrics)
+                    metrics = window_metrics
                     aggregator.update("Loss/value_loss", float(metrics[0]))
                     aggregator.update("Loss/policy_loss", float(metrics[1]))
                     aggregator.update("Loss/alpha_loss", float(metrics[2]))
@@ -557,27 +611,10 @@ def main(fabric, cfg: Dict[str, Any]):
             update == num_updates and cfg.checkpoint.save_last
         ):
             last_checkpoint = policy_step
-            ckpt_state = {
-                "agent": {
-                    "actor": jax.device_get(agent.actor_params),
-                    "critics": jax.device_get(agent.critic_params),
-                    "target_critics": jax.device_get(agent.target_critic_params),
-                    "log_alpha": jax.device_get(agent.log_alpha),
-                },
-                "qf_optimizer": jax.device_get(critic_opt),
-                "actor_optimizer": jax.device_get(actor_opt),
-                "alpha_optimizer": jax.device_get(alpha_opt),
-                "ratio": ratio.state_dict(),
-                "update": update,
-                "batch_size": per_rank_batch_size * world_size,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
-            }
-            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
             fabric.call(
                 "on_checkpoint_coupled",
-                ckpt_path=ckpt_path,
-                state=ckpt_state,
+                ckpt_path=ckpt_path_fn(policy_step),
+                state=ckpt_state_fn(update),
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
@@ -590,6 +627,9 @@ def main(fabric, cfg: Dict[str, Any]):
     # land any in-flight async param stream before the final evaluation
     player.flush_stream_attrs()
     envs.close()
-    if fabric.is_global_zero and cfg.algo.run_test:
+    if fabric.is_global_zero and cfg.algo.run_test and not preempted:
         test(player, fabric, cfg, log_dir)
     logger.finalize()
+    resil.close()
+    if preempted:
+        resil.exit_preempted()

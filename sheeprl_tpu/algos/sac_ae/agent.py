@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.algos.sac.agent import finite_action_bounds
 from sheeprl_tpu.models import MLP
 from sheeprl_tpu.parallel.fabric import HostPlayerParams, put_tree, resolve_player_device
 
@@ -373,11 +374,12 @@ def build_agent(
         screen_size=screen,
         dtype=dtype,
     )
+    action_low, action_high = finite_action_bounds(action_space)
     actor = SACAEActorTrunk(
         action_dim=act_dim,
         hidden_size=int(algo["hidden_size"]),
-        action_low=tuple(np.asarray(action_space.low, np.float32).ravel().tolist()),
-        action_high=tuple(np.asarray(action_space.high, np.float32).ravel().tolist()),
+        action_low=action_low,
+        action_high=action_high,
         dtype=dtype,
     )
     n_critics = int(algo["critic"]["n"])

@@ -175,10 +175,18 @@ def run_algorithm(cfg: dotdict) -> None:
     # `tensorboard --logdir <root>` picks up the profile plugin data; the
     # telemetry JSONL lands beside them at <root>/telemetry.jsonl
     configure_telemetry(cfg, log_dir=run_base_dir(cfg))
+    # auto-resume resolution ran before telemetry existed — flush its events
+    from sheeprl_tpu.resilience import drain_async_checkpoints, emit_pending_resilience_events
+
+    emit_pending_resilience_events()
     try:
         with maybe_profile(cfg, log_dir=run_base_dir(cfg)):
             entrypoint(fabric, cfg, **kwargs)
     finally:
+        # a background checkpoint write may still be in flight (including the
+        # save_last one) — join it before closing the telemetry sink so its
+        # ckpt_committed event makes the run_end totals
+        drain_async_checkpoints()
         shutdown_telemetry()
 
 
@@ -187,6 +195,12 @@ def run(args: Optional[List[str]] = None) -> None:
     overrides = list(sys.argv[1:] if args is None else args)
     cfg = compose("config", overrides)
     cfg = dotdict(cfg)
+    if cfg.checkpoint.resume_from == "auto":
+        # resolve to a concrete committed checkpoint path (newest valid under
+        # this run's base dir) — or None, which starts a fresh run
+        from sheeprl_tpu.resilience import resolve_auto_resume
+
+        cfg.checkpoint.resume_from = resolve_auto_resume(cfg)
     if cfg.checkpoint.resume_from:
         cfg = resume_from_checkpoint(cfg, cli_overrides=overrides)
     if cfg.metric.log_level > 0:

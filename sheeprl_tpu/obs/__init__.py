@@ -29,11 +29,16 @@ from sheeprl_tpu.obs.telemetry import (
     get_telemetry,
     shutdown_telemetry,
     telemetry_advance,
+    telemetry_ckpt_commit,
+    telemetry_ckpt_skipped,
     telemetry_env_step,
     telemetry_fused_fallback,
     telemetry_mark_warm,
     telemetry_masked_slot,
+    telemetry_nan_rollback,
+    telemetry_preemption,
     telemetry_register_flops,
+    telemetry_resume_fallback,
     telemetry_train_window,
     telemetry_worker_restart,
 )
@@ -47,11 +52,16 @@ __all__ = [
     "shutdown_telemetry",
     "span",
     "telemetry_advance",
+    "telemetry_ckpt_commit",
+    "telemetry_ckpt_skipped",
     "telemetry_env_step",
     "telemetry_fused_fallback",
     "telemetry_mark_warm",
     "telemetry_masked_slot",
+    "telemetry_nan_rollback",
+    "telemetry_preemption",
     "telemetry_register_flops",
+    "telemetry_resume_fallback",
     "telemetry_train_window",
     "telemetry_worker_restart",
 ]

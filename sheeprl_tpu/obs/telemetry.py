@@ -123,6 +123,14 @@ class RunTelemetry:
         self._total_masked_slots = 0
         # why fused supersteps fell back to per-step dispatch (reason -> count)
         self._fused_fallbacks: Dict[str, int] = {}
+        # resilience accounting (sheeprl_tpu.resilience): committed/skipped
+        # checkpoint saves, NaN rollbacks, preemption requests, auto-resume
+        # fallbacks — events at each occurrence + run_end totals
+        self._total_ckpt_commits = 0
+        self._total_ckpt_skipped = 0
+        self._total_nan_rollbacks = 0
+        self._total_preemptions = 0
+        self._total_resume_fallbacks = 0
 
     # -- core event plumbing -------------------------------------------------
 
@@ -209,6 +217,45 @@ class RunTelemetry:
         windows instead of silently reporting O(K) dispatches."""
         self._fused_fallbacks[reason] = self._fused_fallbacks.get(reason, 0) + 1
         self.emit("fused_fallback", reason=reason, detail=detail, **fields)
+        self.writer.flush()
+
+    def record_ckpt_commit(self, path: str, step: int, backend: str, emergency: bool = False, **fields: Any) -> None:
+        """A checkpoint committed (manifest landed): one ``ckpt_committed``
+        event + run_end counter. ``emergency=True`` marks the preemption
+        drain's final save."""
+        self._total_ckpt_commits += 1
+        self.emit("ckpt_committed", path=path, ckpt_step=int(step), backend=backend, emergency=bool(emergency), **fields)
+        self.writer.flush()
+
+    def record_ckpt_skipped(self, path: str, step: int, **fields: Any) -> None:
+        """An async save request arrived while one was still in flight and
+        was dropped: one ``ckpt_skipped`` event + run_end counter. The next
+        checkpoint interval retries with fresher state, so nothing is lost
+        beyond that interval's granularity."""
+        self._total_ckpt_skipped += 1
+        self.emit("ckpt_skipped", path=path, ckpt_step=int(step), **fields)
+        self.writer.flush()
+
+    def record_nan_rollback(self, path: Optional[str], reason: str, remaining: int, **fields: Any) -> None:
+        """The non-finite sentinel tripped and the run restored from the last
+        committed checkpoint: one ``nan_rollback`` event + run_end counter."""
+        self._total_nan_rollbacks += 1
+        self.emit("nan_rollback", path=path, reason=reason, remaining=int(remaining), **fields)
+        self.writer.flush()
+
+    def record_preemption(self, signum: int, **fields: Any) -> None:
+        """A preemption signal (SIGTERM/SIGINT) reached the train-loop
+        boundary: one ``preempt`` event + run_end counter."""
+        self._total_preemptions += 1
+        self.emit("preempt", signum=int(signum), **fields)
+        self.writer.flush()
+
+    def record_resume_fallback(self, path: str, error: str, **fields: Any) -> None:
+        """``resume_from=auto`` rejected a candidate checkpoint (load failure
+        or mesh mismatch) and fell back to the next-newest: one
+        ``resume_fallback`` event + run_end counter."""
+        self._total_resume_fallbacks += 1
+        self.emit("resume_fallback", path=path, error=error, **fields)
         self.writer.flush()
 
     def _resolve_flops(self) -> Optional[float]:
@@ -333,6 +380,25 @@ class RunTelemetry:
         if self._total_masked_slots:
             fields["masked_slots_total"] = self._total_masked_slots
             scalars["Counters/masked_slots"] = float(self._total_masked_slots)
+        # checkpoint duty-cycle: only the snapshot span blocks the train loop
+        # (the write happens on the background thread), so the heartbeat
+        # reports them separately
+        ckpt_snap_t = float(timer_window.get("ckpt/snapshot") or 0.0)
+        ckpt_write_t = float(timer_window.get("ckpt/write") or 0.0)
+        if ckpt_snap_t > 0:
+            fields["window_ckpt_snapshot_time"] = ckpt_snap_t
+            scalars["Telemetry/ckpt_snapshot_time"] = ckpt_snap_t
+        if ckpt_write_t > 0:
+            fields["window_ckpt_write_time"] = ckpt_write_t
+        if self._total_ckpt_commits:
+            fields["ckpt_commits_total"] = self._total_ckpt_commits
+            scalars["Counters/ckpt_commits"] = float(self._total_ckpt_commits)
+        if self._total_ckpt_skipped:
+            fields["ckpt_skipped_total"] = self._total_ckpt_skipped
+            scalars["Counters/ckpt_skipped"] = float(self._total_ckpt_skipped)
+        if self._total_nan_rollbacks:
+            fields["nan_rollbacks_total"] = self._total_nan_rollbacks
+            scalars["Counters/nan_rollbacks"] = float(self._total_nan_rollbacks)
         if env_t > 0:
             fields["sps_env"] = env_steps / env_t
         if train_t > 0:
@@ -387,6 +453,11 @@ class RunTelemetry:
             worker_restarts=self._total_worker_restarts,
             masked_slots=self._total_masked_slots,
             fused_fallbacks=dict(self._fused_fallbacks),
+            ckpt_commits=self._total_ckpt_commits,
+            ckpt_skipped=self._total_ckpt_skipped,
+            nan_rollbacks=self._total_nan_rollbacks,
+            preemptions=self._total_preemptions,
+            resume_fallbacks=self._total_resume_fallbacks,
         )
         self.watchdog.stop()
         self.writer.close()
@@ -493,6 +564,46 @@ def telemetry_masked_slot(worker: int, slots: Any, reason: str, **fields: Any) -
     tel = _active_telemetry
     if tel is not None:
         tel.record_masked_slot(worker, slots, reason, **fields)
+
+
+def telemetry_ckpt_commit(path: str, step: int, backend: str, emergency: bool = False, **fields: Any) -> None:
+    """Record a committed checkpoint (see
+    :meth:`RunTelemetry.record_ckpt_commit`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_ckpt_commit(path, step, backend, emergency, **fields)
+
+
+def telemetry_ckpt_skipped(path: str, step: int, **fields: Any) -> None:
+    """Record a dropped async save request (see
+    :meth:`RunTelemetry.record_ckpt_skipped`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_ckpt_skipped(path, step, **fields)
+
+
+def telemetry_nan_rollback(path: Optional[str], reason: str, remaining: int, **fields: Any) -> None:
+    """Record a non-finite rollback (see
+    :meth:`RunTelemetry.record_nan_rollback`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_nan_rollback(path, reason, remaining, **fields)
+
+
+def telemetry_preemption(signum: int, **fields: Any) -> None:
+    """Record a preemption request (see
+    :meth:`RunTelemetry.record_preemption`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_preemption(signum, **fields)
+
+
+def telemetry_resume_fallback(path: str, error: str, **fields: Any) -> None:
+    """Record an auto-resume candidate rejection (see
+    :meth:`RunTelemetry.record_resume_fallback`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_resume_fallback(path, error, **fields)
 
 
 def telemetry_register_flops(jitted_fn: Any, *args: Any, scale: float = 1.0) -> None:

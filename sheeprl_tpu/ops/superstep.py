@@ -140,6 +140,7 @@ def make_superstep_fn(
     mesh=None,
     data_axis: Optional[str] = None,
     ctx_spec=None,
+    check_finite: bool = False,
 ):
     """Wrap one un-jitted gradient step into a donated ``jax.jit(lax.scan)``
     over ``num_steps`` steps.
@@ -171,9 +172,19 @@ def make_superstep_fn(
     gradient-step count entering the window (int32 scalar), ``key`` comes
     back evolved by ``num_steps`` splits, and ``metrics`` is the scan-stacked
     ``[num_steps, ...]`` per-step metric output, fetched once per window.
+
+    ``check_finite=True`` (the resilience non-finite sentinel,
+    ``resilience.check_finite``) appends a fifth output: a ``[num_steps]``
+    boolean vector, ``finite[i]`` true iff every inexact leaf of step ``i``'s
+    metrics AND post-update params was finite. Computed in-graph per step
+    (:func:`sheeprl_tpu.resilience.all_finite`), so the window still costs
+    one dispatch — the host only pays the check when it fetches metrics it
+    already wanted.
     """
     if num_steps <= 0:
         raise ValueError(f"'num_steps' ({num_steps}) must be greater than 0")
+
+    from sheeprl_tpu.resilience.sentinel import all_finite
 
     def superstep(params, aux, counter, sample_ctx, key):
         def body(carry, step_index):
@@ -183,14 +194,22 @@ def make_superstep_fn(
             key, k_train = jax.random.split(key)
             batch = gather(sample_ctx, k_train, step_index)
             params, aux, metrics = train_body(params, aux, batch, k_train)
-            return (params, aux, counter + 1, key), metrics
+            out = metrics
+            if check_finite:
+                # metrics catch NaN losses; params catch an Inf that reached
+                # the weights while the reported losses still looked sane
+                out = (metrics, all_finite((metrics, params)))
+            return (params, aux, counter + 1, key), out
 
-        (params, aux, _, key), metrics = lax.scan(
+        (params, aux, _, key), out = lax.scan(
             body,
             (params, aux, jnp.asarray(counter, jnp.int32), key),
             jnp.arange(num_steps, dtype=jnp.int32),
         )
-        return params, aux, key, metrics
+        if check_finite:
+            metrics, finite = out
+            return params, aux, key, metrics, finite
+        return params, aux, key, out
 
     if mesh is not None:
         if data_axis is None or ctx_spec is None:
@@ -202,7 +221,7 @@ def make_superstep_fn(
             superstep,
             mesh,
             in_specs=(P(), P(), P(), ctx_spec, P()),
-            out_specs=(P(), P(), P(), P()),
+            out_specs=(P(), P(), P(), P(), P()) if check_finite else (P(), P(), P(), P()),
         )
 
     # donate only aux: params stay un-donated (concurrent readers — the async

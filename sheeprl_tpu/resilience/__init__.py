@@ -1,0 +1,73 @@
+"""Training-loop resilience (preemptible-TPU survival kit).
+
+Four cooperating pieces, configured under ``checkpoint.*`` / ``resilience.*``
+and documented in ``howto/resilience.md``:
+
+- **Async checkpointing** (:mod:`~sheeprl_tpu.resilience.async_writer`) —
+  the loop blocks for the host snapshot only; serialization + commit run on
+  a background thread with at-most-one save in flight.
+- **Atomic commit manifests** (:mod:`~sheeprl_tpu.resilience.manifest`) —
+  a checkpoint exists iff its manifest does; pruning, auto-resume and
+  rollback only ever see committed checkpoints and GC torn writes.
+- **Preemption watcher + auto-resume**
+  (:mod:`~sheeprl_tpu.resilience.preemption`,
+  :mod:`~sheeprl_tpu.resilience.autoresume`) — SIGTERM drains to an
+  emergency checkpoint and exits :data:`PREEMPTED_EXIT_CODE`;
+  ``checkpoint.resume_from=auto`` finds the newest valid checkpoint.
+- **Non-finite sentinel + rollback**
+  (:mod:`~sheeprl_tpu.resilience.sentinel`,
+  :meth:`RunResilience.rollback`) — NaN/Inf training metrics restore the
+  last committed checkpoint under a ``resilience.max_rollbacks`` budget.
+"""
+
+from sheeprl_tpu.resilience.async_writer import (
+    AsyncCheckpointWriter,
+    drain_async_checkpoints,
+    get_async_writer,
+)
+from sheeprl_tpu.resilience.autoresume import (
+    emit_pending_resilience_events,
+    queue_resilience_event,
+    resolve_auto_resume,
+    scan_run_checkpoints,
+)
+from sheeprl_tpu.resilience.manager import ROLLBACK_KEY_SALT, RunResilience
+from sheeprl_tpu.resilience.manifest import (
+    CommittedCheckpoint,
+    build_manifest,
+    checkpoint_step,
+    committed_checkpoints,
+    gc_torn,
+    is_committed,
+    read_manifest,
+    torn_checkpoints,
+    write_manifest,
+)
+from sheeprl_tpu.resilience.preemption import PREEMPTED_EXIT_CODE, PreemptionWatcher
+from sheeprl_tpu.resilience.sentinel import all_finite, host_all_finite, parse_nan_faults
+
+__all__ = [
+    "AsyncCheckpointWriter",
+    "CommittedCheckpoint",
+    "PREEMPTED_EXIT_CODE",
+    "PreemptionWatcher",
+    "ROLLBACK_KEY_SALT",
+    "RunResilience",
+    "all_finite",
+    "build_manifest",
+    "checkpoint_step",
+    "committed_checkpoints",
+    "drain_async_checkpoints",
+    "emit_pending_resilience_events",
+    "gc_torn",
+    "get_async_writer",
+    "host_all_finite",
+    "is_committed",
+    "parse_nan_faults",
+    "queue_resilience_event",
+    "read_manifest",
+    "resolve_auto_resume",
+    "scan_run_checkpoints",
+    "torn_checkpoints",
+    "write_manifest",
+]

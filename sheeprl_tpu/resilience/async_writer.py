@@ -1,0 +1,104 @@
+"""Background checkpoint writer: the train loop pays snapshot time only.
+
+The caller (``CheckpointCallback``) snapshots state to host under the
+blocking ``ckpt/snapshot`` span, then hands a zero-argument ``write_fn`` to
+:meth:`AsyncCheckpointWriter.submit`; serialization + atomic commit + prune
+run on a daemon thread under the ``ckpt/write`` span. At most one save is
+ever in flight — a submit that arrives while the previous write is still
+running is DROPPED (one ``ckpt_skipped`` telemetry event); the next
+checkpoint interval retries with fresher state, which is strictly better
+than queueing stale snapshots.
+
+A failed background write never kills the run: the exception is warned,
+recorded as a ``ckpt_error`` event, and surfaced to the next ``drain()``
+caller (the preemption path drains before its emergency save, so a broken
+writer degrades to a synchronous save instead of a lost checkpoint).
+"""
+
+from __future__ import annotations
+
+import threading
+import warnings
+from typing import Callable, Optional
+
+from sheeprl_tpu.obs import get_telemetry, span, telemetry_ckpt_skipped
+
+_writer_lock = threading.Lock()
+_writer: Optional["AsyncCheckpointWriter"] = None
+
+
+class AsyncCheckpointWriter:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._inflight_path: Optional[str] = None
+        self._last_error: Optional[BaseException] = None
+        self.submitted = 0
+        self.skipped = 0
+
+    @property
+    def busy(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    @property
+    def last_error(self) -> Optional[BaseException]:
+        return self._last_error
+
+    def record_skip(self, path: str = "", step: int = 0) -> None:
+        """Account a dropped save request (caller saw ``busy`` and chose not
+        to pay for a snapshot): one ``ckpt_skipped`` event + counter."""
+        self.skipped += 1
+        telemetry_ckpt_skipped(path, step, in_flight=self._inflight_path)
+
+    def submit(self, write_fn: Callable[[], None], *, path: str = "", step: int = 0) -> bool:
+        """Run ``write_fn`` on the background thread. Returns ``False`` (and
+        emits ``ckpt_skipped``) when a previous write is still in flight."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                self.record_skip(path, step)
+                return False
+            self._inflight_path = path
+            self.submitted += 1
+            self._thread = threading.Thread(
+                target=self._run, args=(write_fn, path, step), name="ckpt-writer", daemon=True
+            )
+            self._thread.start()
+            return True
+
+    def _run(self, write_fn: Callable[[], None], path: str, step: int) -> None:
+        try:
+            with span("ckpt/write", path=path, ckpt_step=step):
+                write_fn()
+        except BaseException as exc:  # never let a save failure kill the run
+            self._last_error = exc
+            warnings.warn(f"async checkpoint write for {path!r} failed: {exc!r}")
+            tel = get_telemetry()
+            if tel is not None:
+                tel.emit("ckpt_error", path=path, ckpt_step=int(step), error=repr(exc))
+                tel.writer.flush()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Wait for the in-flight write (if any). Returns ``True`` when no
+        write remains in flight afterwards."""
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        return not self.busy
+
+
+def get_async_writer() -> AsyncCheckpointWriter:
+    """The process-wide writer (one in-flight save per process, matching the
+    one-checkpoint-stream-per-process layout)."""
+    global _writer
+    with _writer_lock:
+        if _writer is None:
+            _writer = AsyncCheckpointWriter()
+        return _writer
+
+
+def drain_async_checkpoints(timeout: Optional[float] = None) -> bool:
+    """Join the in-flight background save, if one exists. Safe to call from
+    teardown paths that never configured resilience."""
+    w = _writer
+    return w.drain(timeout) if w is not None else True

@@ -1,0 +1,137 @@
+"""``checkpoint.resume_from=auto``: find the newest valid checkpoint yourself.
+
+A rescheduled job should not need a hand-typed checkpoint path. ``auto``
+scans the run's base directory (``<log_base_dir>/<root_dir>/<run_name>`` —
+every ``version_N`` under it), collects COMMITTED checkpoints via their
+manifests (garbage-collecting torn writes on the way), and walks them newest
+step first:
+
+1. mesh pre-check — the manifest's stored global ``batch_size`` must split
+   over the resuming run's world size (:func:`elastic_per_rank_batch_size`),
+2. validation load — the checkpoint must actually deserialize,
+3. the version dir must still hold the ``config.yaml`` resume merges from.
+
+A candidate failing any gate is skipped with a warning + ``resume_fallback``
+telemetry event and the next-newest is tried. No candidate at all returns
+``None`` — the caller starts a fresh run (that makes ``auto`` safe as a
+standing default for restart-on-preemption supervisors).
+
+Resolution runs in ``cli.run`` BEFORE telemetry exists, so events are queued
+module-side and flushed by ``cli.run_algorithm`` right after
+``configure_telemetry``.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import warnings
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from sheeprl_tpu.resilience.manifest import CommittedCheckpoint, committed_checkpoints, gc_torn
+
+_pending_events: List[Tuple[str, Dict[str, Any]]] = []
+
+
+def queue_resilience_event(kind: str, **fields: Any) -> None:
+    """Stash an event for emission once telemetry is configured."""
+    _pending_events.append((kind, fields))
+
+
+def emit_pending_resilience_events() -> None:
+    """Flush events queued before ``configure_telemetry`` ran (called from
+    ``cli.run_algorithm``); drops them silently when telemetry is off."""
+    from sheeprl_tpu.obs import get_telemetry
+
+    tel = get_telemetry()
+    events, _pending_events[:] = list(_pending_events), []
+    if tel is None:
+        return
+    for kind, fields in events:
+        if kind == "resume_fallback":
+            tel.record_resume_fallback(fields.pop("path", ""), fields.pop("error", ""), **fields)
+        else:
+            tel.emit(kind, **fields)
+    tel.writer.flush()
+
+
+def _expected_world_size(cfg: Mapping[str, Any]) -> Optional[int]:
+    devices = (cfg.get("fabric") or {}).get("devices")
+    try:
+        import jax
+
+        available = jax.device_count()
+    except Exception:
+        return None
+    if devices in (None, "auto", -1, "-1"):
+        return available
+    try:
+        n = int(devices)
+    except (TypeError, ValueError):
+        return available
+    return n if n > 0 else available
+
+
+def scan_run_checkpoints(run_root: str, *, collect_garbage: bool = True) -> List[CommittedCheckpoint]:
+    """Every committed checkpoint under ``run_root``'s ``version_*/checkpoint``
+    dirs, newest first (step, then wall time). Optionally GCs torn writes."""
+    found: List[CommittedCheckpoint] = []
+    for version_dir in sorted(glob.glob(os.path.join(run_root, "version_*"))):
+        ckpt_dir = os.path.join(version_dir, "checkpoint")
+        if collect_garbage:
+            for removed in gc_torn(ckpt_dir):
+                warnings.warn(f"auto-resume: garbage-collected torn checkpoint write {removed!r}")
+        found.extend(committed_checkpoints(ckpt_dir))
+    found.sort(key=lambda c: (c.step, c.manifest.get("wall_time", 0.0)), reverse=True)
+    return found
+
+
+def resolve_auto_resume(cfg: Mapping[str, Any]) -> Optional[str]:
+    """Resolve ``resume_from=auto`` to a concrete checkpoint path (or ``None``
+    for a fresh start). See the module docstring for the candidate gates."""
+    from sheeprl_tpu.utils.checkpoint import elastic_per_rank_batch_size, load_checkpoint
+    from sheeprl_tpu.utils.logger import run_base_dir
+
+    run_root = run_base_dir(cfg)
+    candidates = scan_run_checkpoints(run_root)
+    if not candidates:
+        warnings.warn(
+            f"checkpoint.resume_from=auto found no committed checkpoint under {run_root!r} — "
+            "starting a fresh run"
+        )
+        return None
+    world_size = _expected_world_size(cfg)
+    for cand in candidates:
+        config_path = os.path.join(os.path.dirname(os.path.dirname(cand.path)), "config.yaml")
+        if not os.path.isfile(config_path):
+            _fallback(cand, f"missing {config_path}")
+            continue
+        batch_size = cand.manifest.get("batch_size")
+        if world_size and isinstance(batch_size, int):
+            try:
+                elastic_per_rank_batch_size(batch_size, world_size)
+            except ValueError as exc:
+                _fallback(cand, str(exc))
+                continue
+        try:
+            load_checkpoint(cand.path)
+        except Exception as exc:
+            _fallback(cand, f"validation load failed: {exc!r}")
+            continue
+        queue_resilience_event(
+            "auto_resume", path=cand.path, ckpt_step=cand.step, candidates=len(candidates)
+        )
+        return cand.path
+    warnings.warn(
+        f"checkpoint.resume_from=auto: all {len(candidates)} committed checkpoints under "
+        f"{run_root!r} were rejected — starting a fresh run"
+    )
+    return None
+
+
+def _fallback(cand: CommittedCheckpoint, error: str) -> None:
+    warnings.warn(
+        f"auto-resume: skipping checkpoint {cand.path!r} (step {cand.step}): {error} — "
+        "falling back to the next-newest"
+    )
+    queue_resilience_event("resume_fallback", path=cand.path, error=error, ckpt_step=cand.step)

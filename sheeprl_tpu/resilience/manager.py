@@ -1,0 +1,192 @@
+"""Per-run resilience facade for the algorithm mains.
+
+One ``RunResilience`` per training process bundles the three loop-facing
+behaviours so an algo main wires resilience with four calls:
+
+- ``preempt_requested()`` at the top of each update (collective on
+  multi-host) — on ``True`` the main saves an emergency checkpoint through
+  ``emergency_checkpoint`` and breaks out of the loop; after teardown,
+  ``exit_preempted()`` leaves with :data:`PREEMPTED_EXIT_CODE`.
+- ``check_finite(metrics, update)`` after each train window — applies the
+  deterministic NaN fault injection, then the host-side finite check.
+- ``rollback(...)`` when the check trips — drains the async writer, restores
+  the newest committed checkpoint of THIS run (``<log_dir>/checkpoint``),
+  decrements ``resilience.max_rollbacks`` and emits ``nan_rollback``; an
+  exhausted budget raises instead of looping forever on a diverged run.
+  ``place_like``/``resalt_key`` help the main put restored host arrays back
+  under the live tree's shardings and fork the sample key away from the
+  stream that produced the NaN.
+
+Everything is config-gated under ``resilience.*`` and inert when
+``resilience.enabled=False`` (every poll is then a plain attribute read).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import warnings
+from typing import Any, Dict, Mapping, Optional
+
+from sheeprl_tpu.obs import telemetry_nan_rollback, telemetry_preemption
+from sheeprl_tpu.resilience.async_writer import drain_async_checkpoints
+from sheeprl_tpu.resilience.manifest import committed_checkpoints
+from sheeprl_tpu.resilience.preemption import PREEMPTED_EXIT_CODE, PreemptionWatcher
+from sheeprl_tpu.resilience.sentinel import host_all_finite, parse_nan_faults
+
+# fold_in salt for post-rollback key forking: must differ from the superstep
+# sample salt (ops.superstep.SAMPLE_KEY_SALT) so a rolled-back run cannot
+# replay the exact RNG stream that produced the non-finite step
+ROLLBACK_KEY_SALT = 0x0BAD
+
+
+class RunResilience:
+    def __init__(self, fabric: Any, cfg: Mapping[str, Any], log_dir: str) -> None:
+        res_cfg: Mapping[str, Any] = cfg.get("resilience") or {}
+        self.fabric = fabric
+        self.cfg = cfg
+        self.log_dir = log_dir
+        self.ckpt_dir = os.path.join(log_dir, "checkpoint")
+        self.enabled = bool(res_cfg.get("enabled", True))
+        self.finite_checks = self.enabled and bool(res_cfg.get("check_finite", True))
+        self.max_rollbacks = int(res_cfg.get("max_rollbacks", 3) or 0)
+        self.rollbacks = 0
+        self._nan_faults = parse_nan_faults(res_cfg) if self.enabled else set()
+        self._fired_faults: set = set()
+        self.watcher: Optional[PreemptionWatcher] = None
+        if self.enabled and bool(res_cfg.get("preemption", True)):
+            self.watcher = PreemptionWatcher().install()
+        self._preempt_reported = False
+
+    # -- preemption ----------------------------------------------------------
+
+    def preempt_requested(self) -> bool:
+        """Poll at the update boundary. COLLECTIVE on multi-host runs (all
+        ranks must call it at the same point); free single-process."""
+        if not self.enabled or self.watcher is None:
+            return False
+        hit = self.watcher.should_preempt(self.fabric.num_processes)
+        if hit and not self._preempt_reported:
+            self._preempt_reported = True
+            telemetry_preemption(self.watcher.signum or 0)
+            warnings.warn(
+                "preemption signal received — draining in-flight saves and writing an "
+                "emergency checkpoint"
+            )
+        return hit
+
+    def emergency_checkpoint(self, ckpt_path: str, state: Dict[str, Any], replay_buffer: Any = None) -> None:
+        """Drain the in-flight async save, then checkpoint synchronously
+        through the normal callback path (manifest marked ``emergency``)."""
+        drain_async_checkpoints()
+        self.fabric.call(
+            "on_checkpoint_coupled",
+            ckpt_path=ckpt_path,
+            state=state,
+            replay_buffer=replay_buffer,
+            emergency=True,
+        )
+
+    def exit_preempted(self) -> None:
+        """Leave with the distinct preemption exit code (after teardown)."""
+        if self.watcher is not None:
+            self.watcher.uninstall()
+        sys.exit(PREEMPTED_EXIT_CODE)
+
+    # -- non-finite sentinel -------------------------------------------------
+
+    def check_finite(self, metrics: Any, update: int) -> bool:
+        """``False`` when this update's train metrics contain NaN/Inf (or the
+        fault-injection schedule says to pretend they do)."""
+        if not self.finite_checks:
+            return True
+        return self.window_ok(host_all_finite(metrics), update)
+
+    def window_ok(self, finite: bool, update: int) -> bool:
+        """:meth:`check_finite` for loops that already reduced their own
+        verdict — e.g. the fused superstep's on-device ``[K]`` finite vector
+        (``ops.superstep`` ``check_finite=True``)."""
+        if not self.finite_checks:
+            return True
+        if update in self._nan_faults and update not in self._fired_faults:
+            self._fired_faults.add(update)
+            warnings.warn(f"resilience.fault_injection: forcing non-finite metrics at update {update}")
+            return False
+        return bool(finite)
+
+    def rollback(self, *, update: int, reason: str = "non_finite_metrics") -> Dict[str, Any]:
+        """Restore the newest committed checkpoint's state. Raises when the
+        rollback budget is exhausted or no committed checkpoint exists."""
+        from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+        if self.rollbacks >= self.max_rollbacks:
+            raise RuntimeError(
+                f"non-finite training metrics at update {update} but the rollback budget "
+                f"(resilience.max_rollbacks={self.max_rollbacks}) is exhausted — the run is "
+                "diverging faster than checkpoints can save it; lower the learning rate or "
+                "raise checkpoint frequency"
+            )
+        drain_async_checkpoints()
+        candidates = committed_checkpoints(self.ckpt_dir)
+        path: Optional[str] = candidates[-1].path if candidates else None
+        if path is None:
+            resume_from = (self.cfg.get("checkpoint") or {}).get("resume_from")
+            if resume_from and resume_from != "auto" and os.path.exists(str(resume_from)):
+                path = str(resume_from)
+        if path is None:
+            raise RuntimeError(
+                f"non-finite training metrics at update {update} and no committed checkpoint "
+                "to roll back to — lower checkpoint.every so a rollback point exists"
+            )
+        state = load_checkpoint(path)
+        self.rollbacks += 1
+        remaining = self.max_rollbacks - self.rollbacks
+        telemetry_nan_rollback(path, reason, remaining, update=update)
+        warnings.warn(
+            f"non-finite training metrics at update {update}: rolled back to {path!r} "
+            f"({remaining} rollback(s) left)"
+        )
+        return state
+
+    # -- restore helpers -----------------------------------------------------
+
+    @staticmethod
+    def place_like(host_tree: Any, like_tree: Any) -> Any:
+        """Re-place restored host arrays leaf-by-leaf under the live tree's
+        placements (device + sharding), so a rollback works identically for
+        replicated, sharded and host-pinned parameter trees.
+
+        Single-device UNCOMMITTED leaves (e.g. the RNG key chain, which is a
+        plain ``jax.random.split`` product) must come back uncommitted too: a
+        ``device_put`` would pin them to one device and the next jitted train
+        step would reject mixing them with the mesh-sharded params."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def leaf(new: Any, old: Any) -> Any:
+            if isinstance(old, jax.Array):
+                arr = np.asarray(new)
+                if len(old.sharding.device_set) > 1 or getattr(old, "committed", False):
+                    return jax.device_put(arr, old.sharding)
+                return jnp.asarray(arr)
+            if isinstance(old, np.ndarray):
+                return np.asarray(new)
+            return new
+
+        return jax.tree.map(leaf, host_tree, like_tree)
+
+    def resalt_key(self, key: Any) -> Any:
+        """Fork a restored RNG key away from the stream that diverged: replaying
+        the same sample order into the same params usually reproduces the NaN."""
+        import jax
+
+        return jax.random.fold_in(key, ROLLBACK_KEY_SALT + self.rollbacks)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Drain background saves and release the signal handlers."""
+        drain_async_checkpoints()
+        if self.watcher is not None:
+            self.watcher.uninstall()

@@ -1,0 +1,194 @@
+"""Checkpoint commit manifests.
+
+A checkpoint only EXISTS once its manifest does. Both backends write all of
+their payload first (pickle file / orbax array store + object sidecars), then
+the manifest lands last as the commit marker:
+
+- pickle  -> a ``<ckpt>.manifest.json`` sidecar next to the checkpoint file
+- orbax   -> a ``manifest.json`` INSIDE the checkpoint directory (the whole
+  directory is staged under a temp name and promoted by a single rename, so
+  the manifest is visible exactly when the directory is)
+
+Everything that enumerates checkpoints — pruning, ``resume_from=auto``, the
+NaN-rollback restore — goes through :func:`committed_checkpoints` and
+therefore only ever sees fully-committed checkpoints; entries matching our
+naming scheme WITHOUT a valid manifest are torn writes from a crash and are
+garbage-collected by :func:`gc_torn`. Foreign files are neither counted nor
+deleted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_SUFFIX = ".manifest.json"
+MANIFEST_VERSION = 1
+
+# canonical checkpoint naming: ckpt_<policy_step>_<rank>.ckpt
+CKPT_NAME_RE = re.compile(r"^ckpt_(\d+)_(\d+)\.ckpt$")
+# staging prefix for orbax directory promotes (hidden so nothing mtime-sorts it)
+TMP_PREFIX = ".tmp-"
+
+
+class CommittedCheckpoint(NamedTuple):
+    step: int
+    path: str
+    manifest: Dict[str, Any]
+
+
+def checkpoint_step(name: str) -> Optional[int]:
+    """Policy step encoded in a checkpoint file/dir name, or ``None`` for
+    entries that do not follow the ``ckpt_<step>_<rank>.ckpt`` scheme."""
+    m = CKPT_NAME_RE.match(os.path.basename(name))
+    return int(m.group(1)) if m else None
+
+
+def tree_digest(state: Any) -> Tuple[int, str]:
+    """(leaf count, short structural digest) of a state tree. The digest
+    hashes the sorted keypaths so a resume can detect a checkpoint written by
+    a structurally different model without deserializing the arrays."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    paths = sorted(jax.tree_util.keystr(p) for p, _ in flat)
+    digest = hashlib.md5("\n".join(paths).encode()).hexdigest()[:12]
+    return len(flat), digest
+
+
+def build_manifest(
+    *,
+    step: int,
+    backend: str,
+    world_size: int,
+    state: Any = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    man: Dict[str, Any] = {
+        "version": MANIFEST_VERSION,
+        "step": int(step),
+        "wall_time": time.time(),
+        "backend": backend,
+        "world_size": int(world_size),
+    }
+    if state is not None:
+        man["leaf_count"], man["tree_digest"] = tree_digest(state)
+        if isinstance(state, dict) and isinstance(state.get("batch_size"), int):
+            man["batch_size"] = state["batch_size"]
+    if extra:
+        man.update(extra)
+    return man
+
+
+def manifest_path(ckpt_path: str) -> str:
+    """Where the commit marker of ``ckpt_path`` lives (inside orbax
+    directories, sidecar next to pickle files)."""
+    if os.path.isdir(ckpt_path):
+        return os.path.join(ckpt_path, MANIFEST_NAME)
+    return ckpt_path + MANIFEST_SUFFIX
+
+
+def write_manifest(ckpt_path: str, manifest: Dict[str, Any]) -> str:
+    """Atomically write the commit marker for ``ckpt_path``. Must be the LAST
+    write of a save — its presence is what makes the checkpoint committed."""
+    mpath = manifest_path(ckpt_path)
+    d = os.path.dirname(os.path.abspath(mpath))
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".manifest-", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(manifest, f, indent=0, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, mpath)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+    return mpath
+
+
+def read_manifest(ckpt_path: str) -> Optional[Dict[str, Any]]:
+    """The manifest of ``ckpt_path``, or ``None`` when it is missing or
+    unparseable (i.e. the checkpoint is not committed)."""
+    # probe both layouts so callers need not know the backend up front
+    for mpath in (
+        os.path.join(ckpt_path, MANIFEST_NAME) if os.path.isdir(ckpt_path) else None,
+        ckpt_path + MANIFEST_SUFFIX,
+    ):
+        if mpath is None or not os.path.isfile(mpath):
+            continue
+        try:
+            with open(mpath) as f:
+                man = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if isinstance(man, dict) and isinstance(man.get("step"), int):
+            return man
+        return None
+    return None
+
+
+def is_committed(ckpt_path: str) -> bool:
+    return read_manifest(ckpt_path) is not None
+
+
+def committed_checkpoints(ckpt_dir: str) -> List[CommittedCheckpoint]:
+    """All committed checkpoints in ``ckpt_dir``, oldest step first. Entries
+    that do not match the naming scheme or lack a valid manifest are ignored."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out: List[CommittedCheckpoint] = []
+    for entry in os.listdir(ckpt_dir):
+        step = checkpoint_step(entry)
+        if step is None:
+            continue
+        path = os.path.join(ckpt_dir, entry)
+        man = read_manifest(path)
+        if man is not None:
+            out.append(CommittedCheckpoint(step, path, man))
+    out.sort(key=lambda c: (c.step, c.manifest.get("wall_time", 0.0)))
+    return out
+
+
+def torn_checkpoints(ckpt_dir: str) -> List[str]:
+    """Entries that are OURS but not committed: checkpoints matching the
+    naming scheme without a valid manifest, orphaned staging dirs/files from
+    a crashed save, and manifest sidecars whose checkpoint is gone."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    torn: List[str] = []
+    for entry in os.listdir(ckpt_dir):
+        path = os.path.join(ckpt_dir, entry)
+        if entry.startswith(TMP_PREFIX) or entry.endswith(".tmp"):
+            torn.append(path)
+        elif entry.endswith(MANIFEST_SUFFIX):
+            if not os.path.exists(path[: -len(MANIFEST_SUFFIX)]):
+                torn.append(path)
+        elif checkpoint_step(entry) is not None and read_manifest(path) is None:
+            torn.append(path)
+    return sorted(torn)
+
+
+def gc_torn(ckpt_dir: str) -> List[str]:
+    """Delete torn checkpoint writes. Returns the paths removed. Only called
+    from points where no save is in flight (after a commit, or at resume
+    scan), so a staging dir here is always an orphan."""
+    removed = []
+    for path in torn_checkpoints(ckpt_dir):
+        try:
+            shutil.rmtree(path) if os.path.isdir(path) else os.remove(path)
+            # a torn pickle checkpoint may still have its (stale) sidecar
+            sidecar = path + MANIFEST_SUFFIX
+            if os.path.isfile(sidecar):
+                os.remove(sidecar)
+            removed.append(path)
+        except OSError:
+            pass
+    return removed

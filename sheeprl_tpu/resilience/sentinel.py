@@ -1,0 +1,94 @@
+"""Non-finite sentinel: detect NaN/Inf losses before they poison a run.
+
+Two flavours of the same check:
+
+- :func:`all_finite` — jittable, reduces every inexact leaf of a tree to one
+  boolean scalar. ``ops.superstep`` folds it into the fused scan's per-step
+  metrics (``check_finite=True``) so a K-step superstep reports a ``[K]``
+  finite vector with no extra dispatch.
+- :func:`host_all_finite` — numpy-side check over metrics the loop already
+  fetched; zero device traffic.
+
+Deterministic fault injection mirrors ``rollout.fault_injection.*``: the
+drill config
+
+.. code-block:: yaml
+
+    resilience:
+      fault_injection:
+        enabled: True
+        faults:
+          - {kind: nan, at_update: 3}
+
+forces the sentinel to report non-finite at exactly that update (once), which
+exercises the full rollback path — restore from last committed checkpoint,
+resalted sample key, decremented budget — without numerics games.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Mapping, Set
+
+
+def all_finite(tree: Any) -> Any:
+    """Jittable: one boolean scalar, ``True`` iff every inexact (float /
+    complex) leaf of ``tree`` is finite. Integer/bool leaves are ignored —
+    step counters are always "finite" and isfinite is not defined for them."""
+    import jax
+    import jax.numpy as jnp
+
+    checks = [
+        jnp.all(jnp.isfinite(leaf))
+        for leaf in jax.tree.leaves(tree)
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.inexact)
+    ]
+    if not checks:
+        return jnp.asarray(True)
+    return jnp.stack(checks).all()
+
+
+def host_all_finite(tree: Any) -> bool:
+    """Host-side mirror of :func:`all_finite` over already-fetched values
+    (numpy arrays, python floats). Non-numeric leaves are ignored."""
+    import numpy as np
+
+    def leaves(node: Any) -> Any:
+        if isinstance(node, Mapping):
+            for v in node.values():
+                yield from leaves(v)
+        elif isinstance(node, (list, tuple)):
+            for v in node:
+                yield from leaves(v)
+        else:
+            yield node
+
+    for leaf in leaves(tree):
+        try:
+            arr = np.asarray(leaf)
+        except Exception:
+            continue
+        if arr.dtype.kind in "fc" and not np.isfinite(arr).all():
+            return False
+    return True
+
+
+def parse_nan_faults(res_cfg: Mapping[str, Any]) -> Set[int]:
+    """Updates at which the sentinel must report non-finite, parsed from
+    ``resilience.fault_injection`` (same shape as ``rollout.fault_injection``:
+    an ``enabled`` gate plus a ``faults`` list of ``{kind, at_update}``)."""
+    fi = res_cfg.get("fault_injection") or {}
+    if not bool(fi.get("enabled", False)):
+        return set()
+    updates: Set[int] = set()
+    faults: List[Any] = fi.get("faults") or []
+    for spec in faults:
+        if not isinstance(spec, Mapping):
+            raise ValueError(f"resilience.fault_injection.faults entries must be mappings, got {spec!r}")
+        kind = str(spec.get("kind", "nan"))
+        if kind != "nan":
+            raise ValueError(f"unknown resilience fault kind {kind!r} (only 'nan' is defined)")
+        at = spec.get("at_update")
+        if at is None:
+            raise ValueError(f"resilience fault {spec!r} needs at_update")
+        updates.add(int(at))
+    return updates

@@ -4,16 +4,29 @@ Invoked by algorithms through ``fabric.call("on_checkpoint_coupled", ...)``;
 serialization goes through ``sheeprl_tpu.utils.checkpoint`` (pickle or
 orbax backend) and old checkpoints are pruned with ``keep_last``.
 
+Every save commits a manifest (``sheeprl_tpu.resilience.manifest``) as its
+last write, so pruning / auto-resume / NaN-rollback only ever see complete
+checkpoints. With ``checkpoint.async_save=True`` (single-process runs) the
+hook blocks only for the host snapshot — a ``ckpt/snapshot`` span — and the
+serialization + commit + prune run on the resilience background writer under
+``ckpt/write``; at most one save is in flight and an overlapping request is
+dropped with a ``ckpt_skipped`` event. Multi-process saves stay synchronous:
+both the orbax store's commit barriers and the pickle buffer gather are
+collectives every rank must enter, which a background thread cannot
+guarantee. ``emergency=True`` (the preemption drain) also forces sync.
+
 When a replay buffer rides the checkpoint, the stored copy must be
 self-consistent without the live env state: the last stored step of every
 env is flagged TRUNCATED for the save and restored right after (reference
 ``_ckpt_rb`` / ``_experiment_consistent_rb``, callback.py:87-142); open
-episodes of an ``EpisodeBuffer`` are dropped the same way. On multi-host
-runs the pickle backend gathers every process's buffer over the host-object
-plane into a one-per-process list (reference gloo ``gather_object``,
-callback.py:40-51); the orbax backend skips the gather — each process writes
-its own buffer sidecar next to the sharded array store. Both restore through
-``checkpoint.select_buffer``.
+episodes of an ``EpisodeBuffer`` are dropped the same way. For async saves
+the buffer is deep-snapshotted (pickle round-trip) inside the snapshot span
+so the env loop can keep writing while the background thread serializes. On
+multi-host runs the pickle backend gathers every process's buffer over the
+host-object plane into a one-per-process list (reference gloo
+``gather_object``, callback.py:40-51); the orbax backend skips the gather —
+each process writes its own buffer sidecar next to the sharded array store.
+Both restore through ``checkpoint.select_buffer``.
 """
 
 from __future__ import annotations
@@ -26,9 +39,18 @@ from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, 
 
 
 class CheckpointCallback:
-    def __init__(self, keep_last: Optional[int] = None, backend: str = "pickle") -> None:
+    def __init__(
+        self,
+        keep_last: Optional[int] = None,
+        backend: str = "pickle",
+        async_save: bool = False,
+    ) -> None:
         self.keep_last = keep_last
         self.backend = backend
+        self.async_save = bool(async_save)
+
+    def _use_async(self, fabric: Any, emergency: bool) -> bool:
+        return self.async_save and not emergency and fabric.num_processes == 1
 
     def on_checkpoint_coupled(
         self,
@@ -38,12 +60,16 @@ class CheckpointCallback:
         replay_buffer: Any = None,
         gather_buffers: bool = True,
         backend: str = None,
+        emergency: bool = False,
     ) -> None:
         backend = backend or self.backend
-        rb_state = None
-        if replay_buffer is not None:
-            rb_state = self._ckpt_rb(replay_buffer)
+        from sheeprl_tpu.obs import span, telemetry_ckpt_commit
+        from sheeprl_tpu.resilience.manifest import build_manifest, checkpoint_step
         from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+        step = checkpoint_step(ckpt_path)
+        step = 0 if step is None else step
+        extra = {"emergency": True} if emergency else None
 
         if backend == "orbax":
             # the orbax store coordinates its own multi-process write
@@ -56,19 +82,74 @@ class CheckpointCallback:
                 import re
 
                 path = re.sub(r"_\d+(\.ckpt)$", r"_0\1", ckpt_path)
+            if self._use_async(fabric, emergency):
+                writer = self._writer()
+                if writer.busy:
+                    writer.record_skip(path=path, step=step)
+                    return
+                with span("ckpt/snapshot", path=path, ckpt_step=step):
+                    rb_flags = self._ckpt_rb(replay_buffer) if replay_buffer is not None else None
+                    host_state = self._snapshot_tree(state)
+                    per_proc = (
+                        {"rb": self._snapshot_buffer(replay_buffer)}
+                        if replay_buffer is not None
+                        else None
+                    )
+                    if replay_buffer is not None:
+                        self._experiment_consistent_rb(replay_buffer, rb_flags)
+                manifest = build_manifest(
+                    step=step, backend="orbax", world_size=fabric.world_size, state=host_state, extra=extra
+                )
+                self._submit(writer, path, step, host_state, "orbax", per_proc, manifest)
+                return
+            rb_flags = self._ckpt_rb(replay_buffer) if replay_buffer is not None else None
             per_proc = {"rb": replay_buffer} if replay_buffer is not None else None
-            save_checkpoint(path, state, backend=backend, per_process_state=per_proc)
-        else:
-            if replay_buffer is not None:
-                rb_to_save: Any = replay_buffer
-                if gather_buffers and fabric.num_processes > 1:
-                    from sheeprl_tpu.parallel.collectives import gather_object
-
-                    gathered = gather_object(replay_buffer, dst=0)
-                    rb_to_save = gathered if fabric.is_global_zero else replay_buffer
-                state = {**state, "rb": rb_to_save}
+            manifest = build_manifest(
+                step=step, backend="orbax", world_size=fabric.world_size, state=state, extra=extra
+            )
+            with span("ckpt/write", path=path, ckpt_step=step, sync=True):
+                save_checkpoint(path, state, backend=backend, per_process_state=per_proc, manifest=manifest)
             if fabric.is_global_zero:
-                save_checkpoint(ckpt_path, state, backend=backend)
+                telemetry_ckpt_commit(path, step, "orbax", emergency)
+            if replay_buffer is not None:
+                self._experiment_consistent_rb(replay_buffer, rb_flags)
+            if fabric.is_global_zero and self.keep_last:
+                self._prune(os.path.dirname(path))
+            return
+
+        # pickle backend
+        if self._use_async(fabric, emergency):
+            writer = self._writer()
+            if writer.busy:
+                writer.record_skip(path=ckpt_path, step=step)
+                return
+            with span("ckpt/snapshot", path=ckpt_path, ckpt_step=step):
+                rb_flags = self._ckpt_rb(replay_buffer) if replay_buffer is not None else None
+                host_state = self._snapshot_tree(state)
+                if replay_buffer is not None:
+                    host_state = {**host_state, "rb": self._snapshot_buffer(replay_buffer)}
+                    self._experiment_consistent_rb(replay_buffer, rb_flags)
+            manifest = build_manifest(
+                step=step, backend="pickle", world_size=fabric.world_size, state=host_state, extra=extra
+            )
+            self._submit(writer, ckpt_path, step, host_state, "pickle", None, manifest)
+            return
+        rb_state = self._ckpt_rb(replay_buffer) if replay_buffer is not None else None
+        if replay_buffer is not None:
+            rb_to_save: Any = replay_buffer
+            if gather_buffers and fabric.num_processes > 1:
+                from sheeprl_tpu.parallel.collectives import gather_object
+
+                gathered = gather_object(replay_buffer, dst=0)
+                rb_to_save = gathered if fabric.is_global_zero else replay_buffer
+            state = {**state, "rb": rb_to_save}
+        if fabric.is_global_zero:
+            manifest = build_manifest(
+                step=step, backend="pickle", world_size=fabric.world_size, state=state, extra=extra
+            )
+            with span("ckpt/write", path=ckpt_path, ckpt_step=step, sync=True):
+                save_checkpoint(ckpt_path, state, backend=backend, manifest=manifest)
+            telemetry_ckpt_commit(ckpt_path, step, "pickle", emergency)
         if replay_buffer is not None:
             self._experiment_consistent_rb(replay_buffer, rb_state)
         if fabric.is_global_zero and self.keep_last:
@@ -93,6 +174,61 @@ class CheckpointCallback:
         self.on_checkpoint_coupled(
             fabric, ckpt_path, state, replay_buffer, gather_buffers=False, backend=backend
         )
+
+    # ------------------------------------------------------------------ #
+    # async plumbing
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _writer() -> Any:
+        from sheeprl_tpu.resilience.async_writer import get_async_writer
+
+        return get_async_writer()
+
+    def _submit(
+        self,
+        writer: Any,
+        path: str,
+        step: int,
+        state: Dict[str, Any],
+        backend: str,
+        per_proc: Optional[Dict[str, Any]],
+        manifest: Dict[str, Any],
+    ) -> None:
+        from sheeprl_tpu.obs import telemetry_ckpt_commit
+        from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+        def write() -> None:
+            save_checkpoint(path, state, backend=backend, per_process_state=per_proc, manifest=manifest)
+            telemetry_ckpt_commit(path, step, backend, bool(manifest.get("emergency", False)))
+            if self.keep_last:
+                self._prune(os.path.dirname(path))
+
+        writer.submit(write, path=path, step=step)
+
+    @staticmethod
+    def _snapshot_tree(tree: Any) -> Any:
+        """Deep host copy of every array leaf: device arrays come to host,
+        numpy leaves are copied so the background pickle cannot race the env
+        loop mutating them in place."""
+        import jax
+        import numpy as np
+
+        def leaf(x: Any) -> Any:
+            if isinstance(x, jax.Array):
+                return np.asarray(jax.device_get(x))
+            if isinstance(x, np.ndarray):
+                return x.copy()
+            return x
+
+        return jax.tree.map(leaf, tree)
+
+    @staticmethod
+    def _snapshot_buffer(rb: Any) -> Any:
+        """Detached deep copy of a replay buffer (pickle round-trip — every
+        buffer type already defines checkpoint pickling semantics)."""
+        import pickle
+
+        return pickle.loads(pickle.dumps(rb, protocol=pickle.HIGHEST_PROTOCOL))
 
     # ------------------------------------------------------------------ #
     # buffer consistency (reference callback.py:87-142)
@@ -134,12 +270,28 @@ class CheckpointCallback:
             rb._open_episodes = saved
 
     def _prune(self, ckpt_dir: str) -> None:
+        """Prune by MANIFEST STEP, not mtime: only committed checkpoints
+        count against ``keep_last`` (a torn write or a foreign file must not
+        evict a good checkpoint, and clock skew must not delete the newest),
+        unrecognized entries are left alone, and torn writes matching our
+        naming scheme are garbage-collected. Runs where no save can be in
+        flight: after a sync commit, or on the background writer thread
+        after its own commit."""
         if not os.path.isdir(ckpt_dir):
             return
-        entries = sorted(
-            (e for e in os.listdir(ckpt_dir) if not e.startswith(".")),
-            key=lambda e: os.path.getmtime(os.path.join(ckpt_dir, e)),
-        )
-        for stale in entries[: -self.keep_last] if len(entries) > self.keep_last else []:
-            path = os.path.join(ckpt_dir, stale)
-            shutil.rmtree(path) if os.path.isdir(path) else os.remove(path)
+        from sheeprl_tpu.resilience.manifest import MANIFEST_SUFFIX, committed_checkpoints, gc_torn
+
+        gc_torn(ckpt_dir)
+        committed = committed_checkpoints(ckpt_dir)  # oldest step first
+        stale = committed[: -self.keep_last] if len(committed) > self.keep_last else []
+        for ckpt in stale:
+            try:
+                if os.path.isdir(ckpt.path):
+                    shutil.rmtree(ckpt.path)
+                else:
+                    os.remove(ckpt.path)
+                    sidecar = ckpt.path + MANIFEST_SUFFIX
+                    if os.path.isfile(sidecar):
+                        os.remove(sidecar)
+            except OSError:
+                pass

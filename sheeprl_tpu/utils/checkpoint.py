@@ -75,42 +75,82 @@ def _join_arrays(tree: Any, arrays: Dict[str, np.ndarray]) -> Any:
     return walk(tree)
 
 
+def _host_barrier() -> None:
+    """Sync every process over the host-object plane (free single-process)."""
+    if jax.process_count() > 1:
+        from sheeprl_tpu.parallel.collectives import host_allreduce_sum
+
+        host_allreduce_sum(1.0)
+
+
 def save_checkpoint(
     path: str,
     state: Dict[str, Any],
     backend: str = "pickle",
     per_process_state: Dict[str, Any] | None = None,
+    manifest: Dict[str, Any] | None = None,
 ) -> None:
-    """Write ``state`` to ``path`` (atomic for the pickle backend; the orbax
-    backend writes ``path`` as a directory).
+    """Write ``state`` to ``path``. Both backends are crash-atomic: the
+    payload is fully staged under a temp name and promoted by rename, and
+    when ``manifest`` is given it lands strictly AFTER the payload as the
+    commit marker (``sheeprl_tpu.resilience.manifest``) — a crash at any
+    point leaves either the previous committed checkpoint or a torn staging
+    entry that pruning garbage-collects, never a half-written checkpoint
+    under the final name.
 
-    Orbax path: ``jax.Array`` leaves are handed to the OCDBT store with
-    their shardings intact — on multi-host runs every process writes only
-    its own shards (no host-dense gather). ``per_process_state`` (e.g. this
-    process's replay buffer) is written as ``objects_rank_{i}.pkl`` by every
-    process; :func:`load_checkpoint` reassembles the per-rank values into
+    Orbax path: ``path`` becomes a directory. ``jax.Array`` leaves are handed
+    to the OCDBT store with their shardings intact — on multi-host runs every
+    process writes only the shards it owns (no host-dense gather).
+    ``per_process_state`` (e.g. this process's replay buffer) is written as
+    ``objects_rank_{i}.pkl`` by every process; all sidecars land before the
+    manifest and the directory promote, so a visible directory is always
+    complete. :func:`load_checkpoint` reassembles the per-rank values into
     lists for :func:`select_buffer`."""
     if backend == "orbax":
         import orbax.checkpoint as ocp
 
+        from sheeprl_tpu.resilience.manifest import TMP_PREFIX, write_manifest
+
         skeleton, arrays = _split_arrays(state)
-        # every process must reach the orbax save (it runs its own process
-        # barriers on multi-host); only process 0 touches the directory and
-        # the shared object sidecar
+        # Stage EVERYTHING in a hidden temp dir next to the destination and
+        # promote with one rename at the end. The temp name is deterministic
+        # (no pid) because on multi-host runs every process must write into
+        # the same directory; process 0 owns creation and the promote.
+        parent = os.path.dirname(os.path.abspath(path)) or "."
+        tmp_dir = os.path.join(parent, TMP_PREFIX + os.path.basename(path))
         if jax.process_index() == 0:
-            if os.path.isdir(path):
-                shutil.rmtree(path)
-            os.makedirs(path, exist_ok=True)
+            if os.path.isdir(tmp_dir):
+                shutil.rmtree(tmp_dir)
+            os.makedirs(tmp_dir, exist_ok=True)
+        # every process must reach the orbax save (it runs its own process
+        # barriers on multi-host); only process 0 touches the shared sidecar
         ckptr = ocp.StandardCheckpointer()
-        ckptr.save(os.path.abspath(os.path.join(path, "arrays")), arrays or {"__empty__": np.zeros(1)})
+        ckptr.save(os.path.abspath(os.path.join(tmp_dir, "arrays")), arrays or {"__empty__": np.zeros(1)})
         ckptr.wait_until_finished()
         if jax.process_index() == 0:
-            with open(os.path.join(path, "objects.pkl"), "wb") as f:
+            with open(os.path.join(tmp_dir, "objects.pkl"), "wb") as f:
                 pickle.dump(skeleton, f, protocol=pickle.HIGHEST_PROTOCOL)
         if per_process_state is not None:
-            rank_path = os.path.join(path, f"objects_rank_{jax.process_index()}.pkl")
+            rank_path = os.path.join(tmp_dir, f"objects_rank_{jax.process_index()}.pkl")
             with open(rank_path, "wb") as f:
                 pickle.dump(_to_host(per_process_state), f, protocol=pickle.HIGHEST_PROTOCOL)
+        # all sidecars must land before the commit marker and the promote
+        _host_barrier()
+        if jax.process_index() == 0:
+            if manifest is not None:
+                write_manifest(tmp_dir, manifest)
+            if os.path.isdir(path):
+                # re-saving the same step: move the old dir aside first so a
+                # crash between delete and promote cannot lose both copies
+                trash = os.path.join(parent, TMP_PREFIX + "trash-" + os.path.basename(path))
+                if os.path.isdir(trash):
+                    shutil.rmtree(trash)
+                os.replace(path, trash)
+                os.replace(tmp_dir, path)
+                shutil.rmtree(trash, ignore_errors=True)
+            else:
+                os.replace(tmp_dir, path)
+        _host_barrier()
         return
     if backend != "pickle":
         raise ValueError(f"unknown checkpoint backend {backend!r} (choose 'pickle' or 'orbax')")
@@ -128,6 +168,10 @@ def save_checkpoint(
         if os.path.exists(tmp):
             os.remove(tmp)
         raise
+    if manifest is not None:
+        from sheeprl_tpu.resilience.manifest import write_manifest
+
+        write_manifest(path, manifest)
 
 
 def load_checkpoint(path: str) -> Dict[str, Any]:

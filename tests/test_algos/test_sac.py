@@ -61,6 +61,24 @@ def test_sac_dummy_continuous(tmp_path, monkeypatch):
     )
 
 
+def test_finite_action_bounds_clamps_unbounded_dims():
+    """An unbounded Box action space must NOT become an inf tanh rescale:
+    the dummy continuous env is Box(-inf, inf) and a literal inf scale NaNs
+    the very first SAC update (caught by the resilience sentinel)."""
+    import gymnasium as gym
+    import numpy as np
+
+    from sheeprl_tpu.algos.sac.agent import finite_action_bounds
+
+    low, high = finite_action_bounds(gym.spaces.Box(-np.inf, np.inf, shape=(2,)))
+    assert low == (-1.0, -1.0) and high == (1.0, 1.0)
+    # finite bounds pass through untouched, per dimension
+    low, high = finite_action_bounds(
+        gym.spaces.Box(np.array([-2.0, -np.inf]), np.array([2.0, np.inf]))
+    )
+    assert low == (-2.0, -1.0) and high == (2.0, 1.0)
+
+
 def test_sac_discrete_env_raises(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     with pytest.raises(ValueError, match="continuous action space"):

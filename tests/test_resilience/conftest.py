@@ -1,0 +1,29 @@
+"""Shared fixtures for the resilience suite."""
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_async_writer():
+    """The async checkpoint writer is a process-wide singleton; every test
+    must start with no in-flight save and no accumulated counters."""
+    import sheeprl_tpu.resilience.async_writer as aw
+
+    aw.drain_async_checkpoints(timeout=30.0)
+    with aw._writer_lock:
+        aw._writer = None
+    yield
+    aw.drain_async_checkpoints(timeout=30.0)
+    with aw._writer_lock:
+        aw._writer = None
+
+
+@pytest.fixture(autouse=True)
+def _no_queued_resilience_events():
+    """Auto-resume queues telemetry events module-side until cli.run_algorithm
+    flushes them; don't let one test's queue leak into the next."""
+    from sheeprl_tpu.resilience import autoresume
+
+    autoresume._pending_events.clear()
+    yield
+    autoresume._pending_events.clear()

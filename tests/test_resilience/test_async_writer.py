@@ -1,0 +1,137 @@
+"""Async checkpoint writer: at-most-one save in flight, dropped overlaps,
+snapshot isolation through the CheckpointCallback async path
+(sheeprl_tpu/resilience/async_writer.py)."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.resilience import drain_async_checkpoints, get_async_writer
+from sheeprl_tpu.resilience.async_writer import AsyncCheckpointWriter
+from sheeprl_tpu.resilience.manifest import is_committed, read_manifest
+from sheeprl_tpu.utils.callback import CheckpointCallback
+from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+
+class _FakeFabric:
+    num_processes = 1
+    world_size = 1
+    is_global_zero = True
+
+
+def test_single_inflight_skip_and_drain():
+    w = AsyncCheckpointWriter()
+    release = threading.Event()
+    done = []
+
+    def slow_write():
+        release.wait(timeout=30)
+        done.append(True)
+
+    assert w.submit(slow_write, path="a.ckpt", step=1) is True
+    assert w.busy
+    # overlapping request: dropped, accounted, never queued
+    assert w.submit(lambda: done.append("overlap"), path="b.ckpt", step=2) is False
+    assert w.skipped == 1 and w.submitted == 1
+    release.set()
+    assert w.drain(timeout=30) is True
+    assert done == [True]
+    # idle again: the next submit goes through
+    assert w.submit(lambda: done.append("next"), path="c.ckpt", step=3) is True
+    assert w.drain(timeout=30)
+    assert done == [True, "next"]
+    assert w.submitted == 2
+
+
+def test_record_skip_without_submit():
+    w = AsyncCheckpointWriter()
+    w.record_skip(path="x.ckpt", step=5)
+    assert w.skipped == 1
+
+
+def test_write_error_never_raises():
+    w = AsyncCheckpointWriter()
+
+    def boom():
+        raise OSError("disk full")
+
+    with pytest.warns(UserWarning, match="disk full"):
+        assert w.submit(boom, path="bad.ckpt", step=1) is True
+        assert w.drain(timeout=30) is True
+    assert isinstance(w.last_error, OSError)
+    # the writer survives a failed write
+    ok = []
+    assert w.submit(lambda: ok.append(1), path="good.ckpt", step=2) is True
+    assert w.drain(timeout=30) and ok == [1]
+
+
+@pytest.mark.parametrize("backend", ["pickle", "orbax"])
+def test_callback_async_snapshot_isolation(tmp_path, backend):
+    """The hook snapshots state to host BEFORE returning: mutating the live
+    tree after the hook must not leak into the checkpoint the background
+    thread serializes (the async correctness property)."""
+    cb = CheckpointCallback(backend=backend, async_save=True)
+    state = {"agent": {"w": np.ones((4, 3), np.float32)}, "update": 1, "batch_size": 8}
+    path = str(tmp_path / "ckpt_64_0.ckpt")
+    cb.on_checkpoint_coupled(_FakeFabric(), path, state)
+    # the env/train loop keeps going while the write is in flight
+    state["agent"]["w"] *= 0.0
+    assert drain_async_checkpoints(timeout=60)
+    assert is_committed(path)
+    man = read_manifest(path)
+    assert man["step"] == 64 and man["backend"] == backend and not man.get("emergency")
+    out = load_checkpoint(path)
+    np.testing.assert_array_equal(out["agent"]["w"], np.ones((4, 3), np.float32))
+
+
+def test_callback_busy_writer_drops_save(tmp_path):
+    """A checkpoint request that lands while a write is in flight is dropped
+    before paying for a snapshot — and nothing is written for it."""
+    writer = get_async_writer()
+    release = threading.Event()
+    writer.submit(lambda: release.wait(timeout=30), path="inflight.ckpt", step=1)
+    try:
+        cb = CheckpointCallback(async_save=True)
+        path = str(tmp_path / "ckpt_128_0.ckpt")
+        cb.on_checkpoint_coupled(_FakeFabric(), path, {"update": 2})
+        assert writer.skipped == 1
+        assert not os.path.exists(path)
+    finally:
+        release.set()
+        writer.drain(timeout=30)
+
+
+def test_callback_async_buffer_snapshot_restores_live_flags(tmp_path):
+    """The truncated-flag fixup must be undone by the time the hook returns
+    (not when the background write finishes), and the SAVED copy keeps it."""
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    rb = ReplayBuffer(8, n_envs=2, seed=0)
+    rb.add(
+        {
+            "observations": np.zeros((3, 2, 4), np.float32),
+            "terminated": np.zeros((3, 2, 1), np.float32),
+            "truncated": np.zeros((3, 2, 1), np.float32),
+        }
+    )
+    cb = CheckpointCallback(async_save=True)
+    path = str(tmp_path / "ckpt_32_0.ckpt")
+    cb.on_checkpoint_coupled(_FakeFabric(), path, {"update": 1}, replay_buffer=rb)
+    # live buffer already restored, even if the write is still in flight
+    assert rb["truncated"][(rb._pos - 1) % rb.buffer_size].sum() == 0
+    assert drain_async_checkpoints(timeout=60)
+    saved = load_checkpoint(path)["rb"]
+    assert saved["truncated"][(saved._pos - 1) % saved.buffer_size].sum() == 2
+
+
+def test_emergency_save_is_synchronous(tmp_path):
+    """emergency=True bypasses the background writer entirely: the checkpoint
+    is committed (manifest flagged) by the time the hook returns."""
+    cb = CheckpointCallback(async_save=True)
+    path = str(tmp_path / "ckpt_96_0.ckpt")
+    cb.on_checkpoint_coupled(_FakeFabric(), path, {"update": 3}, emergency=True)
+    assert is_committed(path)  # no drain needed
+    assert read_manifest(path)["emergency"] is True
+    assert get_async_writer().submitted == 0

@@ -1,0 +1,115 @@
+"""checkpoint.resume_from=auto resolution (sheeprl_tpu/resilience/autoresume.py):
+newest valid committed checkpoint wins, corrupted/mesh-incompatible candidates
+fall back to the next-newest with a queued resume_fallback event, no candidate
+starts fresh."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.resilience import resolve_auto_resume, scan_run_checkpoints
+from sheeprl_tpu.resilience.autoresume import _pending_events
+from sheeprl_tpu.resilience.manifest import build_manifest
+from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+
+def _cfg(tmp_path, devices=1):
+    return {
+        "root_dir": "ppo/Cart",
+        "run_name": "drill",
+        "log_base_dir": str(tmp_path / "logs"),
+        "fabric": {"devices": devices},
+        "checkpoint": {"resume_from": "auto"},
+    }
+
+
+def _run_root(tmp_path):
+    return os.path.join(str(tmp_path), "logs", "ppo", "Cart", "drill")
+
+
+def _add_ckpt(tmp_path, version, step, batch_size=8, with_config=True):
+    vdir = os.path.join(_run_root(tmp_path), f"version_{version}")
+    ckpt_dir = os.path.join(vdir, "checkpoint")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    if with_config:
+        with open(os.path.join(vdir, "config.yaml"), "w") as f:
+            f.write("env:\n  id: CartPole-v1\n")
+    state = {
+        "agent": {"w": np.full(3, float(step), np.float32)},
+        "update": step // 64,
+        "batch_size": batch_size,
+    }
+    path = os.path.join(ckpt_dir, f"ckpt_{step}_0.ckpt")
+    save_checkpoint(
+        path, state, manifest=build_manifest(step=step, backend="pickle", world_size=1, state=state)
+    )
+    return path
+
+
+def test_auto_resume_picks_newest_across_versions(tmp_path):
+    _add_ckpt(tmp_path, 0, 64)
+    _add_ckpt(tmp_path, 0, 128)
+    newest = _add_ckpt(tmp_path, 1, 192)
+    assert resolve_auto_resume(_cfg(tmp_path)) == newest
+    kinds = [k for k, _ in _pending_events]
+    assert kinds == ["auto_resume"]
+    assert _pending_events[0][1]["ckpt_step"] == 192
+    assert _pending_events[0][1]["candidates"] == 3
+
+
+def test_auto_resume_skips_corrupted_newest(tmp_path):
+    older = _add_ckpt(tmp_path, 0, 64)
+    newest = _add_ckpt(tmp_path, 0, 128)
+    # torn-at-the-payload corruption that still carries a manifest: the
+    # validation load must reject it and fall back
+    with open(newest, "wb") as f:
+        f.write(b"\x00garbage")
+    with pytest.warns(UserWarning, match="falling back"):
+        assert resolve_auto_resume(_cfg(tmp_path)) == older
+    kinds = [k for k, _ in _pending_events]
+    assert kinds == ["resume_fallback", "auto_resume"]
+    assert _pending_events[0][1]["path"] == newest
+
+
+def test_auto_resume_mesh_mismatch_falls_back(tmp_path):
+    older = _add_ckpt(tmp_path, 0, 64, batch_size=8)
+    _add_ckpt(tmp_path, 0, 128, batch_size=3)  # 3 does not split over 2 devices
+    with pytest.warns(UserWarning, match="falling back"):
+        assert resolve_auto_resume(_cfg(tmp_path, devices=2)) == older
+    assert [k for k, _ in _pending_events] == ["resume_fallback", "auto_resume"]
+
+
+def test_auto_resume_requires_config_yaml(tmp_path):
+    older = _add_ckpt(tmp_path, 0, 64)
+    newest = _add_ckpt(tmp_path, 1, 128, with_config=False)
+    with pytest.warns(UserWarning, match="config.yaml"):
+        assert resolve_auto_resume(_cfg(tmp_path)) == older
+    assert _pending_events[0][1]["path"] == newest
+
+
+def test_auto_resume_no_candidates_starts_fresh(tmp_path):
+    with pytest.warns(UserWarning, match="fresh run"):
+        assert resolve_auto_resume(_cfg(tmp_path)) is None
+    assert _pending_events == []
+
+
+def test_auto_resume_all_rejected_starts_fresh(tmp_path):
+    bad = _add_ckpt(tmp_path, 0, 64)
+    with open(bad, "wb") as f:
+        f.write(b"nope")
+    with pytest.warns(UserWarning, match="rejected"):
+        assert resolve_auto_resume(_cfg(tmp_path)) is None
+
+
+def test_scan_ignores_uncommitted_and_gcs_torn(tmp_path):
+    good = _add_ckpt(tmp_path, 0, 64)
+    ckpt_dir = os.path.dirname(good)
+    torn = os.path.join(ckpt_dir, "ckpt_128_0.ckpt")
+    save_checkpoint(torn, {"agent": {"w": np.zeros(3)}})  # no manifest
+    os.makedirs(os.path.join(ckpt_dir, ".tmp-ckpt_192_0.ckpt"))
+    with pytest.warns(UserWarning, match="garbage-collected"):
+        found = scan_run_checkpoints(_run_root(tmp_path))
+    assert [c.step for c in found] == [64]
+    assert not os.path.exists(torn)
+    assert not os.path.exists(os.path.join(ckpt_dir, ".tmp-ckpt_192_0.ckpt"))

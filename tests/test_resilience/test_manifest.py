@@ -1,0 +1,172 @@
+"""Commit manifests: a checkpoint exists iff its manifest does
+(sheeprl_tpu/resilience/manifest.py). Covers the ISSUE satellites: manifest
+round-trip on both backends, prune-by-manifest-step (not mtime), foreign
+files skipped, torn writes garbage-collected."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.resilience.manifest import (
+    MANIFEST_SUFFIX,
+    TMP_PREFIX,
+    build_manifest,
+    checkpoint_step,
+    committed_checkpoints,
+    gc_torn,
+    is_committed,
+    read_manifest,
+    torn_checkpoints,
+    write_manifest,
+)
+from sheeprl_tpu.utils.callback import CheckpointCallback
+from sheeprl_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+
+def _state(step=7):
+    return {
+        "agent": {"w": np.random.rand(4, 3).astype(np.float32), "b": np.zeros(3)},
+        "update": step,
+        "batch_size": 64,
+    }
+
+
+def _ckpt_name(step, rank=0):
+    return f"ckpt_{step}_{rank}.ckpt"
+
+
+def _save_committed(ckpt_dir, step, backend="pickle", batch_size=64, world_size=1):
+    os.makedirs(ckpt_dir, exist_ok=True)
+    state = _state(step)
+    state["batch_size"] = batch_size
+    path = os.path.join(ckpt_dir, _ckpt_name(step))
+    man = build_manifest(step=step, backend=backend, world_size=world_size, state=state)
+    save_checkpoint(path, state, backend=backend, manifest=man)
+    return path
+
+
+def test_checkpoint_step_parsing():
+    assert checkpoint_step("ckpt_128_0.ckpt") == 128
+    assert checkpoint_step("/a/b/ckpt_5_3.ckpt") == 5
+    assert checkpoint_step("notes.txt") is None
+    assert checkpoint_step("ckpt_abc_0.ckpt") is None
+    assert checkpoint_step("ckpt_5.ckpt") is None  # missing rank
+
+
+@pytest.mark.parametrize("backend", ["pickle", "orbax"])
+def test_manifest_roundtrip(tmp_path, backend):
+    """save_checkpoint(manifest=...) commits on both backends: the manifest
+    lands last (sidecar / inside the promoted dir) and round-trips the
+    step/backend/world-size/digest fields."""
+    path = _save_committed(str(tmp_path), step=42, backend=backend, batch_size=96, world_size=2)
+    assert is_committed(path)
+    man = read_manifest(path)
+    assert man["step"] == 42
+    assert man["backend"] == backend
+    assert man["world_size"] == 2
+    assert man["batch_size"] == 96
+    assert man["leaf_count"] > 0 and len(man["tree_digest"]) == 12
+    # the payload itself still loads
+    assert load_checkpoint(path)["update"] == 42
+    # manifest location matches the backend layout
+    if backend == "orbax":
+        assert os.path.isfile(os.path.join(path, "manifest.json"))
+    else:
+        assert os.path.isfile(path + MANIFEST_SUFFIX)
+
+
+def test_save_without_manifest_is_not_committed(tmp_path):
+    path = str(tmp_path / _ckpt_name(3))
+    save_checkpoint(path, _state(3))
+    assert not is_committed(path)
+    assert committed_checkpoints(str(tmp_path)) == []
+    # writing the marker afterwards commits it
+    write_manifest(path, build_manifest(step=3, backend="pickle", world_size=1))
+    assert is_committed(path)
+    assert [c.step for c in committed_checkpoints(str(tmp_path))] == [3]
+
+
+def test_unparseable_manifest_is_not_committed(tmp_path):
+    path = str(tmp_path / _ckpt_name(3))
+    save_checkpoint(path, _state(3))
+    with open(path + MANIFEST_SUFFIX, "w") as f:
+        f.write("{ not json")
+    assert read_manifest(path) is None and not is_committed(path)
+    # valid json but no integer step -> still not committed
+    with open(path + MANIFEST_SUFFIX, "w") as f:
+        json.dump({"backend": "pickle"}, f)
+    assert not is_committed(path)
+
+
+def test_committed_checkpoints_order_and_foreign_skip(tmp_path):
+    d = str(tmp_path)
+    for step in (30, 2, 10):
+        _save_committed(d, step)
+    # a foreign file and an uncommitted checkpoint must not be enumerated
+    (tmp_path / "notes.txt").write_text("keep me")
+    save_checkpoint(os.path.join(d, _ckpt_name(99)), _state(99))
+    out = committed_checkpoints(d)
+    assert [c.step for c in out] == [2, 10, 30]  # oldest step first
+    assert all(c.manifest["step"] == c.step for c in out)
+
+
+def test_torn_detection_and_gc(tmp_path):
+    d = str(tmp_path)
+    good = _save_committed(d, 10)
+    # torn entries: staging dir, stray .tmp file, our-naming ckpt without a
+    # manifest, and an orphaned manifest sidecar
+    os.makedirs(os.path.join(d, TMP_PREFIX + _ckpt_name(20)))
+    (tmp_path / ".manifest-x.tmp").write_text("")
+    save_checkpoint(os.path.join(d, _ckpt_name(30)), _state(30))
+    write_manifest(
+        os.path.join(d, _ckpt_name(40)), build_manifest(step=40, backend="pickle", world_size=1)
+    )  # sidecar only: its checkpoint was never written
+    # a foreign file is neither torn nor committed
+    (tmp_path / "notes.txt").write_text("keep me")
+
+    torn = torn_checkpoints(d)
+    assert len(torn) == 4
+    assert good not in torn and os.path.join(d, "notes.txt") not in torn
+
+    removed = gc_torn(d)
+    assert sorted(removed) == sorted(torn)
+    assert os.path.exists(good) and is_committed(good)
+    assert (tmp_path / "notes.txt").exists()
+    assert torn_checkpoints(d) == []
+
+
+def test_prune_keeps_newest_by_manifest_step_not_mtime(tmp_path):
+    """The pre-resilience _prune sorted by mtime; clock skew could evict the
+    newest checkpoint. Now only committed checkpoints count, ordered by
+    manifest step, and unrecognized entries are untouched."""
+    d = str(tmp_path)
+    paths = {step: _save_committed(d, step) for step in (10, 2, 30)}
+    # adversarial mtimes: the NEWEST step looks oldest on disk
+    now = time.time()
+    os.utime(paths[30], (now - 1000, now - 1000))
+    os.utime(paths[30] + MANIFEST_SUFFIX, (now - 1000, now - 1000))
+    os.utime(paths[2], (now, now))
+    # a torn write and a foreign file sit in the same dir
+    save_checkpoint(os.path.join(d, _ckpt_name(99)), _state(99))
+    (tmp_path / "notes.txt").write_text("keep me")
+
+    CheckpointCallback(keep_last=2)._prune(d)
+
+    assert not os.path.exists(paths[2]) and not os.path.exists(paths[2] + MANIFEST_SUFFIX)
+    assert os.path.exists(paths[10]) and os.path.exists(paths[30])
+    assert (tmp_path / "notes.txt").exists()
+    assert not os.path.exists(os.path.join(d, _ckpt_name(99)))  # torn -> GC'd
+    assert [c.step for c in committed_checkpoints(d)] == [10, 30]
+
+
+def test_prune_orbax_dirs_by_step(tmp_path):
+    d = str(tmp_path)
+    for step in (1, 2, 3):
+        _save_committed(d, step, backend="orbax")
+    CheckpointCallback(keep_last=1, backend="orbax")._prune(d)
+    left = committed_checkpoints(d)
+    assert [c.step for c in left] == [3]
+    assert not os.path.exists(os.path.join(d, _ckpt_name(1)))

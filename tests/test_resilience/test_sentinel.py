@@ -1,0 +1,158 @@
+"""Non-finite sentinel + rollback manager unit tests
+(sheeprl_tpu/resilience/sentinel.py, manager.py): jittable all_finite, the
+superstep's fused [K] finite vector, deterministic fault injection, rollback
+budget/restore/resalt semantics."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.resilience import RunResilience, all_finite, host_all_finite, parse_nan_faults
+from sheeprl_tpu.resilience.manifest import build_manifest
+from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+
+class _FakeFabric:
+    num_processes = 1
+    world_size = 1
+    is_global_zero = True
+
+
+def _cfg(**res):
+    # preemption=False: unit tests must not install signal handlers
+    return {"resilience": {"enabled": True, "preemption": False, **res}, "checkpoint": {}}
+
+
+def test_all_finite_jittable():
+    fn = jax.jit(all_finite)
+    good = {"a": jnp.ones(3), "b": (jnp.zeros(2), jnp.arange(4))}  # ints ignored
+    assert bool(fn(good))
+    bad = {"a": jnp.ones(3).at[1].set(jnp.nan), "b": (jnp.zeros(2), jnp.arange(4))}
+    assert not bool(fn(bad))
+    assert not bool(fn({"x": jnp.asarray([1.0, jnp.inf])}))
+    # integer-only trees are vacuously finite
+    assert bool(fn({"count": jnp.arange(3)}))
+
+
+def test_host_all_finite_nested():
+    assert host_all_finite({"a": [1.0, 2.0], "b": {"c": np.ones(3)}})
+    assert not host_all_finite({"a": [1.0, float("nan")]})
+    assert not host_all_finite([np.asarray([np.inf])])
+    # non-numeric leaves are ignored, integer arrays are always finite
+    assert host_all_finite({"name": "run", "n": np.arange(5)})
+
+
+def test_parse_nan_faults():
+    assert parse_nan_faults({}) == set()
+    assert parse_nan_faults({"fault_injection": {"enabled": False, "faults": [{"at_update": 1}]}}) == set()
+    cfg = {"fault_injection": {"enabled": True, "faults": [{"kind": "nan", "at_update": 3}, {"at_update": 7}]}}
+    assert parse_nan_faults(cfg) == {3, 7}
+    with pytest.raises(ValueError, match="kind"):
+        parse_nan_faults({"fault_injection": {"enabled": True, "faults": [{"kind": "crash", "at_update": 1}]}})
+    with pytest.raises(ValueError, match="at_update"):
+        parse_nan_faults({"fault_injection": {"enabled": True, "faults": [{"kind": "nan"}]}})
+    with pytest.raises(ValueError, match="mappings"):
+        parse_nan_faults({"fault_injection": {"enabled": True, "faults": ["nan@3"]}})
+
+
+def test_superstep_finite_vector():
+    """check_finite=True appends a [K] per-step finite vector to the fused
+    scan's outputs: once a NaN enters the params, every later step reports
+    non-finite too (the window verdict the dreamer loop reduces)."""
+    from sheeprl_tpu.ops.superstep import make_superstep_fn
+
+    def train_body(params, aux, batch, key):
+        params = params + batch
+        return params, aux, {"loss": params}
+
+    superstep = make_superstep_fn(
+        train_body, lambda ctx, key, i: ctx[i], num_steps=3, check_finite=True
+    )
+    ctx = jnp.asarray([1.0, jnp.nan, 1.0])
+    params, aux, key, metrics, finite = superstep(
+        jnp.asarray(0.0), jnp.asarray(0.0), 0, ctx, jax.random.PRNGKey(0)
+    )
+    assert finite.shape == (3,)
+    assert list(np.asarray(finite)) == [True, False, False]
+    assert not np.isfinite(np.asarray(params))
+
+    # all-finite context: the vector is all True and params stay finite
+    _, _, _, _, finite_ok = superstep(
+        jnp.asarray(0.0), jnp.asarray(0.0), 0, jnp.ones(3), jax.random.PRNGKey(0)
+    )
+    assert np.asarray(finite_ok).all()
+
+
+def test_check_finite_and_fault_injection(tmp_path):
+    resil = RunResilience(
+        _FakeFabric(),
+        _cfg(fault_injection={"enabled": True, "faults": [{"kind": "nan", "at_update": 3}]}),
+        str(tmp_path),
+    )
+    assert resil.check_finite({"loss": 1.0}, update=1)
+    assert not resil.check_finite({"loss": float("nan")}, update=2)
+    # injected fault fires exactly once at its update
+    with pytest.warns(UserWarning, match="fault_injection"):
+        assert not resil.check_finite({"loss": 1.0}, update=3)
+    assert resil.check_finite({"loss": 1.0}, update=3)
+    # window_ok shares the same schedule for loops with an on-device verdict
+    assert resil.window_ok(True, update=4)
+    assert not resil.window_ok(False, update=4)
+
+
+def test_disabled_sentinel_is_inert(tmp_path):
+    resil = RunResilience(_FakeFabric(), _cfg(check_finite=False), str(tmp_path))
+    assert resil.check_finite({"loss": float("nan")}, update=1)
+    assert resil.window_ok(False, update=1)
+
+
+def test_rollback_budget_exhausted(tmp_path):
+    resil = RunResilience(_FakeFabric(), _cfg(max_rollbacks=0), str(tmp_path))
+    with pytest.raises(RuntimeError, match="max_rollbacks"):
+        resil.rollback(update=5)
+
+
+def test_rollback_without_checkpoint(tmp_path):
+    resil = RunResilience(_FakeFabric(), _cfg(max_rollbacks=2), str(tmp_path))
+    with pytest.raises(RuntimeError, match="no committed checkpoint"):
+        resil.rollback(update=5)
+
+
+def test_rollback_restores_newest_committed_and_resalts(tmp_path):
+    ckpt_dir = os.path.join(str(tmp_path), "checkpoint")
+    os.makedirs(ckpt_dir)
+    for step, val in ((64, 1.0), (128, 2.0)):
+        state = {"agent": {"w": np.full(3, val, np.float32)}, "update": step // 64}
+        save_checkpoint(
+            os.path.join(ckpt_dir, f"ckpt_{step}_0.ckpt"),
+            state,
+            manifest=build_manifest(step=step, backend="pickle", world_size=1, state=state),
+        )
+    # a torn newer write must NOT win over the committed ones
+    save_checkpoint(os.path.join(ckpt_dir, "ckpt_192_0.ckpt"), {"agent": {"w": np.zeros(3)}})
+
+    resil = RunResilience(_FakeFabric(), _cfg(max_rollbacks=2), str(tmp_path))
+    with pytest.warns(UserWarning, match="rolled back"):
+        restored = resil.rollback(update=9)
+    np.testing.assert_array_equal(restored["agent"]["w"], np.full(3, 2.0, np.float32))
+    assert resil.rollbacks == 1
+
+    # the restored key is forked away from the stream that produced the NaN
+    key = jax.random.PRNGKey(0)
+    resalted = resil.resalt_key(key)
+    assert not np.array_equal(np.asarray(key), np.asarray(resalted))
+
+    # place_like puts host arrays back under the live leaves' placements
+    live = {"w": jnp.zeros(3)}
+    placed = resil.place_like(restored["agent"], live)
+    assert isinstance(placed["w"], jax.Array)
+    np.testing.assert_array_equal(np.asarray(placed["w"]), np.full(3, 2.0, np.float32))
+
+    # second rollback exhausts the budget
+    with pytest.warns(UserWarning, match="rolled back"):
+        resil.rollback(update=10)
+    with pytest.raises(RuntimeError, match="max_rollbacks"):
+        resil.rollback(update=11)

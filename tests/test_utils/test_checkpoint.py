@@ -14,6 +14,7 @@ from sheeprl_tpu.utils.checkpoint import load_checkpoint, save_checkpoint, selec
 
 class _FakeFabric:
     num_processes = 1
+    world_size = 1
     is_global_zero = True
 
 
@@ -168,6 +169,24 @@ def test_select_buffer():
     assert select_buffer(["a"], 0, 1) == "a"
     with pytest.raises(RuntimeError):
         select_buffer(["a", "b", "c"], 0, 2)
+
+
+def test_elastic_per_rank_batch_size():
+    """Elastic resume re-splits the checkpoint's GLOBAL batch over the new
+    mesh and fails fast instead of silently flooring (ISSUE satellite)."""
+    from sheeprl_tpu.utils.checkpoint import elastic_per_rank_batch_size
+
+    assert elastic_per_rank_batch_size(64, 8) == 8
+    assert elastic_per_rank_batch_size(64, 1) == 64
+    assert elastic_per_rank_batch_size(8, 8) == 1
+    with pytest.raises(ValueError, match="does not split"):
+        elastic_per_rank_batch_size(64, 6)  # non-dividing
+    with pytest.raises(ValueError, match="does not split"):
+        elastic_per_rank_batch_size(4, 8)  # would divide to zero
+    with pytest.raises(ValueError, match="does not split"):
+        elastic_per_rank_batch_size(0, 4)  # degenerate stored batch
+    with pytest.raises(ValueError):
+        elastic_per_rank_batch_size(64, 0)  # degenerate world size
 
 
 def test_orbax_saves_sharded_jax_arrays_without_host_copy(tmp_path):
