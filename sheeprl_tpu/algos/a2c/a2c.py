@@ -196,6 +196,12 @@ def main(fabric, cfg: Dict[str, Any]):
     def ckpt_path_fn(step: int) -> str:
         return os.path.join(log_dir, "checkpoint", f"ckpt_{step}_{rank}.ckpt")
 
+    # a crash anywhere in the loop gets the preemption treatment too: the
+    # lambdas read the loop's CURRENT policy_step/update at crash time
+    resil.arm_crash_guard(
+        path_fn=lambda: ckpt_path_fn(policy_step),
+        state_fn=lambda: ckpt_state_fn(update - 1),
+    )
     preempted = False
     for update in range(start_update, num_updates + 1):
         telemetry_advance(policy_step)

@@ -739,6 +739,13 @@ def main(fabric, cfg: Dict[str, Any]):
         pending_metrics.clear()  # the poisoned window must not reach the logger
         player.update_params(wm_params, actor_params)
 
+    # a crash anywhere in the loop gets the preemption treatment too: the
+    # lambdas read the loop's CURRENT policy_step/update at crash time
+    resil.arm_crash_guard(
+        path_fn=lambda: ckpt_path_fn(policy_step),
+        state_fn=lambda: ckpt_state_fn(update - 1),
+        replay_buffer_fn=lambda: rb if cfg.buffer.checkpoint else None,
+    )
     preempted = False
     cumulative_per_rank_gradient_steps = 0
     pending_metrics: list = []  # device-resident metric vectors, fetched at log time

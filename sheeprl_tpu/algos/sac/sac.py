@@ -425,6 +425,13 @@ def main(fabric, cfg: Dict[str, Any]):
     def ckpt_path_fn(step: int) -> str:
         return os.path.join(log_dir, "checkpoint", f"ckpt_{step}_{rank}.ckpt")
 
+    # a crash anywhere in the loop gets the preemption treatment too: the
+    # lambdas read the loop's CURRENT policy_step/update at crash time
+    resil.arm_crash_guard(
+        path_fn=lambda: ckpt_path_fn(policy_step),
+        state_fn=lambda: ckpt_state_fn(update - 1),
+        replay_buffer_fn=lambda: rb if cfg.buffer.checkpoint else None,
+    )
     preempted = False
     # steady-state throughput probe (SHEEPRL_TPU_BENCH_JSON contract)
     probe = SteadyStateProbe()

@@ -182,6 +182,15 @@ def run_algorithm(cfg: dotdict) -> None:
     try:
         with maybe_profile(cfg, log_dir=run_base_dir(cfg)):
             entrypoint(fabric, cfg, **kwargs)
+    except Exception as err:
+        # unhandled train-loop crash: if the entrypoint armed its crash
+        # guard, drain in-flight saves and commit an emergency checkpoint so
+        # resume_from=auto restarts from this boundary; the exception still
+        # propagates (SystemExit from a preemption drain bypasses this)
+        from sheeprl_tpu.resilience import crash_drain
+
+        crash_drain(err)
+        raise
     finally:
         # a background checkpoint write may still be in flight (including the
         # save_last one) — join it before closing the telemetry sink so its
@@ -193,6 +202,13 @@ def run_algorithm(cfg: dotdict) -> None:
 def run(args: Optional[List[str]] = None) -> None:
     """Main entry (reference cli.py:344-352)."""
     overrides = list(sys.argv[1:] if args is None else args)
+    if overrides and overrides[0] == "serve":
+        # `python -m sheeprl_tpu serve checkpoint_path=...`: the policy-serving
+        # tier (howto/serving.md) — config comes from beside the checkpoint,
+        # not from a fresh composition, so dispatch before composing
+        from sheeprl_tpu.cli_serve import serving
+
+        return serving(overrides[1:])
     cfg = compose("config", overrides)
     cfg = dotdict(cfg)
     if cfg.checkpoint.resume_from == "auto":

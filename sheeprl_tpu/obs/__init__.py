@@ -31,6 +31,7 @@ from sheeprl_tpu.obs.telemetry import (
     telemetry_advance,
     telemetry_ckpt_commit,
     telemetry_ckpt_skipped,
+    telemetry_crash_checkpoint,
     telemetry_env_step,
     telemetry_fused_fallback,
     telemetry_mark_warm,
@@ -39,6 +40,8 @@ from sheeprl_tpu.obs.telemetry import (
     telemetry_preemption,
     telemetry_register_flops,
     telemetry_resume_fallback,
+    telemetry_serve_event,
+    telemetry_serve_stats,
     telemetry_train_window,
     telemetry_worker_restart,
 )
@@ -54,6 +57,7 @@ __all__ = [
     "telemetry_advance",
     "telemetry_ckpt_commit",
     "telemetry_ckpt_skipped",
+    "telemetry_crash_checkpoint",
     "telemetry_env_step",
     "telemetry_fused_fallback",
     "telemetry_mark_warm",
@@ -62,6 +66,8 @@ __all__ = [
     "telemetry_preemption",
     "telemetry_register_flops",
     "telemetry_resume_fallback",
+    "telemetry_serve_event",
+    "telemetry_serve_stats",
     "telemetry_train_window",
     "telemetry_worker_restart",
 ]

@@ -130,7 +130,13 @@ class RunTelemetry:
         self._total_ckpt_skipped = 0
         self._total_nan_rollbacks = 0
         self._total_preemptions = 0
+        self._total_crash_checkpoints = 0
         self._total_resume_fallbacks = 0
+        # policy-serving accounting (sheeprl_tpu.serve): the server's own
+        # counters are cumulative, so the run_end totals keep the LAST
+        # serve_stats snapshot; supervision/swap events are counted by kind
+        self._serve_last_stats: Optional[Dict[str, Any]] = None
+        self._serve_events: Dict[str, int] = {}
 
     # -- core event plumbing -------------------------------------------------
 
@@ -248,6 +254,31 @@ class RunTelemetry:
         boundary: one ``preempt`` event + run_end counter."""
         self._total_preemptions += 1
         self.emit("preempt", signum=int(signum), **fields)
+        self.writer.flush()
+
+    def record_crash_checkpoint(self, path: str, error: str, **fields: Any) -> None:
+        """An unhandled train-loop exception drained the async writer and
+        committed an emergency checkpoint before re-raising: one
+        ``crash_checkpoint`` event + run_end counter."""
+        self._total_crash_checkpoints += 1
+        self.emit("crash_checkpoint", path=path, error=error, **fields)
+        self.writer.flush()
+
+    def record_serve_stats(self, snapshot: Mapping[str, Any]) -> None:
+        """One periodic serving-tier stats snapshot (QPS, queue depth, shed
+        counts, p50/p95, replica/swap health): a ``serve_stats`` event; the
+        last snapshot becomes the ``run_end`` serve totals."""
+        snap = dict(snapshot)
+        self._serve_last_stats = snap
+        self.emit("serve_stats", **snap)
+        self.writer.flush()
+
+    def record_serve_event(self, kind: str, **fields: Any) -> None:
+        """One serving supervision/swap event (``replica_restart``,
+        ``replica_masked``, ``replica_hung``, ``swap``, ``swap_rejected``,
+        ``rollback``): a ``serve_event`` line + run_end per-kind counters."""
+        self._serve_events[kind] = self._serve_events.get(kind, 0) + 1
+        self.emit("serve_event", kind=kind, **fields)
         self.writer.flush()
 
     def record_resume_fallback(self, path: str, error: str, **fields: Any) -> None:
@@ -439,8 +470,17 @@ class RunTelemetry:
         self.maybe_poll_devices(force=True)
 
     def close(self) -> None:
+        serve_fields: Dict[str, Any] = {}
+        # only serving runs grow a `serve` section: training-run run_end
+        # consumers keep seeing exactly the fields they already parse
+        if self._serve_last_stats is not None or self._serve_events:
+            serve_fields["serve"] = {
+                "stats": self._serve_last_stats or {},
+                "events": dict(self._serve_events),
+            }
         self.emit(
             "run_end",
+            **serve_fields,
             compiles_total=self.watchdog.compiles,
             recompiles=self.watchdog.recompiles,
             device_polls=self._device_polls,
@@ -457,6 +497,7 @@ class RunTelemetry:
             ckpt_skipped=self._total_ckpt_skipped,
             nan_rollbacks=self._total_nan_rollbacks,
             preemptions=self._total_preemptions,
+            crash_checkpoints=self._total_crash_checkpoints,
             resume_fallbacks=self._total_resume_fallbacks,
         )
         self.watchdog.stop()
@@ -598,12 +639,36 @@ def telemetry_preemption(signum: int, **fields: Any) -> None:
         tel.record_preemption(signum, **fields)
 
 
+def telemetry_crash_checkpoint(path: str, error: str, **fields: Any) -> None:
+    """Record a crash-guard emergency save (see
+    :meth:`RunTelemetry.record_crash_checkpoint`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_crash_checkpoint(path, error, **fields)
+
+
 def telemetry_resume_fallback(path: str, error: str, **fields: Any) -> None:
     """Record an auto-resume candidate rejection (see
     :meth:`RunTelemetry.record_resume_fallback`); no-op when telemetry is off."""
     tel = _active_telemetry
     if tel is not None:
         tel.record_resume_fallback(path, error, **fields)
+
+
+def telemetry_serve_stats(snapshot: Mapping[str, Any]) -> None:
+    """Record a serving-tier stats snapshot (see
+    :meth:`RunTelemetry.record_serve_stats`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_serve_stats(snapshot)
+
+
+def telemetry_serve_event(kind: str, **fields: Any) -> None:
+    """Record a serving supervision/swap event (see
+    :meth:`RunTelemetry.record_serve_event`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_serve_event(kind, **fields)
 
 
 def telemetry_register_flops(jitted_fn: Any, *args: Any, scale: float = 1.0) -> None:

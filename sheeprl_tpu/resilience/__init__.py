@@ -31,7 +31,7 @@ from sheeprl_tpu.resilience.autoresume import (
     resolve_auto_resume,
     scan_run_checkpoints,
 )
-from sheeprl_tpu.resilience.manager import ROLLBACK_KEY_SALT, RunResilience
+from sheeprl_tpu.resilience.manager import ROLLBACK_KEY_SALT, RunResilience, crash_drain
 from sheeprl_tpu.resilience.manifest import (
     CommittedCheckpoint,
     build_manifest,
@@ -57,6 +57,7 @@ __all__ = [
     "build_manifest",
     "checkpoint_step",
     "committed_checkpoints",
+    "crash_drain",
     "drain_async_checkpoints",
     "emit_pending_resilience_events",
     "gc_torn",

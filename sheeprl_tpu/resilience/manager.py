@@ -17,6 +17,13 @@ behaviours so an algo main wires resilience with four calls:
   under the live tree's shardings and fork the sample key away from the
   stream that produced the NaN.
 
+A fourth behaviour needs no polling: ``arm_crash_guard(...)`` registers the
+same checkpoint closures so an UNHANDLED exception anywhere in the loop also
+drains the async writer and commits an emergency checkpoint before the
+exception propagates (``cli.run_algorithm`` calls :func:`crash_drain` from
+its except path) — a crashed run restarts with
+``checkpoint.resume_from=auto`` just like a preempted one.
+
 Everything is config-gated under ``resilience.*`` and inert when
 ``resilience.enabled=False`` (every poll is then a plain attribute read).
 """
@@ -26,9 +33,9 @@ from __future__ import annotations
 import os
 import sys
 import warnings
-from typing import Any, Dict, Mapping, Optional
+from typing import Any, Callable, Dict, Mapping, Optional
 
-from sheeprl_tpu.obs import telemetry_nan_rollback, telemetry_preemption
+from sheeprl_tpu.obs import telemetry_crash_checkpoint, telemetry_nan_rollback, telemetry_preemption
 from sheeprl_tpu.resilience.async_writer import drain_async_checkpoints
 from sheeprl_tpu.resilience.manifest import committed_checkpoints
 from sheeprl_tpu.resilience.preemption import PREEMPTED_EXIT_CODE, PreemptionWatcher
@@ -38,6 +45,23 @@ from sheeprl_tpu.resilience.sentinel import host_all_finite, parse_nan_faults
 # sample salt (ops.superstep.SAMPLE_KEY_SALT) so a rolled-back run cannot
 # replay the exact RNG stream that produced the non-finite step
 ROLLBACK_KEY_SALT = 0x0BAD
+
+# the RunResilience whose crash guard is currently armed: the algo main arms
+# it with its checkpoint closures, cli.run_algorithm routes any unhandled
+# entrypoint exception through crash_drain() before re-raising
+_ARMED_GUARD: Optional["RunResilience"] = None
+
+
+def crash_drain(err: BaseException) -> Optional[str]:
+    """Entry for :func:`sheeprl_tpu.cli.run_algorithm`'s crash path: if a
+    training loop armed its crash guard, drain the async writer and write an
+    emergency checkpoint (best-effort — the original exception always
+    propagates). Returns the checkpoint path, or ``None`` when no guard is
+    armed or the save was skipped."""
+    guard = _ARMED_GUARD
+    if guard is None:
+        return None
+    return guard.crash_checkpoint(err)
 
 
 class RunResilience:
@@ -53,6 +77,10 @@ class RunResilience:
         self.rollbacks = 0
         self._nan_faults = parse_nan_faults(res_cfg) if self.enabled else set()
         self._fired_faults: set = set()
+        self.crash_checkpoints = self.enabled and bool(res_cfg.get("crash_checkpoint", True))
+        self._crash_path_fn: Optional[Callable[[], str]] = None
+        self._crash_state_fn: Optional[Callable[[], Dict[str, Any]]] = None
+        self._crash_buffer_fn: Optional[Callable[[], Any]] = None
         self.watcher: Optional[PreemptionWatcher] = None
         if self.enabled and bool(res_cfg.get("preemption", True)):
             self.watcher = PreemptionWatcher().install()
@@ -92,6 +120,81 @@ class RunResilience:
         if self.watcher is not None:
             self.watcher.uninstall()
         sys.exit(PREEMPTED_EXIT_CODE)
+
+    # -- crash guard ---------------------------------------------------------
+
+    def arm_crash_guard(
+        self,
+        *,
+        path_fn: Callable[[], str],
+        state_fn: Callable[[], Dict[str, Any]],
+        replay_buffer_fn: Optional[Callable[[], Any]] = None,
+    ) -> None:
+        """Register the loop's checkpoint closures so an UNHANDLED exception
+        gets the same drain-and-emergency-save treatment as a preemption
+        signal (``crash_drain`` runs them from ``cli.run_algorithm``'s except
+        path). The closures read the loop's current bindings at crash time —
+        pass the same ``ckpt_path_fn``/``ckpt_state_fn`` lambdas the
+        preemption branch uses."""
+        if not self.crash_checkpoints:
+            return
+        global _ARMED_GUARD
+        self._crash_path_fn = path_fn
+        self._crash_state_fn = state_fn
+        self._crash_buffer_fn = replay_buffer_fn
+        _ARMED_GUARD = self
+
+    def disarm_crash_guard(self) -> None:
+        global _ARMED_GUARD
+        self._crash_path_fn = None
+        self._crash_state_fn = None
+        self._crash_buffer_fn = None
+        if _ARMED_GUARD is self:
+            _ARMED_GUARD = None
+
+    def crash_checkpoint(self, err: BaseException) -> Optional[str]:
+        """Best-effort crash-path emergency save: drain the async writer so
+        any in-flight committed checkpoint lands, then save the loop's current
+        state through the normal callback path (manifest marked ``emergency``)
+        so ``checkpoint.resume_from=auto`` restarts from the crash boundary.
+        Never raises — the ORIGINAL exception must propagate unmasked."""
+        path_fn, state_fn, buffer_fn = self._crash_path_fn, self._crash_state_fn, self._crash_buffer_fn
+        self.disarm_crash_guard()  # at-most-once, even on nested failures
+        if path_fn is None or state_fn is None:
+            return None
+        try:
+            drain_async_checkpoints()
+        except Exception as drain_err:  # noqa: BLE001 — crash path stays silent
+            warnings.warn(f"crash guard: async-writer drain failed ({drain_err!r})")
+        if self.fabric.num_processes > 1:
+            # one crashing rank cannot enter the save collectives alone
+            # without deadlocking the healthy ranks — the drained in-flight
+            # checkpoint is the best recovery point multi-host can offer
+            warnings.warn(
+                "crash guard: skipping the emergency checkpoint on a multi-process "
+                "run (the save is collective); the drained async checkpoint is the "
+                "newest recovery point"
+            )
+            return None
+        try:
+            path = str(path_fn())
+            self.fabric.call(
+                "on_checkpoint_coupled",
+                ckpt_path=path,
+                state=state_fn(),
+                replay_buffer=buffer_fn() if buffer_fn is not None else None,
+                emergency=True,
+            )
+        except Exception as save_err:  # noqa: BLE001 — never mask the crash
+            warnings.warn(f"crash guard: emergency checkpoint failed ({save_err!r})")
+            return None
+        telemetry_crash_checkpoint(path, repr(err))
+        warnings.warn(
+            f"unhandled {type(err).__name__} in the train loop — wrote emergency "
+            f"checkpoint {path!r}; rerun with checkpoint.resume_from=auto to continue "
+            "from this boundary"
+        )
+        return path
 
     # -- non-finite sentinel -------------------------------------------------
 
@@ -187,6 +290,7 @@ class RunResilience:
 
     def close(self) -> None:
         """Drain background saves and release the signal handlers."""
+        self.disarm_crash_guard()
         drain_async_checkpoints()
         if self.watcher is not None:
             self.watcher.uninstall()

@@ -31,13 +31,14 @@ strips the distributed-coordinator environment before the child imports jax.
 from sheeprl_tpu.rollout.config import PoolConfig, pool_config_from_cfg
 from sheeprl_tpu.rollout.fault_injection import FaultSchedule, FaultSpec, parse_fault_config
 from sheeprl_tpu.rollout.pool import EnvPool
-from sheeprl_tpu.rollout.supervisor import WorkerDied, WorkerTimeout
+from sheeprl_tpu.rollout.supervisor import RestartBudget, WorkerDied, WorkerTimeout
 
 __all__ = [
     "EnvPool",
     "FaultSchedule",
     "FaultSpec",
     "PoolConfig",
+    "RestartBudget",
     "WorkerDied",
     "WorkerTimeout",
     "parse_fault_config",

@@ -29,6 +29,7 @@ class PoolConfig:
     spawn_timeout_s: float = 120.0
     heartbeat_grace_s: Optional[float] = None  # default: step_timeout_s
     max_restarts: int = 3
+    restart_refund_s: Optional[float] = 600.0  # healthy seconds refunding one restart; None disables
     backoff_base_s: float = 0.5
     backoff_max_s: float = 10.0
     copy_obs: bool = True
@@ -63,6 +64,11 @@ def pool_config_from_cfg(cfg: Mapping[str, Any]) -> PoolConfig:
         spawn_timeout_s=float(_get(node, "spawn_timeout_s", 120.0)),
         heartbeat_grace_s=_get(node, "heartbeat_grace_s", None),
         max_restarts=int(_get(node, "max_restarts", 3)),
+        restart_refund_s=(
+            float(_get(node, "restart_refund_s", 600.0))
+            if _get(node, "restart_refund_s", 600.0) is not None
+            else None
+        ),
         backoff_base_s=float(_get(node, "backoff_base_s", 0.5)),
         backoff_max_s=float(_get(node, "backoff_max_s", 10.0)),
         copy_obs=bool(_get(node, "copy_obs", True)),

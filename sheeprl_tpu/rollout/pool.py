@@ -280,7 +280,12 @@ class EnvPool:
                 return self._sup.wait_reply(handle)
             except (WorkerDied, WorkerTimeout) as err:
                 reason = "timeout" if isinstance(err, WorkerTimeout) else "crash"
-                if handle.restarts >= self.config.max_restarts:
+                exhausted = (
+                    handle.budget.exhausted
+                    if handle.budget is not None
+                    else handle.restarts >= self.config.max_restarts
+                )
+                if exhausted:
                     self._sup.mask(handle, reason)
                     return None
                 if phase == "reset":

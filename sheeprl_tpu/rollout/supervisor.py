@@ -34,6 +34,57 @@ from sheeprl_tpu.rollout.shm import ShmSpec
 from sheeprl_tpu.rollout.worker import sanitize_worker_environ, worker_main
 
 
+class RestartBudget:
+    """Restart budget with a healthy-window refund.
+
+    A plain ``max_restarts`` cap conflates two failure shapes: a worker that
+    crash-loops (restarts do not help — mask it) and a long-lived worker that
+    accumulates rare, uncorrelated faults over hours (restarts always help —
+    but the cap eventually masks it exactly when graceful degradation matters
+    most). The refund separates them: every ``refund_after_s`` seconds WITHOUT
+    a fault hands one restart back, so only faults *clustered* inside a
+    healthy window can exhaust the budget. ``refund_after_s=None`` disables
+    the refund (the original fixed-cap behaviour).
+
+    Not thread-safe by itself — callers serialize (the rollout pool charges
+    from the stepping thread, the serve supervisor from its monitor thread).
+    """
+
+    def __init__(self, max_restarts: int, refund_after_s: Optional[float] = None, clock=time.monotonic) -> None:
+        self.max_restarts = int(max_restarts)
+        self.refund_after_s = float(refund_after_s) if refund_after_s else None
+        self._clock = clock
+        self.used = 0
+        self._last_fault_t: Optional[float] = None
+
+    def _refund(self) -> None:
+        if self.refund_after_s is None or self.used <= 0 or self._last_fault_t is None:
+            return
+        windows = int((self._clock() - self._last_fault_t) / self.refund_after_s)
+        if windows > 0:
+            self.used = max(0, self.used - windows)
+            # keep the remainder of the current window so two refunds cannot
+            # ride one healthy stretch
+            self._last_fault_t += windows * self.refund_after_s
+            if self.used == 0:
+                self._last_fault_t = None
+
+    @property
+    def exhausted(self) -> bool:
+        """True once the budget cannot absorb another fault — the caller
+        masks instead of restarting."""
+        self._refund()
+        return self.used >= self.max_restarts
+
+    def charge(self) -> int:
+        """Record one fault/restart; returns the post-refund charge count
+        (1-based within the current fault cluster — feeds the backoff)."""
+        self._refund()
+        self.used += 1
+        self._last_fault_t = self._clock()
+        return self.used
+
+
 class WorkerDied(RuntimeError):
     def __init__(self, worker: int, detail: str = "") -> None:
         super().__init__(f"env worker {worker} died{': ' + detail if detail else ''}")
@@ -57,7 +108,8 @@ class WorkerHandle:
         self.thunk_blob = thunk_blob
         self.proc = None
         self.conn = None
-        self.restarts = 0
+        self.restarts = 0  # lifetime total (telemetry); the maskable budget is `budget`
+        self.budget: Optional[RestartBudget] = None  # attached by Supervisor.launch
         self.masked = False
         self.video_slots: List[int] = []
 
@@ -101,6 +153,10 @@ class Supervisor:
     def launch(self, handle: WorkerHandle) -> None:
         """Start ``handle``'s process (no handshake — boots overlap when the
         pool launches every worker before waiting on any of them)."""
+        if handle.budget is None:
+            handle.budget = RestartBudget(
+                self.config.max_restarts, getattr(self.config, "restart_refund_s", None)
+            )
         parent_conn, child_conn = self._ctx.Pipe(duplex=True)
         proc = self._ctx.Process(
             target=worker_main,
@@ -208,9 +264,13 @@ class Supervisor:
         budget)."""
         self.kill(handle)
         handle.restarts += 1
+        # backoff scales with the budget's post-refund charge count, not the
+        # lifetime total: a fault after a long healthy stretch restarts fast
+        # again instead of inheriting hours-old backoff escalation
+        charge = handle.budget.charge() if handle.budget is not None else handle.restarts
         if self.on_restart is not None:
             self.on_restart(handle.index, reason, handle.restarts)
-        time.sleep(self.backoff_s(handle.restarts))
+        time.sleep(self.backoff_s(charge))
         self.spawn(handle)
         if self._shm_specs is None:
             raise RuntimeError("restart before shared-memory allocation")

@@ -1,0 +1,137 @@
+"""Serving-tier knobs, parsed once from the composed config's ``serve`` node.
+
+Everything lives under top-level ``serve`` (``configs/config.yaml``) so CLI
+overrides read ``serve.slo_ms=50``; a checkpoint written before the node
+existed composes to all-defaults (``serve_config_from_cfg({})`` is valid).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Mapping, Optional
+
+from sheeprl_tpu.serve.fault_injection import ServeFaultSpec, parse_serve_faults
+
+
+@dataclass
+class LoadConfig:
+    """Scripted load-generator run (``serve.load.*``): the CLI's measurable
+    proxy for "heavy traffic" — N concurrent closed-loop clients (optionally
+    rate-limited) hammering the server for ``duration_s``."""
+
+    enabled: bool = False
+    duration_s: float = 10.0
+    concurrency: int = 8
+    rate_hz: float = 0.0  # >0: open-loop target request rate across all clients
+    timeout_ms: Optional[float] = None  # per-request client deadline; None: server default
+    max_retries: int = 3
+    seed: int = 0
+
+
+@dataclass
+class ServeConfig:
+    """Parameters for :class:`~sheeprl_tpu.serve.server.PolicyServer`.
+
+    The SLO drives the derived knobs: the micro-batcher coalesces requests
+    for at most ``gather_window_s`` (default ``slo_ms / 5``) so queueing can
+    never eat the whole latency budget, and requests default to a
+    ``4 * slo_ms`` deadline.
+    """
+
+    batch_ladder: List[int] = field(default_factory=lambda: [1, 2, 4, 8])
+    slo_ms: float = 100.0
+    gather_window_ms: Optional[float] = None  # None: slo_ms / 5, capped at 10ms
+    max_queue: int = 64  # admission-control bound (pending requests)
+    default_deadline_ms: Optional[float] = None  # None: 4 * slo_ms
+    num_replicas: int = 2
+    max_restarts: int = 3
+    restart_refund_s: Optional[float] = 600.0  # healthy window refunding one restart
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    replica_timeout_s: float = 30.0  # stale heartbeat -> replica presumed hung
+    breaker_threshold: int = 3  # consecutive inference failures trip the replica
+    monitor_interval_s: float = 0.05
+    swap_poll_s: float = 0.0  # >0: watch the ckpt dir and hot-swap newer manifests
+    stats_interval_s: float = 5.0  # serve_stats telemetry cadence
+    faults: List[ServeFaultSpec] = field(default_factory=list)
+    load: LoadConfig = field(default_factory=LoadConfig)
+
+    def __post_init__(self) -> None:
+        ladder = sorted({int(b) for b in self.batch_ladder})
+        if not ladder or ladder[0] < 1:
+            raise ValueError(f"serve.batch_ladder must be positive ints, got {self.batch_ladder!r}")
+        self.batch_ladder = ladder
+        if self.num_replicas < 1:
+            raise ValueError(f"serve.num_replicas must be >= 1, got {self.num_replicas}")
+        if self.max_queue < 1:
+            raise ValueError(f"serve.max_queue must be >= 1, got {self.max_queue}")
+
+    @property
+    def max_batch(self) -> int:
+        return self.batch_ladder[-1]
+
+    @property
+    def gather_window_s(self) -> float:
+        if self.gather_window_ms is not None:
+            return float(self.gather_window_ms) / 1e3
+        return min(self.slo_ms / 5.0, 10.0) / 1e3
+
+    @property
+    def default_deadline_s(self) -> float:
+        if self.default_deadline_ms is not None:
+            return float(self.default_deadline_ms) / 1e3
+        return 4.0 * self.slo_ms / 1e3
+
+    def backoff_s(self, charge: int) -> float:
+        return min(self.backoff_max_s, self.backoff_base_s * (2 ** max(0, charge - 1)))
+
+
+def serve_config_from_cfg(cfg: Mapping[str, Any]) -> ServeConfig:
+    """Build a :class:`ServeConfig` from the composed run config's ``serve``
+    node (absent node -> all defaults, faults disabled)."""
+    node = _get(cfg, "serve") or {}
+    fault_node = _get(node, "fault_injection") or {}
+    faults: List[ServeFaultSpec] = []
+    if bool(_get(fault_node, "enabled", False)):
+        faults = parse_serve_faults(_get(fault_node, "faults") or [])
+    load_node = _get(node, "load") or {}
+    load = LoadConfig(
+        enabled=bool(_get(load_node, "enabled", False)),
+        duration_s=float(_get(load_node, "duration_s", 10.0)),
+        concurrency=int(_get(load_node, "concurrency", 8)),
+        rate_hz=float(_get(load_node, "rate_hz", 0.0) or 0.0),
+        timeout_ms=_opt_float(_get(load_node, "timeout_ms", None)),
+        max_retries=int(_get(load_node, "max_retries", 3)),
+        seed=int(_get(load_node, "seed", 0)),
+    )
+    return ServeConfig(
+        batch_ladder=list(_get(node, "batch_ladder", None) or [1, 2, 4, 8]),
+        slo_ms=float(_get(node, "slo_ms", 100.0)),
+        gather_window_ms=_opt_float(_get(node, "gather_window_ms", None)),
+        max_queue=int(_get(node, "max_queue", 64)),
+        default_deadline_ms=_opt_float(_get(node, "default_deadline_ms", None)),
+        num_replicas=int(_get(node, "num_replicas", 2)),
+        max_restarts=int(_get(node, "max_restarts", 3)),
+        restart_refund_s=_opt_float(_get(node, "restart_refund_s", 600.0)),
+        backoff_base_s=float(_get(node, "backoff_base_s", 0.05)),
+        backoff_max_s=float(_get(node, "backoff_max_s", 2.0)),
+        replica_timeout_s=float(_get(node, "replica_timeout_s", 30.0)),
+        breaker_threshold=int(_get(node, "breaker_threshold", 3)),
+        monitor_interval_s=float(_get(node, "monitor_interval_s", 0.05)),
+        swap_poll_s=float(_get(node, "swap_poll_s", 0.0) or 0.0),
+        stats_interval_s=float(_get(node, "stats_interval_s", 5.0)),
+        faults=faults,
+        load=load,
+    )
+
+
+def _opt_float(v: Any) -> Optional[float]:
+    return None if v is None else float(v)
+
+
+def _get(node: Any, key: str, default: Any = None) -> Any:
+    if node is None:
+        return default
+    if hasattr(node, "get"):
+        return node.get(key, default)
+    return getattr(node, key, default)

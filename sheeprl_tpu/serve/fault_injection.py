@@ -1,0 +1,137 @@
+"""Deterministic fault injection for the serving tier.
+
+Same philosophy as ``rollout.fault_injection``: the recovery paths are
+exercised by scheduled drills in CI, not discovered in production. Faults are
+owned by the *schedule* (parent-side state), not by the replica that executes
+them, so a crashed-and-restarted replica cannot lose the record of which
+faults already fired.
+
+Config shape (``serve.fault_injection`` in the composed config)::
+
+    serve:
+      fault_injection:
+        enabled: true
+        faults:
+          - {kind: replica_crash,  replica: 0, at_batch: 5}
+          - {kind: slow_inference, replica: 0, at_batch: 2, duration_s: 0.2, for_batches: 20}
+          - {kind: poison_swap, at_swap: 1}
+
+``kind``:
+
+- ``replica_crash`` — replica ``replica`` raises before processing its
+  ``at_batch``-th batch (the batch is re-queued first, so no request is
+  dropped); the supervisor sees the dead thread and restarts it under the
+  restart budget.
+- ``slow_inference`` — replica ``replica`` sleeps ``duration_s`` before each
+  of ``for_batches`` consecutive batches starting at ``at_batch``; drives the
+  queue toward its bound so admission control sheds.
+- ``poison_swap`` — the ``at_swap``-th swap *attempt* (1-based) has its
+  freshly loaded weights NaN-poisoned after the load, so the promotion
+  validation must reject it and keep serving the previous executable.
+
+``at_batch`` counts batches *processed by that replica* (a monotone
+per-replica counter that survives restarts). Each fault fires exactly once
+(``slow_inference`` covers its window, then expires).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, List, Mapping, Sequence
+
+_KINDS = ("replica_crash", "slow_inference", "poison_swap")
+
+
+@dataclass
+class ServeFaultSpec:
+    kind: str
+    replica: int = 0
+    at_batch: int = 0
+    at_swap: int = 1
+    duration_s: float = 0.0
+    for_batches: int = 1
+
+    def __post_init__(self) -> None:
+        self.kind = str(self.kind).lower()
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown serve fault kind {self.kind!r}; expected one of {_KINDS}")
+        self.replica = int(self.replica)
+        self.at_batch = int(self.at_batch)
+        self.at_swap = int(self.at_swap)
+        self.duration_s = float(self.duration_s)
+        self.for_batches = int(self.for_batches)
+        if self.replica < 0:
+            raise ValueError(f"serve fault replica index must be >= 0, got {self.replica}")
+        if self.at_batch < 0:
+            raise ValueError(f"serve fault at_batch must be >= 0, got {self.at_batch}")
+        if self.kind == "poison_swap" and self.at_swap < 1:
+            raise ValueError(f"serve fault at_swap is 1-based, got {self.at_swap}")
+        if self.for_batches < 1:
+            raise ValueError(f"serve fault for_batches must be >= 1, got {self.for_batches}")
+
+
+def parse_serve_faults(node: Sequence[Mapping[str, Any]]) -> List[ServeFaultSpec]:
+    faults = []
+    for i, entry in enumerate(node):
+        if not hasattr(entry, "get"):
+            raise ValueError(f"serve.fault_injection.faults[{i}] must be a mapping, got {entry!r}")
+        if "kind" not in entry:
+            raise ValueError(f"serve.fault_injection.faults[{i}] needs a kind, got {dict(entry)!r}")
+        faults.append(
+            ServeFaultSpec(
+                kind=entry["kind"],
+                replica=int(entry.get("replica", 0)),
+                at_batch=int(entry.get("at_batch", 0)),
+                at_swap=int(entry.get("at_swap", 1)),
+                duration_s=float(entry.get("duration_s", 0.0) or 0.0),
+                for_batches=int(entry.get("for_batches", 1)),
+            )
+        )
+    return faults
+
+
+class ServeFaultSchedule:
+    """Thread-safe: replicas and the swap watcher query concurrently."""
+
+    def __init__(self, faults: Sequence[ServeFaultSpec]) -> None:
+        self._lock = threading.Lock()
+        self._batch_faults = [f for f in faults if f.kind in ("replica_crash", "slow_inference")]
+        self._swap_faults = [f for f in faults if f.kind == "poison_swap"]
+
+    def __bool__(self) -> bool:
+        with self._lock:
+            return bool(self._batch_faults or self._swap_faults)
+
+    def batch_faults(self, replica: int, batch_index: int) -> List[ServeFaultSpec]:
+        """Faults due for ``replica``'s ``batch_index``-th batch. A
+        ``replica_crash`` whose step the replica already passed (scheduled
+        while it was restarting) fires on the next batch, mirroring the
+        rollout schedule's nothing-silently-dropped rule."""
+        due: List[ServeFaultSpec] = []
+        with self._lock:
+            remaining = []
+            for f in self._batch_faults:
+                if f.replica != replica:
+                    remaining.append(f)
+                elif f.kind == "replica_crash" and f.at_batch <= batch_index:
+                    due.append(f)
+                elif f.kind == "slow_inference" and f.at_batch <= batch_index < f.at_batch + f.for_batches:
+                    due.append(f)
+                    remaining.append(f)  # stays scheduled for its whole window
+                elif f.kind == "slow_inference" and batch_index >= f.at_batch + f.for_batches:
+                    pass  # window over: expire
+                else:
+                    remaining.append(f)
+            self._batch_faults = remaining
+        return due
+
+    def poison_swap(self, attempt: int) -> bool:
+        """True when the ``attempt``-th swap attempt (1-based) must have its
+        loaded weights poisoned before validation."""
+        with self._lock:
+            for f in list(self._swap_faults):
+                if f.at_swap <= attempt:
+                    self._swap_faults.remove(f)
+                    return True
+        return False

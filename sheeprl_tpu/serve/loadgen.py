@@ -1,0 +1,153 @@
+"""Scripted load generator: the measurable proxy for production traffic.
+
+Runs ``concurrency`` client threads against a started
+:class:`~sheeprl_tpu.serve.server.PolicyServer` for ``duration_s``:
+
+- **closed-loop** (default, ``rate_hz == 0``): each client fires its next
+  request as soon as the previous one resolves — the classic
+  concurrency-bounded load that finds the server's natural throughput.
+- **open-loop** (``rate_hz > 0``): clients pace to an aggregate target rate,
+  which can exceed capacity — the shape that drives shedding drills.
+
+Each client is a :class:`~sheeprl_tpu.serve.client.ServeClient` (retry +
+backoff on ``Overloaded``), observations are drawn per-request from a seeded
+RNG, and the run report is a plain dict (ok/shed/expired counts, retries,
+qps, p50/p95) that ``--serve-stats`` and the acceptance tests both consume —
+the SLO claim in the docs is literally this report.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from sheeprl_tpu.serve.client import ServeClient
+from sheeprl_tpu.serve.config import LoadConfig
+from sheeprl_tpu.serve.errors import DeadlineExceeded, Overloaded, ServeError, ServerClosed
+from sheeprl_tpu.serve.server import PolicyServer
+
+
+def _default_obs_factory(server: PolicyServer) -> Callable[[np.random.Generator], Any]:
+    """Random observations matching the policy's per-request spec."""
+    import jax
+
+    spec = server.policy.obs_spec
+
+    def make(rng: np.random.Generator) -> Any:
+        def leaf(s: Any) -> np.ndarray:
+            if np.issubdtype(s.dtype, np.integer):
+                return rng.integers(0, 255, size=tuple(s.shape)).astype(s.dtype)
+            return rng.standard_normal(tuple(s.shape)).astype(s.dtype)
+
+        return jax.tree.map(leaf, spec)
+
+    return make
+
+
+class _Worker(threading.Thread):
+    def __init__(
+        self,
+        wid: int,
+        server: PolicyServer,
+        cfg: LoadConfig,
+        stop: threading.Event,
+        obs_factory: Callable[[np.random.Generator], Any],
+        interval_s: float,
+    ) -> None:
+        super().__init__(name=f"loadgen-{wid}", daemon=True)
+        self.client = ServeClient(
+            server,
+            max_retries=cfg.max_retries,
+            timeout_s=(cfg.timeout_ms / 1e3) if cfg.timeout_ms else None,
+            seed=cfg.seed * 10_000 + wid,
+        )
+        self._halt = stop
+        self._obs_factory = obs_factory
+        self._rng = np.random.default_rng(cfg.seed * 10_000 + wid)
+        self._interval_s = interval_s  # 0: closed loop
+        self.ok = 0
+        self.shed = 0
+        self.expired = 0
+        self.errors = 0
+        self.latencies: List[float] = []
+
+    def run(self) -> None:
+        next_t = time.monotonic()
+        while not self._halt.is_set():
+            if self._interval_s > 0:
+                now = time.monotonic()
+                if now < next_t:
+                    if self._halt.wait(min(next_t - now, 0.05)):
+                        break
+                    continue
+                next_t += self._interval_s
+            obs = self._obs_factory(self._rng)
+            t0 = time.monotonic()
+            try:
+                self.client.infer(obs)
+            except Overloaded:
+                self.shed += 1
+            except DeadlineExceeded:
+                self.expired += 1
+            except ServerClosed:
+                break
+            except ServeError:
+                self.errors += 1
+            else:
+                self.ok += 1
+                self.latencies.append(time.monotonic() - t0)
+
+
+def run_load(
+    server: PolicyServer,
+    cfg: LoadConfig,
+    *,
+    obs_factory: Optional[Callable[[np.random.Generator], Any]] = None,
+) -> Dict[str, Any]:
+    """Drive the load shape described by ``cfg``; returns the run report."""
+    factory = obs_factory or _default_obs_factory(server)
+    interval_s = cfg.concurrency / cfg.rate_hz if cfg.rate_hz > 0 else 0.0
+    stop = threading.Event()
+    workers = [
+        _Worker(i, server, cfg, stop, factory, interval_s) for i in range(cfg.concurrency)
+    ]
+    t0 = time.monotonic()
+    for w in workers:
+        w.start()
+    stop.wait(cfg.duration_s)
+    stop.set()
+    for w in workers:
+        w.join(5.0)
+    elapsed = time.monotonic() - t0
+
+    lats = sorted(l for w in workers for l in w.latencies)
+
+    def pct(q: float) -> Optional[float]:
+        if not lats:
+            return None
+        idx = min(len(lats) - 1, max(0, int(np.ceil(q * len(lats))) - 1))
+        return lats[idx] * 1e3
+
+    ok = sum(w.ok for w in workers)
+    report: Dict[str, Any] = {
+        "duration_s": elapsed,
+        "concurrency": cfg.concurrency,
+        "mode": "open-loop" if cfg.rate_hz > 0 else "closed-loop",
+        "target_rate_hz": cfg.rate_hz or None,
+        "ok": ok,
+        "shed": sum(w.shed for w in workers),
+        "expired": sum(w.expired for w in workers),
+        "errors": sum(w.errors for w in workers),
+        "client_retries": sum(w.client.retries for w in workers),
+        "client_rejections": sum(w.client.rejected for w in workers),
+        "qps": ok / elapsed if elapsed > 0 else 0.0,
+        "p50_ms": pct(0.50),
+        "p95_ms": pct(0.95),
+        "slo_ms": server.config.slo_ms,
+    }
+    p95 = report["p95_ms"]
+    report["slo_met"] = bool(p95 is not None and p95 <= server.config.slo_ms)
+    return report

@@ -1,0 +1,297 @@
+"""The model side of the serving tier: AOT-compiled batch ladder + hot swap.
+
+**Batch ladder.** The policy forward is AOT-compiled once per rung of
+``serve.batch_ladder`` via ``jax.jit(...).lower(...).compile()`` *before the
+server accepts traffic*, so no request ever pays a JIT compile. At inference
+a gathered micro-batch is zero-padded up to the nearest rung and the outputs
+sliced back — a bounded ladder keeps the executable cache small while
+padding waste stays under 2x with the default power-of-two rungs.
+
+**Hot swap.** The AOT executables close over *shapes*, not weights: params
+are a call argument. A newer committed checkpoint can therefore be promoted
+atomically by replacing the params reference — no recompilation, no serving
+gap. Promotion is validate-then-promote; a candidate must pass ALL of:
+
+1. committed manifest present (torn writes are invisible by construction —
+   the scan only sees :func:`committed_checkpoints`),
+2. manifest ``tree_digest``/``leaf_count`` match the loaded state (detects a
+   corrupted or foreign checkpoint behind a valid-looking manifest),
+3. extracted params are structurally identical to the serving params (same
+   treedef, leaf shapes and dtypes — the precondition for executable reuse),
+4. all weights finite (a NaN-poisoned checkpoint must not reach traffic),
+5. a smoke inference through the smallest rung returns finite outputs.
+
+Any failure leaves the previous version serving (the "rollback" is that
+promotion never happened); :meth:`ModelStore.rollback` additionally restores
+the previous params if a promoted version misbehaves post-swap.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from sheeprl_tpu.resilience.manifest import CommittedCheckpoint, committed_checkpoints, tree_digest
+from sheeprl_tpu.resilience.sentinel import host_all_finite
+from sheeprl_tpu.serve.errors import SwapRejected
+from sheeprl_tpu.serve.fault_injection import ServeFaultSchedule
+from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+
+@dataclass
+class ServedPolicy:
+    """Everything the server needs to run one policy:
+
+    - ``apply(params, obs_batch) -> action_batch`` — pure, jit-able; obs and
+      action batches are pytrees whose leaves carry a leading batch dim,
+    - ``params`` — the initial weights (from the checkpoint being served),
+    - ``obs_spec`` — pytree of per-request ``jax.ShapeDtypeStruct`` (no batch
+      dim) that requests must match,
+    - ``params_from_state(state)`` — extract the params pytree from a raw
+      loaded checkpoint state dict (used again at every hot swap).
+    """
+
+    name: str
+    apply: Callable[[Any, Any], Any]
+    params: Any
+    obs_spec: Any
+    params_from_state: Callable[[Dict[str, Any]], Any]
+
+
+class ModelVersion(NamedTuple):
+    step: int
+    path: str
+    params: Any
+
+
+def _batched_spec(obs_spec: Any, batch: int) -> Any:
+    return jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct((batch,) + tuple(s.shape), s.dtype), obs_spec
+    )
+
+
+def stack_obs(obs_spec: Any, obs_list: Sequence[Any], batch: int) -> Any:
+    """Stack per-request observations into one batch of size ``batch``
+    (zero-padding past ``len(obs_list)``), coercing leaves to the spec dtype
+    so they match what the executables were lowered against."""
+
+    def build(spec: Any, *leaves: Any) -> np.ndarray:
+        out = np.zeros((batch,) + tuple(spec.shape), dtype=spec.dtype)
+        for i, leaf in enumerate(leaves):
+            out[i] = np.asarray(leaf, dtype=spec.dtype)
+        return out
+
+    return jax.tree.map(build, obs_spec, *obs_list)
+
+
+class CompiledLadder:
+    """One AOT executable per batch rung, warmed eagerly at construction."""
+
+    def __init__(self, policy: ServedPolicy, ladder: Sequence[int]) -> None:
+        self.policy = policy
+        self.rungs = sorted({int(b) for b in ladder})
+        self.compile_s: Dict[int, float] = {}
+        self._compiled: Dict[int, Any] = {}
+        jitted = jax.jit(policy.apply)
+        for b in self.rungs:
+            t0 = time.perf_counter()
+            self._compiled[b] = jitted.lower(policy.params, _batched_spec(policy.obs_spec, b)).compile()
+            self.compile_s[b] = time.perf_counter() - t0
+
+    @property
+    def max_batch(self) -> int:
+        return self.rungs[-1]
+
+    def rung_for(self, n: int) -> int:
+        for b in self.rungs:
+            if b >= n:
+                return b
+        raise ValueError(f"batch of {n} exceeds top ladder rung {self.max_batch}")
+
+    def run(self, params: Any, obs_list: Sequence[Any]) -> List[Any]:
+        """Run ``len(obs_list)`` requests through the nearest rung; returns
+        one host-side action pytree per request (padding sliced away)."""
+        n = len(obs_list)
+        rung = self.rung_for(n)
+        batch = stack_obs(self.policy.obs_spec, obs_list, rung)
+        out = jax.device_get(self._compiled[rung](params, batch))
+        return [jax.tree.map(lambda leaf: leaf[i], out) for i in range(n)]
+
+
+class ModelStore:
+    """The atomically-swappable current model version.
+
+    ``on_event(kind, info)`` (kinds ``swap`` / ``swap_rejected`` /
+    ``rollback``) is the stats hook; exceptions from it are swallowed.
+    """
+
+    def __init__(
+        self,
+        policy: ServedPolicy,
+        ladder: CompiledLadder,
+        *,
+        step: int,
+        path: str,
+        fault_schedule: Optional[ServeFaultSchedule] = None,
+        on_event: Optional[Callable[[str, Dict[str, Any]], None]] = None,
+    ) -> None:
+        self.policy = policy
+        self.ladder = ladder
+        self._faults = fault_schedule
+        self._on_event = on_event
+        self._lock = threading.Lock()
+        self._current = ModelVersion(int(step), str(path), policy.params)
+        self._previous: Optional[ModelVersion] = None
+        self.swap_attempts = 0
+        self.swaps = 0
+        self.swap_rejects = 0
+        self.rollbacks = 0
+
+    # ---------------------------------------------------------------- serving
+    @property
+    def current(self) -> ModelVersion:
+        return self._current  # reference read is atomic; swaps replace wholesale
+
+    def infer(self, obs_list: Sequence[Any]) -> List[Any]:
+        version = self._current
+        return self.ladder.run(version.params, obs_list)
+
+    # ------------------------------------------------------------------- swap
+    def maybe_swap_newest(self, ckpt_dir: str) -> Optional[ModelVersion]:
+        """Promote the newest committed checkpoint in ``ckpt_dir`` if it is
+        strictly newer than the serving one. Returns the new version on
+        promotion, ``None`` otherwise (including rejections, which are
+        recorded, not raised — the watcher must keep serving)."""
+        committed = committed_checkpoints(ckpt_dir)
+        fresh = [c for c in committed if c.step > self._current.step]
+        if not fresh:
+            return None
+        candidate = fresh[-1]
+        ok, reason = self.try_swap(candidate)
+        return self._current if ok else None
+
+    def request_swap(self, candidate: CommittedCheckpoint) -> ModelVersion:
+        """Explicit-swap API: promote or raise :class:`SwapRejected`."""
+        ok, reason = self.try_swap(candidate)
+        if not ok:
+            raise SwapRejected(f"checkpoint {candidate.path} rejected: {reason}")
+        return self._current
+
+    def try_swap(self, candidate: CommittedCheckpoint) -> Tuple[bool, str]:
+        """Validate-then-promote ``candidate``. Never raises on a bad
+        checkpoint — returns ``(False, reason)`` and keeps serving."""
+        self.swap_attempts += 1
+        attempt = self.swap_attempts
+        try:
+            state = load_checkpoint(candidate.path)
+        except Exception as err:
+            return self._reject(candidate, f"load failed: {err!r}")
+
+        man = candidate.manifest
+        if man.get("tree_digest") is not None:
+            leaf_count, digest = tree_digest(state)
+            if (leaf_count, digest) != (man.get("leaf_count"), man.get("tree_digest")):
+                return self._reject(
+                    candidate,
+                    f"state digest ({leaf_count}, {digest}) != manifest "
+                    f"({man.get('leaf_count')}, {man.get('tree_digest')}) — torn or foreign checkpoint",
+                )
+
+        try:
+            params = self.policy.params_from_state(state)
+        except Exception as err:
+            return self._reject(candidate, f"params extraction failed: {err!r}")
+
+        mismatch = _structure_mismatch(self._current.params, params)
+        if mismatch:
+            return self._reject(candidate, f"params structure changed: {mismatch}")
+
+        if self._faults is not None and self._faults.poison_swap(attempt):
+            params = _poison(params)
+
+        if not host_all_finite(jax.device_get(params)):
+            return self._reject(candidate, "non-finite weights (poisoned checkpoint)")
+
+        try:
+            smoke = self.ladder.run(params, [_zero_obs(self.policy.obs_spec)])
+            if not host_all_finite(smoke):
+                return self._reject(candidate, "smoke inference produced non-finite outputs")
+        except Exception as err:
+            return self._reject(candidate, f"smoke inference failed: {err!r}")
+
+        with self._lock:
+            self._previous = self._current
+            self._current = ModelVersion(candidate.step, candidate.path, params)
+            self.swaps += 1
+        self._emit("swap", {"step": candidate.step, "path": candidate.path, "attempt": attempt})
+        return True, "promoted"
+
+    def rollback(self) -> Optional[ModelVersion]:
+        """Restore the previous version (post-swap escape hatch). Returns the
+        now-serving version, or ``None`` when there is nothing to roll back."""
+        with self._lock:
+            if self._previous is None:
+                return None
+            bad, self._current, self._previous = self._current, self._previous, None
+            self.rollbacks += 1
+        self._emit("rollback", {"from_step": bad.step, "to_step": self._current.step})
+        return self._current
+
+    # ------------------------------------------------------------------ misc
+    def _reject(self, candidate: CommittedCheckpoint, reason: str) -> Tuple[bool, str]:
+        self.swap_rejects += 1
+        self._emit("swap_rejected", {"step": candidate.step, "path": candidate.path, "reason": reason})
+        return False, reason
+
+    def _emit(self, kind: str, info: Dict[str, Any]) -> None:
+        if self._on_event is not None:
+            try:
+                self._on_event(kind, info)
+            except Exception:
+                pass
+
+
+def _structure_mismatch(current: Any, new: Any) -> Optional[str]:
+    """Human-readable first difference between two param trees (treedef,
+    leaf shapes or dtypes), or ``None`` when they are executable-compatible."""
+    cur_flat, cur_def = jax.tree.flatten(current)
+    new_flat, new_def = jax.tree.flatten(new)
+    if cur_def != new_def:
+        return f"tree structure differs ({cur_def} vs {new_def})"
+    for i, (a, b) in enumerate(zip(cur_flat, new_flat)):
+        a_shape, b_shape = np.shape(a), np.shape(b)
+        if a_shape != b_shape:
+            return f"leaf {i} shape {b_shape} != serving {a_shape}"
+        a_dtype = getattr(a, "dtype", np.asarray(a).dtype)
+        b_dtype = getattr(b, "dtype", np.asarray(b).dtype)
+        if a_dtype != b_dtype:
+            return f"leaf {i} dtype {b_dtype} != serving {a_dtype}"
+    return None
+
+
+def _poison(params: Any) -> Any:
+    """NaN-poison the first inexact leaf (fault injection: a checkpoint whose
+    weights were corrupted after commit)."""
+    flat, treedef = jax.tree.flatten(params)
+    for i, leaf in enumerate(flat):
+        arr = np.asarray(jax.device_get(leaf))
+        if arr.dtype.kind == "f":
+            bad = arr.copy()
+            bad.flat[0] = np.nan
+            flat[i] = bad
+            break
+    return jax.tree.unflatten(treedef, flat)
+
+
+def _zero_obs(obs_spec: Any) -> Any:
+    return jax.tree.map(lambda s: np.zeros(tuple(s.shape), dtype=s.dtype), obs_spec)
+
+
+def newest_committed(ckpt_dir: str) -> Optional[CommittedCheckpoint]:
+    committed = committed_checkpoints(ckpt_dir)
+    return committed[-1] if committed else None

@@ -1,0 +1,147 @@
+"""Policy builders: checkpoint state -> a servable forward + obs spec.
+
+A builder takes the run config stored beside the checkpoint plus the loaded
+state and returns a :class:`~sheeprl_tpu.serve.model.ServedPolicy` — the
+pure ``apply``, the initial params, the per-request observation spec and the
+``params_from_state`` extractor hot swaps re-use. Registered per algorithm
+name (the serve CLI dispatches on ``cfg.algo.name`` exactly like eval does);
+``linear`` is the env-free synthetic policy the unit tests and drills serve
+so the robustness machinery is testable without gymnasium or a real
+checkpointed run.
+
+Serving is greedy and stateless: the PPO forward takes the distribution
+mode, so the PRNG key baked into the compiled executable is never consulted
+and identical observations yield identical actions across replicas — which
+is what lets a crashed replica's re-queued request be re-served anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import numpy as np
+
+from sheeprl_tpu.serve.model import ServedPolicy
+
+POLICY_BUILDERS: Dict[str, Callable[..., ServedPolicy]] = {}
+
+
+def register_policy_builder(*names: str) -> Callable:
+    def deco(fn: Callable[..., ServedPolicy]) -> Callable[..., ServedPolicy]:
+        for name in names:
+            POLICY_BUILDERS[name] = fn
+        return fn
+
+    return deco
+
+
+def build_served_policy(cfg: Any, state: Dict[str, Any]) -> ServedPolicy:
+    """Dispatch on ``cfg.algo.name``. Unsupported algorithms fail with the
+    list of servable ones, mirroring the eval registry's error shape."""
+    name = cfg["algo"]["name"]
+    builder = POLICY_BUILDERS.get(name)
+    if builder is None:
+        raise ValueError(
+            f"no policy builder registered for algorithm '{name}'; "
+            f"servable algorithms: {sorted(POLICY_BUILDERS)}"
+        )
+    return builder(cfg, state)
+
+
+@register_policy_builder("ppo", "ppo_decoupled")
+def build_ppo_policy(cfg: Any, state: Dict[str, Any]) -> ServedPolicy:
+    """Greedy PPO serving forward: ``obs -> env-ready actions`` (per-part
+    integer indices for discrete spaces, raw vectors for continuous)."""
+    import gymnasium as gym
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.ppo.agent import build_agent, real_actions_from_onehot, sample_actions
+    from sheeprl_tpu.envs import make_env
+    from sheeprl_tpu.parallel.fabric import Fabric
+
+    # spaces come from one throwaway env exactly like evaluate() builds them
+    env = make_env(cfg, cfg["seed"], 0, None, "serve", vector_env_idx=0)()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    env.close()
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"unexpected observation space for serving: {observation_space}")
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+
+    fabric = Fabric(devices=1, precision=str(cfg["fabric"].get("precision", "fp32")))
+    agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, state["agent"])
+
+    # per-request obs spec: the post-`prepare_obs` layout (frame stack folded
+    # into channels, pixels uint8, vectors float32), WITHOUT the batch axis
+    spec: Dict[str, jax.ShapeDtypeStruct] = {}
+    for k in agent.cnn_keys:
+        shape = observation_space[k].shape
+        if len(shape) == 4:  # [S,H,W,C] stacked -> [H,W,S*C]
+            s, h, w, c = shape
+            shape = (h, w, s * c)
+        spec[k] = jax.ShapeDtypeStruct(tuple(shape), np.uint8)
+    for k in agent.mlp_keys:
+        spec[k] = jax.ShapeDtypeStruct(tuple(observation_space[k].shape), np.float32)
+
+    greedy_key = jax.random.PRNGKey(0)  # never consulted: greedy takes the mode
+
+    def apply(p: Any, obs: Dict[str, Any]) -> Any:
+        actions, _, _ = sample_actions(agent, p, obs, greedy_key, greedy=True)
+        return real_actions_from_onehot(agent.actions_dim, agent.is_continuous, actions)
+
+    def params_from_state(new_state: Dict[str, Any]) -> Any:
+        # same placement pipeline build_agent runs on a restore
+        new = jax.tree.map(jnp.asarray, new_state["agent"])
+        new = jax.tree.map(lambda x: x.astype(fabric.precision.param_dtype), new)
+        return fabric.replicate(new)
+
+    return ServedPolicy(
+        name=cfg["algo"]["name"],
+        apply=apply,
+        params=params,
+        obs_spec=spec,
+        params_from_state=params_from_state,
+    )
+
+
+@register_policy_builder("linear")
+def build_linear_policy(cfg: Any, state: Dict[str, Any]) -> ServedPolicy:
+    """Synthetic env-free policy for tests and serving drills: a single
+    linear layer over a flat observation. State layout matches the real
+    algos (``state["agent"]`` holds the params pytree)."""
+    import jax
+    import jax.numpy as jnp
+
+    params = jax.tree.map(jnp.asarray, state["agent"])
+    in_dim = int(np.asarray(params["w"]).shape[0])
+
+    def apply(p: Any, obs: Dict[str, Any]) -> Any:
+        return obs["vector"] @ p["w"] + p["b"]
+
+    return ServedPolicy(
+        name="linear",
+        apply=apply,
+        params=params,
+        obs_spec={"vector": jax.ShapeDtypeStruct((in_dim,), np.float32)},
+        params_from_state=lambda s: jax.tree.map(jnp.asarray, s["agent"]),
+    )
+
+
+def make_linear_state(in_dim: int = 4, out_dim: int = 2, seed: int = 0) -> Dict[str, Any]:
+    """A deterministic ``state`` dict servable by the ``linear`` builder —
+    what the tests checkpoint, commit and hot-swap."""
+    rng = np.random.default_rng(seed)
+    return {
+        "agent": {
+            "w": rng.standard_normal((in_dim, out_dim)).astype(np.float32),
+            "b": rng.standard_normal((out_dim,)).astype(np.float32),
+        },
+        "update": 0,
+    }

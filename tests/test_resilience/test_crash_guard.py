@@ -1,0 +1,148 @@
+"""Crash guard (RunResilience.arm_crash_guard + cli crash_drain): an
+unhandled train-loop exception drains the async writer, commits an emergency
+checkpoint through the normal callback path and re-raises — so a crashed run
+restarts with checkpoint.resume_from=auto exactly like a preempted one."""
+
+import json
+import os
+
+import pytest
+
+from sheeprl_tpu.resilience import RunResilience, committed_checkpoints, crash_drain, read_manifest
+from sheeprl_tpu.resilience import manager as manager_mod
+from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+
+class _FakeFabric:
+    num_processes = 1
+    world_size = 1
+    is_global_zero = True
+
+    def __init__(self):
+        self.calls = []
+
+    def call(self, hook, **kwargs):
+        self.calls.append((hook, kwargs))
+
+
+def _cfg(**res):
+    # preemption=False: unit tests must not install signal handlers
+    return {"resilience": {"enabled": True, "preemption": False, **res}, "checkpoint": {}}
+
+
+@pytest.fixture(autouse=True)
+def _clean_guard():
+    yield
+    manager_mod._ARMED_GUARD = None
+
+
+def test_crash_drain_unarmed_is_noop(tmp_path):
+    assert crash_drain(RuntimeError("boom")) is None
+
+
+def test_crash_checkpoint_saves_once_and_disarms(tmp_path):
+    fabric = _FakeFabric()
+    resil = RunResilience(fabric, _cfg(), str(tmp_path))
+    state = {"agent": {"w": 1.0}, "update": 4}
+    resil.arm_crash_guard(
+        path_fn=lambda: str(tmp_path / "ckpt_64_0.ckpt"),
+        state_fn=lambda: state,
+    )
+    path = crash_drain(RuntimeError("boom"))
+    assert path == str(tmp_path / "ckpt_64_0.ckpt")
+    assert fabric.calls == [
+        (
+            "on_checkpoint_coupled",
+            {"ckpt_path": path, "state": state, "replay_buffer": None, "emergency": True},
+        )
+    ]
+    # at-most-once: the guard disarmed itself
+    assert crash_drain(RuntimeError("again")) is None
+    assert len(fabric.calls) == 1
+
+
+def test_crash_guard_config_gated(tmp_path):
+    fabric = _FakeFabric()
+    resil = RunResilience(fabric, _cfg(crash_checkpoint=False), str(tmp_path))
+    resil.arm_crash_guard(path_fn=lambda: "x", state_fn=lambda: {})
+    assert crash_drain(RuntimeError("boom")) is None
+    assert fabric.calls == []
+
+
+def test_crash_guard_never_masks_the_original_error(tmp_path):
+    """A failing state_fn (e.g. NameError on a not-yet-bound loop variable)
+    is swallowed with a warning — the crash path must stay silent."""
+    resil = RunResilience(_FakeFabric(), _cfg(), str(tmp_path))
+    resil.arm_crash_guard(
+        path_fn=lambda: "x",
+        state_fn=lambda: (_ for _ in ()).throw(NameError("update")),
+    )
+    with pytest.warns(UserWarning, match="emergency checkpoint failed"):
+        assert crash_drain(RuntimeError("boom")) is None
+
+
+def test_crash_guard_skips_save_on_multiprocess(tmp_path):
+    """One crashing rank cannot enter the save collectives alone — only the
+    async-writer drain runs, no checkpoint call."""
+    fabric = _FakeFabric()
+    fabric.num_processes = 2
+    resil = RunResilience(fabric, _cfg(), str(tmp_path))
+    resil.arm_crash_guard(path_fn=lambda: "x", state_fn=lambda: {})
+    with pytest.warns(UserWarning, match="multi-process"):
+        assert crash_drain(RuntimeError("boom")) is None
+    assert fabric.calls == []
+
+
+def test_close_disarms(tmp_path):
+    resil = RunResilience(_FakeFabric(), _cfg(), str(tmp_path))
+    resil.arm_crash_guard(path_fn=lambda: "x", state_fn=lambda: {})
+    resil.close()
+    assert crash_drain(RuntimeError("boom")) is None
+
+
+def test_crash_drill_emergency_save_and_auto_resume(tmp_path, monkeypatch):
+    """End to end, in process: a RuntimeError injected at the update-2
+    boundary propagates out of cli.run (the crash guard does NOT eat it), a
+    committed emergency checkpoint of update 1 lands, and resume_from=auto
+    continues the run to completion."""
+    from tests.test_resilience.test_drills import _ckpt_dirs, _telemetry_events, drill_args
+
+    from sheeprl_tpu.cli import run
+
+    monkeypatch.chdir(tmp_path)
+    args = drill_args(tmp_path) + ["checkpoint.every=0"]
+
+    orig = RunResilience.preempt_requested
+    count = [0]
+
+    def exploding_poll(self):
+        count[0] += 1
+        if count[0] == 2:
+            raise RuntimeError("injected train-loop crash")
+        return orig(self)
+
+    monkeypatch.setattr(RunResilience, "preempt_requested", exploding_poll)
+    with pytest.raises(RuntimeError, match="injected train-loop crash"):
+        run(args)
+    monkeypatch.setattr(RunResilience, "preempt_requested", orig)
+
+    (ckpt_dir,) = _ckpt_dirs(tmp_path)
+    (emergency,) = committed_checkpoints(ckpt_dir)
+    assert emergency.step == 64  # policy step at the update-2 boundary
+    assert read_manifest(emergency.path)["emergency"] is True
+    assert load_checkpoint(emergency.path)["update"] == 1  # update 2 never ran
+
+    events, _ = _telemetry_events(tmp_path)
+    crashes = [e for e in events if e["event"] == "crash_checkpoint"]
+    assert len(crashes) == 1
+    assert crashes[0]["path"] == emergency.path
+    assert "injected train-loop crash" in crashes[0]["error"]
+    run_end = [e for e in events if e["event"] == "run_end"][-1]
+    assert run_end["crash_checkpoints"] == 1
+
+    # the crashed run restarts exactly like a preempted one
+    run(args + ["checkpoint.resume_from=auto"])
+    finals = [
+        c for d in _ckpt_dirs(tmp_path) for c in committed_checkpoints(d) if c.step == 256
+    ]
+    assert finals and load_checkpoint(finals[0].path)["update"] == 4

@@ -8,7 +8,7 @@ import pytest
 from sheeprl_tpu.envs import resolve_env_backend
 from sheeprl_tpu.rollout import FaultSpec, FaultSchedule, PoolConfig, parse_fault_config, pool_config_from_cfg
 from sheeprl_tpu.rollout.shm import ShmObsBuffers, obs_layout
-from sheeprl_tpu.rollout.supervisor import Supervisor
+from sheeprl_tpu.rollout.supervisor import RestartBudget, Supervisor
 from sheeprl_tpu.rollout.worker import _COORDINATOR_VARS, sanitize_worker_environ
 from sheeprl_tpu.utils.utils import dotdict
 
@@ -157,3 +157,58 @@ def test_sanitize_worker_environ():
     assert out["SHEEPRL_TPU_ENV_WORKER"] == "1"
     assert out["HOME"] == "/root"
     assert not any(var in out for var in _COORDINATOR_VARS)
+
+
+def test_restart_budget_fixed_cap_without_refund():
+    budget = RestartBudget(max_restarts=2, refund_after_s=None)
+    assert not budget.exhausted
+    assert budget.charge() == 1
+    assert budget.charge() == 2
+    assert budget.exhausted  # cap reached, no healthy window can save it
+
+
+def test_restart_budget_healthy_window_refunds():
+    now = [0.0]
+    budget = RestartBudget(max_restarts=2, refund_after_s=100.0, clock=lambda: now[0])
+    assert budget.charge() == 1
+    assert budget.charge() == 2
+    assert budget.exhausted
+    # one full healthy window refunds one restart — the worker earns back
+    # headroom instead of staying one fault from a mask forever
+    now[0] = 101.0
+    assert not budget.exhausted
+    assert budget.used == 1
+    # the next fault's backoff restarts from the post-refund charge count
+    assert budget.charge() == 2
+    # two windows refund two, clamped at zero
+    now[0] = 301.0
+    assert not budget.exhausted
+    assert budget.used == 0
+
+
+def test_restart_budget_refund_keeps_window_remainder():
+    """A 1.5-window healthy stretch refunds exactly one restart and the
+    leftover half-window still counts toward the next refund."""
+    now = [0.0]
+    budget = RestartBudget(max_restarts=3, refund_after_s=100.0, clock=lambda: now[0])
+    budget.charge()
+    budget.charge()
+    now[0] = 150.0
+    assert not budget.exhausted
+    assert budget.used == 1
+    # only 50s more completes the window that already half-elapsed
+    now[0] = 200.0
+    assert not budget.exhausted
+    assert budget.used == 0
+
+
+def test_restart_budget_clustered_faults_still_mask():
+    """Faults inside one window get no refund — a crash-looping worker is
+    masked exactly as with the fixed cap."""
+    now = [0.0]
+    budget = RestartBudget(max_restarts=2, refund_after_s=100.0, clock=lambda: now[0])
+    budget.charge()
+    now[0] = 50.0
+    budget.charge()
+    now[0] = 99.0
+    assert budget.exhausted

@@ -1,0 +1,75 @@
+"""Shared fixtures for the serving-tier tests: committed linear-policy
+checkpoints on disk (the env-free synthetic policy) and a PolicyServer
+factory with drill-friendly supervision timings."""
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import pytest
+
+from sheeprl_tpu.resilience.manifest import build_manifest
+from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+
+def commit_linear(ckpt_dir: str, step: int, *, seed: int = 0, state: Optional[Dict[str, Any]] = None) -> Tuple[str, Dict[str, Any]]:
+    """Write a COMMITTED linear-policy checkpoint (payload + manifest) the
+    way a training run would, returning ``(path, state)``."""
+    from sheeprl_tpu.serve.policy import make_linear_state
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    state = state if state is not None else make_linear_state(seed=seed)
+    path = os.path.join(ckpt_dir, f"ckpt_{step}_0.ckpt")
+    man = build_manifest(step=step, backend="pickle", world_size=1, state=state)
+    save_checkpoint(path, state, backend="pickle", manifest=man)
+    return path, state
+
+
+# supervision timings tuned for drills: fast monitor, near-zero backoff, a
+# small ladder so tests stay sub-second outside the deliberate fault windows
+DRILL_SERVE: Dict[str, Any] = {
+    "batch_ladder": [1, 2, 4],
+    "slo_ms": 200.0,
+    "monitor_interval_s": 0.01,
+    "backoff_base_s": 0.01,
+    "backoff_max_s": 0.05,
+    "replica_timeout_s": 5.0,
+}
+
+
+@pytest.fixture
+def make_server(tmp_path):
+    """Factory: a PolicyServer over a committed linear checkpoint at step
+    100. Keyword overrides merge into the drill serve node; every server is
+    closed at teardown even when the test raises."""
+    from sheeprl_tpu.serve.config import serve_config_from_cfg
+    from sheeprl_tpu.serve.policy import build_linear_policy
+    from sheeprl_tpu.serve.server import PolicyServer
+
+    servers = []
+
+    def build(**serve_overrides: Any) -> Tuple[PolicyServer, str, Dict[str, Any]]:
+        ckpt_dir = str(tmp_path / "checkpoint")
+        path, state = commit_linear(ckpt_dir, 100, seed=0)
+        policy = build_linear_policy({"algo": {"name": "linear"}}, state)
+        cfg = serve_config_from_cfg({"serve": {**DRILL_SERVE, **serve_overrides}})
+        server = PolicyServer(policy, cfg, step=100, path=path, ckpt_dir=ckpt_dir)
+        servers.append(server)
+        return server, ckpt_dir, state
+
+    yield build
+    for server in servers:
+        server.close()
+
+
+def linear_obs(state: Dict[str, Any], value: float = 1.0):
+    """A deterministic observation matching the linear policy's spec."""
+    import numpy as np
+
+    in_dim = state["agent"]["w"].shape[0]
+    return {"vector": np.full((in_dim,), value, dtype=np.float32)}
+
+
+def expected_action(state: Dict[str, Any], obs) -> Any:
+    import numpy as np
+
+    return np.asarray(obs["vector"]) @ state["agent"]["w"] + state["agent"]["b"]

@@ -1,0 +1,125 @@
+"""MicroBatcher units: bounded admission with typed shedding, SLO-derived
+gather window, deadline expiry at assembly, requeue-bypasses-admission, and
+fail-everything-on-close. jax-free (the batcher never touches the model)."""
+
+import threading
+import time
+
+import pytest
+
+from sheeprl_tpu.serve.batching import MicroBatcher
+from sheeprl_tpu.serve.errors import DeadlineExceeded, Overloaded, ServerClosed
+
+pytestmark = pytest.mark.serve
+
+
+def _batcher(max_queue=4, window=0.01, on_shed=None, clock=None):
+    kw = {"max_queue": max_queue, "gather_window_s": window, "on_shed": on_shed}
+    if clock is not None:
+        kw["clock"] = clock
+    return MicroBatcher(**kw)
+
+
+def test_admission_bound_sheds_typed_and_immediately():
+    shed = []
+    b = _batcher(max_queue=2, on_shed=shed.append)
+    b.submit({"x": 1}, deadline_s=10.0)
+    b.submit({"x": 2}, deadline_s=10.0)
+    t0 = time.monotonic()
+    with pytest.raises(Overloaded) as err:
+        b.submit({"x": 3}, deadline_s=10.0)
+    # shedding is a rejection at admission, not a blocking wait
+    assert time.monotonic() - t0 < 0.1
+    assert err.value.depth == 2 and err.value.bound == 2
+    assert err.value.retry_after_s > 0
+    assert shed == ["overloaded"]
+    assert b.depth() == 2  # nothing was enqueued for the shed request
+
+
+def test_next_batch_coalesces_up_to_max_within_window():
+    b = _batcher(max_queue=16, window=0.02)
+    for i in range(3):
+        b.submit({"x": i}, deadline_s=10.0)
+    batch = b.next_batch(max_batch=8, wait_timeout_s=0.5)
+    assert [r.obs["x"] for r in batch] == [0, 1, 2]
+    # an empty queue returns [] on timeout so replica loops can heartbeat
+    assert b.next_batch(max_batch=8, wait_timeout_s=0.01) == []
+
+
+def test_next_batch_closes_at_top_rung_without_waiting_out_the_window():
+    b = _batcher(max_queue=16, window=30.0)  # pathological window
+    for i in range(4):
+        b.submit({"x": i}, deadline_s=10.0)
+    t0 = time.monotonic()
+    batch = b.next_batch(max_batch=4, wait_timeout_s=0.5)
+    assert len(batch) == 4
+    assert time.monotonic() - t0 < 1.0  # full rung: no window wait
+    assert b.depth() == 0
+
+
+def test_expired_requests_fail_at_assembly_and_never_reach_the_model():
+    shed = []
+    b = _batcher(max_queue=8, on_shed=shed.append)
+    dead = b.submit({"x": 0}, deadline_s=0.0)  # already expired
+    live = b.submit({"x": 1}, deadline_s=10.0)
+    batch = b.next_batch(max_batch=8, wait_timeout_s=0.5)
+    assert [r.rid for r in batch] == [live.rid]
+    with pytest.raises(DeadlineExceeded):
+        dead.future.result(timeout=0)
+    assert shed == ["expired"]
+
+
+def test_requeue_front_of_queue_bypasses_admission_and_fails_expired():
+    shed = []
+    b = _batcher(max_queue=2, on_shed=shed.append)
+    first = b.submit({"x": 0}, deadline_s=10.0)
+    second = b.submit({"x": 1}, deadline_s=10.0)
+    batch = b.next_batch(max_batch=8, wait_timeout_s=0.5)
+    assert len(batch) == 2
+    # fill the queue back to its bound, then requeue the failed batch: the
+    # already-admitted requests MUST go back (no shedding of in-flight work)
+    b.submit({"x": 2}, deadline_s=10.0)
+    b.submit({"x": 3}, deadline_s=10.0)
+    b.requeue(batch)
+    assert b.depth() == 4  # above the admission bound, by design
+    nxt = b.next_batch(max_batch=8, wait_timeout_s=0.5)
+    # requeued requests come FIRST (they have waited longest), in order
+    assert [r.obs["x"] for r in nxt[:2]] == [0, 1]
+    assert all(r.attempts == 1 for r in (first, second))
+    # a requeued request past its deadline is completed exceptionally instead
+    expired = b.submit({"x": 4}, deadline_s=0.0)
+    b.next_batch(max_batch=8, wait_timeout_s=0.1)  # drains + fails it
+    with pytest.raises(DeadlineExceeded):
+        expired.future.result(timeout=0)
+    assert "expired" in shed
+
+
+def test_close_fails_pending_and_refuses_new_work():
+    b = _batcher()
+    req = b.submit({"x": 0}, deadline_s=10.0)
+    b.close()
+    with pytest.raises(ServerClosed):
+        req.future.result(timeout=0)
+    with pytest.raises(ServerClosed):
+        b.submit({"x": 1}, deadline_s=10.0)
+    # requeue after close fails the requests rather than stranding them
+    stranded = type(req)({"x": 2}, time.monotonic(), time.monotonic() + 10.0)
+    b.requeue([stranded])
+    with pytest.raises(ServerClosed):
+        stranded.future.result(timeout=0)
+
+
+def test_submit_wakes_a_waiting_replica():
+    b = _batcher(window=0.005)
+    got = []
+
+    def puller():
+        got.extend(b.next_batch(max_batch=4, wait_timeout_s=2.0))
+
+    t = threading.Thread(target=puller)
+    t.start()
+    time.sleep(0.05)  # puller is parked in the condition wait
+    b.submit({"x": 7}, deadline_s=10.0)
+    t.join(2.0)
+    assert not t.is_alive()
+    assert [r.obs["x"] for r in got] == [7]

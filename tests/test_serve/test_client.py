@@ -1,0 +1,62 @@
+"""ServeClient retry policy (jax-free: the client is duck-typed over the
+server) and the scripted load generator's report shape."""
+
+import pytest
+
+from sheeprl_tpu.serve.client import ServeClient
+from sheeprl_tpu.serve.errors import DeadlineExceeded, Overloaded, ServerClosed
+
+pytestmark = pytest.mark.serve
+
+
+class _ScriptedServer:
+    """infer() raises the scripted exceptions in order, then returns."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = 0
+
+    def infer(self, obs, deadline_s=None):
+        self.calls += 1
+        if self.script:
+            raise self.script.pop(0)
+        return {"action": 1}
+
+
+def _overloaded():
+    return Overloaded(4, 4, retry_after_s=0.001)
+
+
+def test_client_retries_overloaded_with_backoff_then_succeeds():
+    server = _ScriptedServer([_overloaded(), _overloaded()])
+    client = ServeClient(server, max_retries=3, seed=0)
+    assert client.infer({"x": 1}) == {"action": 1}
+    assert server.calls == 3
+    assert client.retries == 2 and client.rejected == 2
+
+
+def test_client_gives_up_after_max_retries():
+    server = _ScriptedServer([_overloaded()] * 10)
+    client = ServeClient(server, max_retries=2, seed=0)
+    with pytest.raises(Overloaded):
+        client.infer({"x": 1})
+    assert server.calls == 3  # initial + 2 retries
+    assert client.rejected == 3
+
+
+@pytest.mark.parametrize("err", [DeadlineExceeded(0.5, 0.5), ServerClosed("down")])
+def test_client_does_not_retry_terminal_failures(err):
+    server = _ScriptedServer([err])
+    client = ServeClient(server, max_retries=3, seed=0)
+    with pytest.raises(type(err)):
+        client.infer({"x": 1})
+    assert server.calls == 1 and client.retries == 0
+
+
+def test_client_never_backs_off_past_its_own_deadline():
+    # retry_after so large the jittered pause cannot fit the timeout budget
+    server = _ScriptedServer([Overloaded(4, 4, retry_after_s=10.0)] * 5)
+    client = ServeClient(server, max_retries=5, timeout_s=0.05, seed=0)
+    with pytest.raises(Overloaded):
+        client.infer({"x": 1})
+    assert client.retries == 0  # rejected, but no sleep was affordable

@@ -1,0 +1,117 @@
+"""ServeConfig derivation/validation, serve node parsing, fault-spec
+parsing and the fire-once fault schedule. jax-free."""
+
+import pytest
+
+from sheeprl_tpu.serve.config import ServeConfig, serve_config_from_cfg
+from sheeprl_tpu.serve.fault_injection import ServeFaultSchedule, ServeFaultSpec, parse_serve_faults
+
+pytestmark = pytest.mark.serve
+
+
+def test_slo_derives_gather_window_and_deadline():
+    cfg = ServeConfig(slo_ms=25.0)
+    assert cfg.gather_window_s == pytest.approx(0.005)  # slo/5
+    assert cfg.default_deadline_s == pytest.approx(0.1)  # 4x slo
+    # the window is capped at 10ms no matter how loose the SLO
+    assert ServeConfig(slo_ms=1000.0).gather_window_s == pytest.approx(0.010)
+    # explicit values win over derivation
+    explicit = ServeConfig(slo_ms=25.0, gather_window_ms=2.0, default_deadline_ms=50.0)
+    assert explicit.gather_window_s == pytest.approx(0.002)
+    assert explicit.default_deadline_s == pytest.approx(0.050)
+
+
+def test_ladder_sorted_deduped_and_validated():
+    cfg = ServeConfig(batch_ladder=[8, 1, 4, 4, 2])
+    assert cfg.batch_ladder == [1, 2, 4, 8]
+    assert cfg.max_batch == 8
+    with pytest.raises(ValueError, match="batch_ladder"):
+        ServeConfig(batch_ladder=[])
+    with pytest.raises(ValueError, match="batch_ladder"):
+        ServeConfig(batch_ladder=[0, 2])
+    with pytest.raises(ValueError, match="num_replicas"):
+        ServeConfig(num_replicas=0)
+    with pytest.raises(ValueError, match="max_queue"):
+        ServeConfig(max_queue=0)
+
+
+def test_restart_backoff_exponential_and_capped():
+    cfg = ServeConfig(backoff_base_s=0.05, backoff_max_s=0.4)
+    assert [cfg.backoff_s(n) for n in (1, 2, 3, 4, 10)] == [0.05, 0.1, 0.2, 0.4, 0.4]
+
+
+def test_serve_config_from_cfg_reads_node_and_defaults():
+    # a checkpoint written before the serve node existed composes to defaults
+    assert serve_config_from_cfg({}).slo_ms == 100.0
+    cfg = serve_config_from_cfg(
+        {
+            "serve": {
+                "slo_ms": 50,
+                "max_queue": 8,
+                "num_replicas": 3,
+                "fault_injection": {
+                    "enabled": True,
+                    "faults": [{"kind": "replica_crash", "replica": 1, "at_batch": 5}],
+                },
+                "load": {"enabled": True, "duration_s": 2, "concurrency": 4},
+            }
+        }
+    )
+    assert cfg.slo_ms == 50.0 and cfg.max_queue == 8 and cfg.num_replicas == 3
+    assert [f.kind for f in cfg.faults] == ["replica_crash"]
+    assert cfg.load.enabled and cfg.load.duration_s == 2.0 and cfg.load.concurrency == 4
+
+
+def test_faults_gated_by_enabled_flag():
+    cfg = serve_config_from_cfg(
+        {
+            "serve": {
+                "fault_injection": {
+                    "enabled": False,
+                    "faults": [{"kind": "replica_crash", "at_batch": 1}],
+                }
+            }
+        }
+    )
+    assert cfg.faults == []
+
+
+def test_fault_spec_validation():
+    with pytest.raises(ValueError, match="kind"):
+        ServeFaultSpec(kind="segfault")
+    with pytest.raises(ValueError, match="at_swap"):
+        ServeFaultSpec(kind="poison_swap", at_swap=0)
+    with pytest.raises(ValueError, match="mapping"):
+        parse_serve_faults(["replica_crash@5"])
+    with pytest.raises(ValueError, match="kind"):
+        parse_serve_faults([{"replica": 0}])
+
+
+def test_schedule_crash_fires_once_and_late():
+    sched = ServeFaultSchedule([ServeFaultSpec(kind="replica_crash", replica=0, at_batch=3)])
+    assert sched.batch_faults(1, 10) == []  # other replica: never
+    assert sched.batch_faults(0, 2) == []
+    # scheduled step was passed while the replica restarted: fire on the NEXT
+    # batch rather than silently dropping the drill
+    due = sched.batch_faults(0, 5)
+    assert [f.kind for f in due] == ["replica_crash"]
+    assert sched.batch_faults(0, 6) == []  # exactly once
+    assert not sched
+
+
+def test_schedule_slow_window_then_expires():
+    sched = ServeFaultSchedule(
+        [ServeFaultSpec(kind="slow_inference", replica=0, at_batch=2, duration_s=0.1, for_batches=3)]
+    )
+    assert sched.batch_faults(0, 1) == []
+    for b in (2, 3, 4):  # the whole window fires
+        assert [f.kind for f in sched.batch_faults(0, b)] == ["slow_inference"]
+    assert sched.batch_faults(0, 5) == []  # window over: expired
+    assert not sched
+
+
+def test_schedule_poison_swap_fires_once():
+    sched = ServeFaultSchedule([ServeFaultSpec(kind="poison_swap", at_swap=2)])
+    assert not sched.poison_swap(1)
+    assert sched.poison_swap(2)
+    assert not sched.poison_swap(3)  # consumed

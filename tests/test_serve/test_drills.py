@@ -1,0 +1,302 @@
+"""Serving-tier fault drills (ISSUE acceptance): AOT warmup before traffic,
+slow-inference overload -> bounded queue + typed shedding, replica crash ->
+restart with no lost request, budget exhaustion -> masked/degraded, all
+masked -> deadline-bounded failure, hot swap with zero dropped in-flight
+requests, poisoned/torn/mismatched swap rejection, and rollback."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serve.errors import DeadlineExceeded, Overloaded, ServeError, SwapRejected
+
+from .conftest import commit_linear, expected_action, linear_obs
+
+pytestmark = pytest.mark.serve
+
+
+def _wait_until(predicate, timeout_s=5.0, interval_s=0.01):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+def test_warmup_before_traffic_and_correct_actions(make_server):
+    server, _, state = make_server()
+    with pytest.raises(ServeError):
+        server.submit(linear_obs(state))  # no traffic before warmup
+    server.start()
+    # every rung was AOT-compiled during start()
+    assert sorted(server.warmup_s) == [1, 2, 4]
+    assert all(dt >= 0 for dt in server.warmup_s.values())
+    obs = linear_obs(state, value=0.5)
+    out = server.infer(obs)
+    np.testing.assert_allclose(out, expected_action(state, obs), rtol=1e-5)
+    snap = server.snapshot()
+    assert snap["completed"] == 1 and snap["submitted"] == 1
+    assert snap["serving_step"] == 100
+    assert snap["replicas_alive"] == 2 and not snap["degraded"]
+
+
+def test_concurrent_requests_coalesce_into_batches(make_server):
+    server, _, state = make_server(num_replicas=1, gather_window_ms=20.0)
+    server.start()
+    results, errors = [], []
+
+    def one():
+        try:
+            results.append(server.infer(linear_obs(state)))
+        except Exception as err:  # noqa: BLE001 — drill collects everything
+            errors.append(err)
+
+    threads = [threading.Thread(target=one) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5.0)
+    assert not errors and len(results) == 8
+    snap = server.snapshot()
+    assert snap["batches"] < 8  # some requests rode a shared rung
+    assert snap["mean_batch"] > 1.0
+
+
+def test_slow_inference_drill_bounded_queue_and_typed_shedding(make_server):
+    """The overload drill: one replica held slow by fault injection, a burst
+    of submits -> the queue never grows past its bound, extras are rejected
+    with a typed Overloaded immediately (not queued to time out), and
+    in-flight requests still fail by their own deadline, never hang."""
+    server, _, state = make_server(
+        num_replicas=1,
+        max_queue=3,
+        slo_ms=50.0,
+        fault_injection={
+            "enabled": True,
+            "faults": [
+                {"kind": "slow_inference", "replica": 0, "at_batch": 0, "duration_s": 0.15, "for_batches": 200}
+            ],
+        },
+    )
+    server.start()
+    obs = linear_obs(state)
+    overloads, admitted = 0, []
+    for _ in range(30):
+        t0 = time.monotonic()
+        try:
+            admitted.append(server.submit(obs, deadline_s=0.4))
+        except Overloaded as err:
+            overloads += 1
+            assert err.depth >= err.bound == 3
+            assert err.retry_after_s > 0
+        # shed or admitted, the submit path never blocks
+        assert time.monotonic() - t0 < 0.1
+        assert server.batcher.depth() <= 3
+    assert overloads > 0, "the bounded queue never shed under a slow replica"
+    # admitted requests resolve by their deadline: served or DeadlineExceeded
+    t0 = time.monotonic()
+    outcomes = []
+    for req in admitted:
+        try:
+            outcomes.append(server.wait(req))
+        except DeadlineExceeded:
+            outcomes.append("expired")
+    assert time.monotonic() - t0 < 5.0  # bounded, not hung
+    snap = server.snapshot()
+    assert snap["shed_overloaded"] == overloads
+    assert snap["shed_overloaded"] + snap["shed_expired"] > 0
+
+
+def test_replica_crash_restart_serves_requeued_request(make_server):
+    """Crash drill: the injected crash requeues the batch first, the
+    supervisor restarts the replica under budget, and the SAME request is
+    served by the next incarnation — nothing dropped."""
+    server, _, state = make_server(
+        num_replicas=1,
+        slo_ms=500.0,
+        fault_injection={
+            "enabled": True,
+            "faults": [{"kind": "replica_crash", "replica": 0, "at_batch": 1}],
+        },
+    )
+    server.start()
+    obs = linear_obs(state, value=2.0)
+    np.testing.assert_allclose(server.infer(obs), expected_action(state, obs), rtol=1e-5)  # batch 0
+    out = server.infer(obs)  # batch 1 crashes mid-flight; restart re-serves it
+    np.testing.assert_allclose(out, expected_action(state, obs), rtol=1e-5)
+    assert _wait_until(lambda: server.replicas.total_restarts == 1)
+    snap = server.snapshot()
+    assert snap["restarts"] == 1 and not snap["degraded"]
+    assert snap["events"].get("replica_restart") == 1
+
+
+def test_budget_exhausted_masks_slot_and_serves_degraded(make_server):
+    """Repeated crashes exhaust the slot's restart budget: the slot is
+    masked (not restarted forever), the server keeps serving on N-1 and
+    reports degraded mode."""
+    server, _, state = make_server(
+        num_replicas=2,
+        max_restarts=1,
+        restart_refund_s=None,
+        slo_ms=500.0,
+        fault_injection={
+            "enabled": True,
+            "faults": [
+                {"kind": "replica_crash", "replica": 0, "at_batch": 0},
+                {"kind": "replica_crash", "replica": 0, "at_batch": 1},
+            ],
+        },
+    )
+    server.start()
+    obs = linear_obs(state)
+
+    def drive():
+        try:
+            server.infer(obs, deadline_s=0.5)
+        except ServeError:
+            pass
+
+    assert _wait_until(lambda: (drive(), server.replicas.masked_count == 1)[-1], timeout_s=10.0)
+    snap = server.snapshot()
+    assert snap["replicas_masked"] == 1 and snap["degraded"]
+    assert snap["events"].get("replica_masked") == 1
+    # the surviving replica still serves correctly
+    np.testing.assert_allclose(server.infer(obs), expected_action(state, obs), rtol=1e-5)
+
+
+def test_all_masked_fails_by_deadline_not_hang(make_server):
+    """With every slot masked the server stays up and requests fail by
+    their own deadline — the typed failure clients can reason about."""
+    server, _, state = make_server(
+        num_replicas=1,
+        max_restarts=0,  # first fault masks immediately
+        fault_injection={
+            "enabled": True,
+            "faults": [{"kind": "replica_crash", "replica": 0, "at_batch": 0}],
+        },
+    )
+    server.start()
+    obs = linear_obs(state)
+    try:  # triggers the crash; may or may not be re-served before the mask
+        server.infer(obs, deadline_s=0.3)
+    except ServeError:
+        pass
+    assert _wait_until(lambda: server.replicas.all_masked)
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded):
+        server.infer(obs, deadline_s=0.3)
+    assert time.monotonic() - t0 < 2.0  # bounded by the deadline, not hung
+
+
+def test_hot_swap_zero_dropped_in_flight(make_server):
+    """Swap drill: continuous traffic while a newer committed checkpoint is
+    promoted — zero failed requests, and every response matches either the
+    old or the new params (never garbage)."""
+    server, ckpt_dir, state = make_server(num_replicas=2, slo_ms=500.0)
+    server.start()
+    new_path, new_state = commit_linear(ckpt_dir, 200, seed=7)
+    obs = linear_obs(state, value=1.0)
+    old_expected = expected_action(state, obs)
+    new_expected = expected_action(new_state, obs)
+
+    stop = threading.Event()
+    failures, outputs = [], []
+
+    def traffic():
+        while not stop.is_set():
+            try:
+                outputs.append(server.infer(obs))
+            except Exception as err:  # noqa: BLE001 — the drill counts everything
+                failures.append(err)
+
+    threads = [threading.Thread(target=traffic) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)  # in-flight traffic established
+    promoted = server.request_swap(new_path)
+    time.sleep(0.1)  # post-swap traffic
+    stop.set()
+    for t in threads:
+        t.join(5.0)
+
+    assert promoted.step == 200
+    assert not failures, f"hot swap dropped in-flight requests: {failures[:3]}"
+    assert outputs
+    for out in outputs:  # every answer came from a real version
+        assert np.allclose(out, old_expected, rtol=1e-5) or np.allclose(out, new_expected, rtol=1e-5)
+    assert any(np.allclose(out, new_expected, rtol=1e-5) for out in outputs[-4:])
+    snap = server.snapshot()
+    assert snap["serving_step"] == 200 and snap["swaps"] == 1
+    assert snap["events"].get("swap") == 1
+
+
+def test_swap_watcher_promotes_newer_commit(make_server):
+    server, ckpt_dir, state = make_server(swap_poll_s=0.02)
+    server.start()
+    assert server.snapshot()["serving_step"] == 100
+    commit_linear(ckpt_dir, 300, seed=3)
+    assert _wait_until(lambda: server.snapshot()["serving_step"] == 300)
+
+
+def test_poisoned_swap_rejected_then_clean_retry_promotes(make_server):
+    """Poison drill: the first swap attempt has its loaded weights
+    NaN-poisoned by fault injection — validation must refuse it and keep the
+    old version serving; the second (clean) attempt promotes."""
+    server, ckpt_dir, state = make_server(
+        fault_injection={"enabled": True, "faults": [{"kind": "poison_swap", "at_swap": 1}]},
+    )
+    server.start()
+    new_path, new_state = commit_linear(ckpt_dir, 200, seed=7)
+    with pytest.raises(SwapRejected, match="non-finite"):
+        server.request_swap(new_path)
+    snap = server.snapshot()
+    assert snap["serving_step"] == 100 and snap["swap_rejects"] == 1 and snap["swaps"] == 0
+    assert snap["events"].get("swap_rejected") == 1
+    obs = linear_obs(state)
+    np.testing.assert_allclose(server.infer(obs), expected_action(state, obs), rtol=1e-5)
+    # the fault fired once; the same checkpoint now passes validation
+    assert server.request_swap(new_path).step == 200
+    assert server.snapshot()["serving_step"] == 200
+
+
+def test_torn_checkpoint_refused(make_server, tmp_path):
+    server, ckpt_dir, _ = make_server()
+    server.start()
+    import pickle
+
+    torn = str(tmp_path / "checkpoint" / "ckpt_999_0.ckpt")
+    with open(torn, "wb") as f:
+        pickle.dump({"agent": {}}, f)  # payload without a commit manifest
+    with pytest.raises(SwapRejected, match="manifest"):
+        server.request_swap(torn)
+    assert server.snapshot()["serving_step"] == 100
+
+
+def test_structure_mismatch_rejected(make_server):
+    from sheeprl_tpu.serve.policy import make_linear_state
+
+    server, ckpt_dir, _ = make_server()
+    server.start()
+    bad_path, _ = commit_linear(ckpt_dir, 400, state=make_linear_state(in_dim=9))
+    with pytest.raises(SwapRejected, match="structure|shape"):
+        server.request_swap(bad_path)
+    snap = server.snapshot()
+    assert snap["serving_step"] == 100 and snap["swap_rejects"] == 1
+
+
+def test_rollback_restores_previous_version(make_server):
+    server, ckpt_dir, state = make_server()
+    server.start()
+    new_path, new_state = commit_linear(ckpt_dir, 200, seed=7)
+    server.request_swap(new_path)
+    assert server.snapshot()["serving_step"] == 200
+    restored = server.store.rollback()
+    assert restored.step == 100
+    snap = server.snapshot()
+    assert snap["serving_step"] == 100 and snap["rollbacks"] == 1
+    assert snap["events"].get("rollback") == 1
+    obs = linear_obs(state)
+    np.testing.assert_allclose(server.infer(obs), expected_action(state, obs), rtol=1e-5)
