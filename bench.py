@@ -938,8 +938,37 @@ if __name__ == "__main__":
         "(QPS, p50/p95 vs SLO, queue depth, shed counts, replica restarts/masks, "
         "swap promotions/rejections, load-generator report) and exit",
     )
+    parser.add_argument(
+        "--regress",
+        action="store_true",
+        help="regression gate: compare the newest run-registry record per "
+        "scenario cell against its tolerance-banded history, write the "
+        "verdict grid to SCENARIOS.json, exit nonzero on regression "
+        "(tools/regress.py)",
+    )
+    parser.add_argument("--runs", default="RUNS.jsonl", help="run-registry path for --regress")
+    parser.add_argument("--scenarios-out", default="SCENARIOS.json", help="verdict-grid path for --regress")
+    parser.add_argument(
+        "--bench-glob", default="BENCH_r*.json", help="driver bench records folded into --regress ('' disables)"
+    )
     args = parser.parse_args()
-    if args.serve_stats:
+    if args.regress:
+        # the gate is stdlib-only; load it by file path so this parent
+        # process stays jax-free (same reason main() shells out workloads)
+        import importlib.util
+
+        regress_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools", "regress.py")
+        spec = importlib.util.spec_from_file_location("_sheeprl_tpu_regress", regress_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        sys.exit(
+            mod.run_gate(
+                args.runs,
+                args.scenarios_out,
+                bench_pattern=args.bench_glob or None,
+            )
+        )
+    elif args.serve_stats:
         print(json.dumps(serve_stats(args.serve_stats), indent=1))
     elif args.resilience_stats:
         print(json.dumps(resilience_stats(args.resilience_stats), indent=1))

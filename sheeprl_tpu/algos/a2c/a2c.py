@@ -34,6 +34,7 @@ from sheeprl_tpu.obs import (
     telemetry_advance,
     telemetry_mark_warm,
     telemetry_register_flops,
+    telemetry_run_metrics,
 )
 from sheeprl_tpu.ops.math import gae
 from sheeprl_tpu.resilience import RunResilience
@@ -290,7 +291,9 @@ def main(fabric, cfg: Dict[str, Any]):
             aggregator.update("Loss/policy_loss", float(metrics[0]))
             aggregator.update("Loss/value_loss", float(metrics[1]))
             if policy_step - last_log >= cfg.metric.log_every or update == num_updates:
-                logger.log_metrics(aggregator.compute(), policy_step)
+                metrics_dict = aggregator.compute()
+                logger.log_metrics(metrics_dict, policy_step)
+                telemetry_run_metrics(metrics_dict)
                 aggregator.reset()
                 log_sps_and_heartbeat(
                     logger,

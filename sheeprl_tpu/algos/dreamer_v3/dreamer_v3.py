@@ -72,6 +72,7 @@ from sheeprl_tpu.obs import (
     log_sps_and_heartbeat,
     telemetry_advance,
     telemetry_register_flops,
+    telemetry_run_metrics,
     telemetry_train_window,
 )
 from sheeprl_tpu.ops.math import MomentsState, compute_lambda_values, init_moments, update_moments
@@ -1052,6 +1053,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 pending_metrics.clear()
             metrics_dict = aggregator.compute()
             logger.log_metrics(metrics_dict, policy_step)
+            telemetry_run_metrics(metrics_dict)
             aggregator.reset()
             if policy_step > 0:
                 logger.log_metrics(

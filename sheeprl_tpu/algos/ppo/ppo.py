@@ -39,7 +39,12 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.envs import build_vector_env
-from sheeprl_tpu.obs import log_sps_and_heartbeat, telemetry_advance, telemetry_register_flops
+from sheeprl_tpu.obs import (
+    log_sps_and_heartbeat,
+    telemetry_advance,
+    telemetry_register_flops,
+    telemetry_run_metrics,
+)
 from sheeprl_tpu.ops.math import gae
 from sheeprl_tpu.parallel.fabric import put_tree, resolve_player_device, resolve_train_device
 from sheeprl_tpu.resilience import RunResilience
@@ -455,6 +460,7 @@ def main(fabric, cfg: Dict[str, Any]):
             if policy_step - last_log >= cfg.metric.log_every or update == num_updates:
                 metrics_dict = aggregator.compute()
                 logger.log_metrics(metrics_dict, policy_step)
+                telemetry_run_metrics(metrics_dict)
                 aggregator.reset()
                 log_sps_and_heartbeat(
                     logger,

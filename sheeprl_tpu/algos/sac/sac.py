@@ -40,7 +40,12 @@ from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.data.device_buffer import draw_transition_batch
 from sheeprl_tpu.envs import build_vector_env
-from sheeprl_tpu.obs import log_sps_and_heartbeat, telemetry_advance, telemetry_train_window
+from sheeprl_tpu.obs import (
+    log_sps_and_heartbeat,
+    telemetry_advance,
+    telemetry_run_metrics,
+    telemetry_train_window,
+)
 from sheeprl_tpu.ops.superstep import fold_sample_key, fused_fallback, reset_fused_fallback_warnings
 from sheeprl_tpu.resilience import RunResilience
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -599,6 +604,7 @@ def main(fabric, cfg: Dict[str, Any]):
         if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or update == num_updates):
             metrics_dict = aggregator.compute()
             logger.log_metrics(metrics_dict, policy_step)
+            telemetry_run_metrics(metrics_dict)
             aggregator.reset()
             if policy_step > 0:
                 logger.log_metrics(

@@ -179,23 +179,41 @@ def run_algorithm(cfg: dotdict) -> None:
     from sheeprl_tpu.resilience import drain_async_checkpoints, emit_pending_resilience_events
 
     emit_pending_resilience_events()
+    outcome, error = "completed", None
     try:
         with maybe_profile(cfg, log_dir=run_base_dir(cfg)):
             entrypoint(fabric, cfg, **kwargs)
-    except Exception as err:
+    except SystemExit as err:
+        # the preemption drain exits with the distinct code 77 — everything
+        # else raising SystemExit mid-loop is a crash for the registry
+        from sheeprl_tpu.resilience import PREEMPTED_EXIT_CODE
+
+        outcome = "preempted" if err.code == PREEMPTED_EXIT_CODE else "crashed"
+        error = None if outcome == "preempted" else repr(err)
+        raise
+    except BaseException as err:
         # unhandled train-loop crash: if the entrypoint armed its crash
         # guard, drain in-flight saves and commit an emergency checkpoint so
         # resume_from=auto restarts from this boundary; the exception still
-        # propagates (SystemExit from a preemption drain bypasses this)
-        from sheeprl_tpu.resilience import crash_drain
+        # propagates. register_run reclassifies to rolled_back when the run
+        # died after NaN rollbacks.
+        outcome, error = "crashed", repr(err)
+        if isinstance(err, Exception):
+            from sheeprl_tpu.resilience import crash_drain
 
-        crash_drain(err)
+            crash_drain(err)
         raise
     finally:
         # a background checkpoint write may still be in flight (including the
         # save_last one) — join it before closing the telemetry sink so its
         # ckpt_committed event makes the run_end totals
         drain_async_checkpoints()
+        # run registry (obs/registry.py): the durable one-line record in
+        # RUNS.jsonl, appended BEFORE shutdown so the telemetry rollup
+        # (run_summary) is still alive to fold in
+        from sheeprl_tpu.obs.registry import register_run
+
+        register_run(cfg, kind="train", outcome=outcome, error=error)
         shutdown_telemetry()
 
 
@@ -247,7 +265,16 @@ def eval_algorithm(cfg: dotdict) -> None:
 
     fabric = Fabric(devices=1, precision=str(cfg.fabric.get("precision", "fp32")))
     state = load_checkpoint(cfg.checkpoint_path)
-    evaluate_fn(fabric, cfg, state)
+    from sheeprl_tpu.obs.registry import register_run
+
+    outcome, error = "completed", None
+    try:
+        evaluate_fn(fabric, cfg, state)
+    except BaseException as err:
+        outcome, error = "crashed", repr(err)
+        raise
+    finally:
+        register_run(cfg, kind="eval", outcome=outcome, error=error, checkpoint=cfg.get("checkpoint_path"))
 
 
 def evaluation(args: Optional[List[str]] = None) -> None:

@@ -147,19 +147,39 @@ def serving(args: Optional[List[str]] = None) -> None:
     except ValueError:
         pass  # not the main thread (tests drive serving() directly)
 
+    outcome, error = "completed", None
+    final_snap: Optional[Dict[str, Any]] = None
     try:
         if serve_cfg.load.enabled:
             report = run_load(server, serve_cfg.load)
             snap = server.snapshot()
             snap["load_report"] = report
             telemetry_serve_stats(snap)
+            final_snap = snap
             print(json.dumps({"serve_stats": snap}, indent=2, default=str))
         else:
             while not stop.wait(serve_cfg.stats_interval_s):
                 telemetry_serve_stats(server.snapshot())
-            telemetry_serve_stats(server.snapshot())
+            final_snap = server.snapshot()
+            telemetry_serve_stats(final_snap)
+    except BaseException as err:
+        outcome, error = "crashed", repr(err)
+        raise
     finally:
         server.close()
+        # serve sessions register in RUNS.jsonl too: the record's `serve`
+        # section (run_summary folds in the last serve_stats snapshot)
+        # feeds the regression gates' serve_qps / serve_p95_ms cells
+        from sheeprl_tpu.obs.registry import register_run
+
+        register_run(
+            cfg,
+            kind="serve",
+            outcome=outcome,
+            error=error,
+            checkpoint=ckpt_path,
+            serve_stats=final_snap,
+        )
         shutdown_telemetry()
 
 

@@ -22,6 +22,8 @@ report the same numbers. Everything is inert unless
 """
 
 from sheeprl_tpu.obs.heartbeat import log_sps_and_heartbeat
+from sheeprl_tpu.obs.profile import TriggeredProfiler
+from sheeprl_tpu.obs.registry import append_run_record, build_run_record, read_run_records, register_run
 from sheeprl_tpu.obs.span import TimerError, span
 from sheeprl_tpu.obs.telemetry import (
     RunTelemetry,
@@ -32,6 +34,8 @@ from sheeprl_tpu.obs.telemetry import (
     telemetry_ckpt_commit,
     telemetry_ckpt_skipped,
     telemetry_crash_checkpoint,
+    telemetry_deliberate_compiles,
+    telemetry_dump_flight_record,
     telemetry_env_step,
     telemetry_fused_fallback,
     telemetry_mark_warm,
@@ -40,6 +44,7 @@ from sheeprl_tpu.obs.telemetry import (
     telemetry_preemption,
     telemetry_register_flops,
     telemetry_resume_fallback,
+    telemetry_run_metrics,
     telemetry_serve_event,
     telemetry_serve_stats,
     telemetry_train_window,
@@ -49,15 +54,22 @@ from sheeprl_tpu.obs.telemetry import (
 __all__ = [
     "RunTelemetry",
     "TimerError",
+    "TriggeredProfiler",
+    "append_run_record",
+    "build_run_record",
     "configure_telemetry",
     "get_telemetry",
     "log_sps_and_heartbeat",
+    "read_run_records",
+    "register_run",
     "shutdown_telemetry",
     "span",
     "telemetry_advance",
     "telemetry_ckpt_commit",
     "telemetry_ckpt_skipped",
     "telemetry_crash_checkpoint",
+    "telemetry_deliberate_compiles",
+    "telemetry_dump_flight_record",
     "telemetry_env_step",
     "telemetry_fused_fallback",
     "telemetry_mark_warm",
@@ -66,6 +78,7 @@ __all__ = [
     "telemetry_preemption",
     "telemetry_register_flops",
     "telemetry_resume_fallback",
+    "telemetry_run_metrics",
     "telemetry_serve_event",
     "telemetry_serve_stats",
     "telemetry_train_window",

@@ -1,0 +1,152 @@
+"""Triggered XLA profiler capture (the evidence engine's trace arm).
+
+``metric.profiler.enabled`` (``utils/profiler.maybe_profile``) traces a
+*whole* run — fine for a 30-second bench, useless for answering "why was
+window 4812 slow" on a day-long job. :class:`TriggeredProfiler` wraps
+``jax.profiler.start_trace/stop_trace`` around *individual train windows*
+(one window = one ``telemetry_advance`` interval, i.e. one loop update) with
+two triggers:
+
+- **explicit** — ``metric.telemetry.profile_windows=[k..m]`` captures the
+  listed 1-based windows; consecutive indices share one trace so a ``[2,3]``
+  request produces a single Perfetto file spanning both.
+- **slow-window watchdog** — with ``metric.telemetry.slow_window_factor=k``
+  (>0) the profiler watches ``Time/train_time`` span durations and, once a
+  window exceeds ``k×`` the trailing median (after
+  ``slow_window_min_history`` healthy windows), schedules ONE capture of the
+  next window. One capture per run: the point is a post-hoc artifact for the
+  first anomaly, not a trace-everything regression.
+
+Traces land under ``profile_triggered/window_<k>`` next to ``telemetry.jsonl``
+and every capture is registered in the run record
+(``obs/registry.py`` → ``RUNS.jsonl`` ``profile_captures``), so the MFU
+question gets answered with a trace, not a guess.
+
+``start_trace``/``stop_trace`` are injectable for tests; the defaults import
+jax lazily. A failed ``start_trace`` (e.g. ``maybe_profile`` already owns the
+process-wide profiler session) is swallowed — capture is best-effort evidence,
+never a reason to kill the run.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+_TRAIN_SPAN = "Time/train_time"
+_HISTORY_WINDOW = 64
+
+
+def _default_start(path: str) -> None:
+    import jax
+
+    jax.profiler.start_trace(path)
+
+
+def _default_stop() -> None:
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+class TriggeredProfiler:
+    """Per-train-window trace capture with explicit and slow-window triggers.
+
+    Driven by :class:`~sheeprl_tpu.obs.telemetry.RunTelemetry`:
+    :meth:`on_window` at every ``advance`` (window boundary),
+    :meth:`observe_span` for every ``Time/train_time`` span close,
+    :meth:`finish` at shutdown (stops a straddling capture and returns the
+    capture manifest for ``run_end``/the run record).
+    """
+
+    def __init__(
+        self,
+        trace_root: str,
+        *,
+        windows: Optional[Sequence[int]] = None,
+        slow_factor: float = 0.0,
+        slow_min_history: int = 8,
+        start_trace: Optional[Callable[[str], None]] = None,
+        stop_trace: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self.trace_root = trace_root
+        self.windows = {int(w) for w in (windows or [])}
+        self.slow_factor = float(slow_factor or 0.0)
+        self.slow_min_history = max(1, int(slow_min_history))
+        self.captures: List[Dict[str, Any]] = []
+        self._start_trace = start_trace or _default_start
+        self._stop_trace = stop_trace or _default_stop
+        self._active: Optional[Dict[str, Any]] = None
+        self._history: deque = deque(maxlen=_HISTORY_WINDOW)
+        self._slow_fired = False
+        self._slow_pending: Optional[int] = None
+        self._window = 0
+
+    # -- window boundary (telemetry.advance) --------------------------------
+
+    def on_window(self, index: int) -> None:
+        """Window ``index`` (1-based) starts now. Stop a capture whose
+        windows are over, start/extend one the triggers ask for."""
+        self._window = int(index)
+        want = index in self.windows or index == self._slow_pending
+        if self._active is not None:
+            if want:
+                self._active["windows"].append(index)
+                return
+            self._stop()
+        if want:
+            self._start(index)
+
+    # -- slow-window watchdog (telemetry.emit_span) -------------------------
+
+    def observe_span(self, name: str, dur: float) -> None:
+        if name != _TRAIN_SPAN:
+            return
+        if (
+            self.slow_factor > 0.0
+            and not self._slow_fired
+            and len(self._history) >= self.slow_min_history
+        ):
+            median = statistics.median(self._history)
+            if median > 0.0 and dur > self.slow_factor * median:
+                # capture the NEXT window: this one already ran untraced
+                self._slow_fired = True
+                self._slow_pending = self._window + 1
+        self._history.append(float(dur))
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def finish(self) -> List[Dict[str, Any]]:
+        if self._active is not None:
+            self._stop()
+        return list(self.captures)
+
+    # -- internals ----------------------------------------------------------
+
+    def _start(self, index: int) -> None:
+        trigger = "slow_window" if index == self._slow_pending else "explicit"
+        path = os.path.join(self.trace_root, f"window_{index:05d}")
+        try:
+            os.makedirs(path, exist_ok=True)
+            self._start_trace(path)
+        except Exception:
+            return  # profiler busy (whole-run maybe_profile) or unavailable
+        self._active = {
+            "trigger": trigger,
+            "windows": [index],
+            "trace_dir": path,
+            "t_start": time.time(),
+        }
+
+    def _stop(self) -> None:
+        try:
+            self._stop_trace()
+        except Exception:
+            pass
+        assert self._active is not None
+        self._active["t_end"] = time.time()
+        self.captures.append(self._active)
+        self._active = None

@@ -22,8 +22,10 @@ silent retracing is the #1 TPU perf killer.
 from __future__ import annotations
 
 import logging
+import threading
 import warnings
-from typing import Any, Optional
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
 
 _LOWER_EVENT = "/jax/core/compile/jaxpr_to_mlir_module_duration"
 _BACKEND_EVENT = "/jax/core/compile/backend_compile_duration"
@@ -70,7 +72,15 @@ class CompileWatchdog:
         self.recompiles = 0
         self.cache_hits = 0
         self.cache_misses = 0
+        # deliberate (allowlisted) post-warmup compiles by reason — AOT cost
+        # analysis, the serve batch ladder, hot-swap revalidation
+        self.deliberate_compiles: Dict[str, int] = {}
         self.warm = False
+        # compiles fire on the compiling thread (serve AOT on the server's
+        # caller, revalidation on watcher threads), so the allowlist flag
+        # must be thread-local: one thread's deliberate window must not
+        # silence a real retrace racing on another thread
+        self._deliberate = threading.local()
         self._started = False
         self._handler = _NameCaptureHandler()
         self._logger = logging.getLogger(_PXLA_LOGGER)
@@ -121,6 +131,20 @@ class CompileWatchdog:
     def mark_warm(self) -> None:
         self.warm = True
 
+    @contextmanager
+    def deliberate(self, reason: str):
+        """Allowlist window: compiles on THIS thread while the context is
+        open are deliberate (counted per ``reason``, tagged in the event
+        stream) and never raise :class:`RecompileWarning`, even after
+        :meth:`mark_warm` — the carve-out for AOT cost analysis, the serve
+        tier's batch-ladder warmup and hot-swap revalidation."""
+        prev = getattr(self._deliberate, "reason", None)
+        self._deliberate.reason = str(reason)
+        try:
+            yield
+        finally:
+            self._deliberate.reason = prev
+
     def _on_plain_event(self, event: str, **kwargs: Any) -> None:
         """Persistent-compilation-cache outcome: one ``compile_cache`` event
         per backend-compile request, so a resumed run can show its retraces
@@ -146,10 +170,13 @@ class CompileWatchdog:
         else:
             return
         name = self._handler.last_name or "<unknown>"
-        post_warm = self.warm
+        reason = getattr(self._deliberate, "reason", None)
+        post_warm = self.warm and reason is None
         if phase == "lower":
             self.compiles += 1
-            if post_warm:
+            if reason is not None:
+                self.deliberate_compiles[reason] = self.deliberate_compiles.get(reason, 0) + 1
+            elif post_warm:
                 self.recompiles += 1
                 warnings.warn(
                     f"recompile after warmup: {name} was re-traced/re-lowered "
@@ -157,7 +184,8 @@ class CompileWatchdog:
                     RecompileWarning,
                     stacklevel=2,
                 )
+        extra = {"deliberate": reason} if reason is not None else {}
         try:
-            self._emit("compile", name=name, phase=phase, dur=duration, post_warm=post_warm)
+            self._emit("compile", name=name, phase=phase, dur=duration, post_warm=post_warm, **extra)
         except Exception:
             pass

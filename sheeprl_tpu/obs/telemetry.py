@@ -16,6 +16,22 @@ kinds are ``run_start``, ``span``, ``compile``, ``device_poll``,
 The module-level accessor :func:`get_telemetry` returns ``None`` unless a run
 configured telemetry — callers on hot paths pay one global read when the
 subsystem is off.
+
+Evidence-engine extensions (howto/evidence.md):
+
+- **flight recorder** — a bounded ring of the last
+  ``metric.telemetry.flightrec_events`` events, dumped to ``flightrec.json``
+  by the crash-guard / NaN-rollback / preemption paths so every abnormal
+  exit leaves a post-mortem artifact (newest event last).
+- **rotation** — ``metric.telemetry.max_bytes`` caps the JSONL stream: on
+  overflow the file rotates once to ``telemetry.jsonl.1`` (overwriting the
+  previous rotation), bounding disk at ~2× the cap for soak/serve runs.
+- **triggered profiler** — ``metric.telemetry.profile_windows`` /
+  ``slow_window_factor`` drive :class:`~sheeprl_tpu.obs.profile.TriggeredProfiler`
+  through :meth:`RunTelemetry.advance` and the span stream.
+- **run rollup** — :meth:`RunTelemetry.run_summary` condenses the run into
+  the registry record appended to ``RUNS.jsonl``
+  (:mod:`sheeprl_tpu.obs.registry`).
 """
 
 from __future__ import annotations
@@ -24,8 +40,11 @@ import json
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, Mapping, Optional
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Mapping, Optional
 
+from sheeprl_tpu.obs.profile import TriggeredProfiler
 from sheeprl_tpu.obs.recompile import CompileWatchdog
 
 _FLUSH_EVERY_EVENTS = 64
@@ -33,6 +52,7 @@ _FLUSH_EVERY_SECONDS = 5.0
 # bound on per-heartbeat-window env-step latency samples: at sane log
 # intervals the window never fills; a runaway loop degrades to "first N"
 _ENV_STEP_RESERVOIR = 8192
+_FLIGHTREC_EVENTS = 256
 
 _active_telemetry: Optional["RunTelemetry"] = None
 
@@ -43,12 +63,23 @@ class TelemetryWriter:
     jax.monitoring listeners and the poller can fire from any thread; the
     lock keeps lines whole.  Events are buffered and flushed every
     ``_FLUSH_EVERY_EVENTS`` events or ``_FLUSH_EVERY_SECONDS`` seconds so the
-    hot path never waits on the filesystem."""
+    hot path never waits on the filesystem.
 
-    def __init__(self, path: str) -> None:
+    ``max_bytes > 0`` enables size-capped rotation: when the current segment
+    exceeds the cap it is renamed to ``<path>.1`` (overwriting any previous
+    rotation) and a fresh segment starts, so a soak run's stream occupies at
+    most ~2× the cap on disk."""
+
+    def __init__(self, path: str, *, max_bytes: int = 0) -> None:
         self.path = path
+        self.max_bytes = int(max_bytes or 0)
+        self.rotations = 0
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._fh = open(path, "a", buffering=1)
+        try:
+            self._bytes = os.path.getsize(path)
+        except OSError:
+            self._bytes = 0
         self._lock = threading.Lock()
         self._buf: list = []
         self._last_flush = time.time()
@@ -66,10 +97,28 @@ class TelemetryWriter:
 
     def _flush_locked(self) -> None:
         if self._buf:
-            self._fh.write("\n".join(self._buf) + "\n")
+            data = "\n".join(self._buf) + "\n"
+            self._fh.write(data)
             self._buf.clear()
+            self._bytes += len(data)
         self._fh.flush()
         self._last_flush = time.time()
+        if self.max_bytes > 0 and self._bytes >= self.max_bytes:
+            self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        self._fh.close()
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass  # someone removed the segment under us: just start fresh
+        self._fh = open(self.path, "a", buffering=1)
+        self._bytes = 0
+        self.rotations += 1
+
+    def segments(self) -> List[str]:
+        """Existing stream segments, oldest first (``.1`` before current)."""
+        return [p for p in (self.path + ".1", self.path) if os.path.exists(p)]
 
     def close(self) -> None:
         self.flush()
@@ -90,6 +139,9 @@ class RunTelemetry:
         *,
         poll_interval: float = 30.0,
         poll_rtt: bool = False,
+        max_bytes: int = 0,
+        flightrec_events: int = _FLIGHTREC_EVENTS,
+        profiler: Optional[TriggeredProfiler] = None,
     ) -> None:
         import jax
 
@@ -98,8 +150,19 @@ class RunTelemetry:
         self.step = 0
         self.poll_interval = float(poll_interval)
         self.poll_rtt = bool(poll_rtt)
-        self.writer = TelemetryWriter(jsonl_path)
+        self.writer = TelemetryWriter(jsonl_path, max_bytes=max_bytes)
         self.watchdog = CompileWatchdog(self.emit)
+        # flight recorder: bounded ring of the newest events, dumped to
+        # flightrec.json on the abnormal-exit paths (newest event last)
+        self._flightrec: Optional[deque] = (
+            deque(maxlen=int(flightrec_events)) if int(flightrec_events or 0) > 0 else None
+        )
+        stem = "flightrec.json" if self.process_index == 0 else f"flightrec.{self.process_index}.json"
+        self.flightrec_path = os.path.join(os.path.dirname(jsonl_path) or ".", stem)
+        # triggered profiler (obs/profile.py): driven by advance()/emit_span
+        self.profiler = profiler
+        self.profile_captures: List[Dict[str, Any]] = []
+        self._window_index = 0
         self._last_poll: Optional[float] = None
         self._hbm_peak_bytes = 0
         self._device_polls = 0
@@ -137,6 +200,16 @@ class RunTelemetry:
         # serve_stats snapshot; supervision/swap events are counted by kind
         self._serve_last_stats: Optional[Dict[str, Any]] = None
         self._serve_events: Dict[str, int] = {}
+        # run-registry rollup: cumulative heartbeat-window sums (run-average
+        # SPS/duty cycle survive the per-window resets above) + the latest
+        # aggregator scalars (final losses/returns for the run record)
+        self._cum_env_steps = 0.0
+        self._cum_env_time = 0.0
+        self._cum_train_steps = 0.0
+        self._cum_train_time = 0.0
+        self._last_mfu: Optional[float] = None
+        self._last_train_flops_per_sec: Optional[float] = None
+        self._final_metrics: Dict[str, float] = {}
 
     # -- core event plumbing -------------------------------------------------
 
@@ -151,12 +224,17 @@ class RunTelemetry:
             record["name"] = name
         record.update(fields)
         self.writer.write(record)
+        ring = self._flightrec
+        if ring is not None:
+            ring.append(record)
 
     def emit_span(self, name: str, t_start: Optional[float], dur: float, attrs: Mapping[str, Any]) -> None:
         fields: Dict[str, Any] = {"t_start": t_start, "dur": dur}
         if attrs:
             fields["attrs"] = dict(attrs)
         self.emit("span", name=name, **fields)
+        if self.profiler is not None:
+            self.profiler.observe_span(name, dur)
 
     def trace_annotation(self, name: Optional[str]):
         if name is None:
@@ -167,6 +245,11 @@ class RunTelemetry:
 
     def advance(self, step: int) -> None:
         self.step = int(step)
+        # every advance() is one loop update = one train window (1-based);
+        # the triggered profiler keys its captures off this counter
+        self._window_index += 1
+        if self.profiler is not None:
+            self.profiler.on_window(self._window_index)
         self.maybe_poll_devices()
 
     def mark_warm(self) -> None:
@@ -244,25 +327,69 @@ class RunTelemetry:
 
     def record_nan_rollback(self, path: Optional[str], reason: str, remaining: int, **fields: Any) -> None:
         """The non-finite sentinel tripped and the run restored from the last
-        committed checkpoint: one ``nan_rollback`` event + run_end counter."""
+        committed checkpoint: one ``nan_rollback`` event + run_end counter +
+        a flight-record dump (the trigger event is the newest in the ring)."""
         self._total_nan_rollbacks += 1
         self.emit("nan_rollback", path=path, reason=reason, remaining=int(remaining), **fields)
         self.writer.flush()
+        self.dump_flight_record("nan_rollback")
 
     def record_preemption(self, signum: int, **fields: Any) -> None:
         """A preemption signal (SIGTERM/SIGINT) reached the train-loop
-        boundary: one ``preempt`` event + run_end counter."""
+        boundary: one ``preempt`` event + run_end counter + a flight-record
+        dump before the drain exits the process."""
         self._total_preemptions += 1
         self.emit("preempt", signum=int(signum), **fields)
         self.writer.flush()
+        self.dump_flight_record("preempt")
 
     def record_crash_checkpoint(self, path: str, error: str, **fields: Any) -> None:
         """An unhandled train-loop exception drained the async writer and
         committed an emergency checkpoint before re-raising: one
-        ``crash_checkpoint`` event + run_end counter."""
+        ``crash_checkpoint`` event + run_end counter + a flight-record dump."""
         self._total_crash_checkpoints += 1
         self.emit("crash_checkpoint", path=path, error=error, **fields)
         self.writer.flush()
+        self.dump_flight_record("crash")
+
+    def record_run_metrics(self, metrics: Mapping[str, Any]) -> None:
+        """Keep the newest numeric aggregator scalars (losses, returns,
+        episode lengths): the LAST values at run end become the registry
+        record's ``final_metrics``. No event is emitted — the logger already
+        carries the per-interval scalars."""
+        for key, value in dict(metrics).items():
+            try:
+                num = float(value)
+            except (TypeError, ValueError):
+                continue
+            if num == num:  # drop NaN — a poisoned final metric is useless
+                self._final_metrics[str(key)] = num
+
+    def dump_flight_record(self, trigger: str) -> Optional[str]:
+        """Write the ring to ``flightrec.json`` (atomic tmp+rename; events
+        oldest→newest, so the abnormal-exit trigger event is LAST). Each dump
+        overwrites the previous — the newest post-mortem wins. Returns the
+        path, or ``None`` when the ring is disabled or the write failed."""
+        ring, path = self._flightrec, self.flightrec_path
+        if ring is None or path is None:
+            return None
+        payload = {
+            "schema": 1,
+            "trigger": trigger,
+            "t": time.time(),
+            "step": self.step,
+            "process_index": self.process_index,
+            "ring_capacity": ring.maxlen,
+            "events": list(ring),
+        }
+        try:
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, default=str)
+            os.replace(tmp, path)
+        except Exception:
+            return None
+        return path
 
     def record_serve_stats(self, snapshot: Mapping[str, Any]) -> None:
         """One periodic serving-tier stats snapshot (QPS, queue depth, shed
@@ -292,15 +419,12 @@ class RunTelemetry:
     def _resolve_flops(self) -> Optional[float]:
         if not self._flops_resolved and self._flops_source is not None:
             # the AOT cost-analysis compile is deliberate, not a retrace —
-            # keep the watchdog from flagging it as a post-warm recompile
-            saved_warm = self.watchdog.warm
-            self.watchdog.warm = False
-            try:
-                self._flops_per_train_step = self._flops_source()
-            except Exception:
-                self._flops_per_train_step = None
-            finally:
-                self.watchdog.warm = saved_warm
+            # run it inside the watchdog's allowlist window
+            with self.watchdog.deliberate("aot_cost_analysis"):
+                try:
+                    self._flops_per_train_step = self._flops_source()
+                except Exception:
+                    self._flops_per_train_step = None
             self._flops_source = None
             self._flops_resolved = True
         return self._flops_per_train_step
@@ -367,6 +491,12 @@ class RunTelemetry:
         peak, recompile count — one JSONL event + ``Telemetry/*`` scalars."""
         env_t = float(timer_window.get("Time/env_interaction_time") or 0.0)
         train_t = float(timer_window.get("Time/train_time") or 0.0)
+        # run-registry rollup: the window sums reset every heartbeat, these
+        # cumulative mirrors survive to run_summary()
+        self._cum_env_steps += float(env_steps or 0.0)
+        self._cum_env_time += env_t
+        self._cum_train_steps += float(train_steps or 0.0)
+        self._cum_train_time += train_t
         fields: Dict[str, Any] = {
             "window_env_steps": env_steps,
             "window_train_steps": train_steps,
@@ -448,12 +578,14 @@ class RunTelemetry:
                     fps = flops * train_invocations / train_t
                     fields["train_flops_per_sec"] = fps
                     scalars["Telemetry/train_flops_per_sec"] = fps
+                    self._last_train_flops_per_sec = fps
                     from sheeprl_tpu.utils.profiler import PEAK_BF16_FLOPS
 
                     peak = PEAK_BF16_FLOPS.get(fields["device_kind"])
                     if peak:
                         fields["mfu"] = fps / peak
                         scalars["Telemetry/mfu"] = fields["mfu"]
+                        self._last_mfu = fields["mfu"]
         self.emit("heartbeat", **fields)
         self.writer.flush()
         if logger is not None:
@@ -461,6 +593,62 @@ class RunTelemetry:
                 logger.log_metrics(scalars, step)
             except Exception:
                 pass
+
+    # -- run-registry rollup -------------------------------------------------
+
+    def run_summary(self) -> Dict[str, Any]:
+        """Condense the run for the registry record (``RUNS.jsonl``): run-wide
+        SPS/duty cycle from the cumulative heartbeat sums, the latest MFU,
+        HBM peak, compile/recompile/dispatch/fallback and resilience totals,
+        rollout restart/mask totals, the last serve snapshot, profile
+        captures and the telemetry segments on disk."""
+        summary: Dict[str, Any] = {
+            "backend": self._jax.default_backend(),
+            "device_kind": self.device_kind(),
+            "local_device_count": self._jax.local_device_count(),
+            "process_count": self._jax.process_count(),
+            "hbm_peak_bytes": self._hbm_peak_bytes,
+            "compiles_total": self.watchdog.compiles,
+            "recompiles": self.watchdog.recompiles,
+            "deliberate_compiles": dict(self.watchdog.deliberate_compiles),
+            "train_windows": self._total_train_windows,
+            "train_dispatches": self._total_train_dispatches,
+            "train_gradient_steps": self._total_train_gradient_steps,
+            "fused_fallbacks": dict(self._fused_fallbacks),
+            "worker_restarts": self._total_worker_restarts,
+            "masked_slots": self._total_masked_slots,
+            "ckpt_commits": self._total_ckpt_commits,
+            "ckpt_skipped": self._total_ckpt_skipped,
+            "nan_rollbacks": self._total_nan_rollbacks,
+            "preemptions": self._total_preemptions,
+            "crash_checkpoints": self._total_crash_checkpoints,
+            "resume_fallbacks": self._total_resume_fallbacks,
+        }
+        if self._cum_env_time > 0:
+            summary["sps_env"] = self._cum_env_steps / self._cum_env_time
+        if self._cum_train_time > 0:
+            summary["sps_train"] = self._cum_train_steps / self._cum_train_time
+        if self._cum_env_time + self._cum_train_time > 0:
+            summary["duty_cycle_train"] = self._cum_train_time / (self._cum_env_time + self._cum_train_time)
+        if self._flops_per_train_step is not None:
+            summary["flops_per_train_step"] = self._flops_per_train_step
+        if self._last_train_flops_per_sec is not None:
+            summary["train_flops_per_sec"] = self._last_train_flops_per_sec
+        if self._last_mfu is not None:
+            summary["mfu"] = self._last_mfu
+        if self._serve_last_stats is not None or self._serve_events:
+            summary["serve"] = {
+                "stats": self._serve_last_stats or {},
+                "events": dict(self._serve_events),
+            }
+        captures = self.profile_captures or (self.profiler.captures if self.profiler is not None else [])
+        if captures:
+            summary["profile_captures"] = [dict(c) for c in captures]
+        if self._final_metrics:
+            summary["final_metrics"] = dict(self._final_metrics)
+        summary["telemetry_jsonl"] = self.writer.path
+        summary["telemetry_segments"] = [os.path.basename(p) for p in self.writer.segments()]
+        return summary
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -470,6 +658,10 @@ class RunTelemetry:
         self.maybe_poll_devices(force=True)
 
     def close(self) -> None:
+        if self.profiler is not None:
+            # stop a capture straddling run end so the trace file is complete
+            # BEFORE run_end reports it
+            self.profile_captures = self.profiler.finish()
         serve_fields: Dict[str, Any] = {}
         # only serving runs grow a `serve` section: training-run run_end
         # consumers keep seeing exactly the fields they already parse
@@ -499,6 +691,10 @@ class RunTelemetry:
             preemptions=self._total_preemptions,
             crash_checkpoints=self._total_crash_checkpoints,
             resume_fallbacks=self._total_resume_fallbacks,
+            deliberate_compiles=dict(self.watchdog.deliberate_compiles),
+            profile_captures=[dict(c) for c in self.profile_captures],
+            telemetry_rotations=self.writer.rotations,
+            telemetry_segments=[os.path.basename(p) for p in self.writer.segments()],
         )
         self.watchdog.stop()
         self.writer.close()
@@ -513,8 +709,10 @@ def get_telemetry() -> Optional[RunTelemetry]:
 
 def configure_telemetry(cfg: Mapping[str, Any], log_dir: Optional[str] = None) -> Optional[RunTelemetry]:
     """Build the process-wide :class:`RunTelemetry` from
-    ``cfg.metric.telemetry`` (``{enabled, jsonl, poll_interval, poll_rtt}``).
-    Returns ``None`` (and leaves the subsystem inert) unless enabled."""
+    ``cfg.metric.telemetry`` (``{enabled, jsonl, poll_interval, poll_rtt,
+    max_bytes, flightrec_events, profile_windows, slow_window_factor,
+    slow_window_min_history}``).  Returns ``None`` (and leaves the subsystem
+    inert) unless enabled."""
     global _active_telemetry
     tel_cfg = ((cfg.get("metric") or {}).get("telemetry")) or {}
     if not bool(tel_cfg.get("enabled", False)):
@@ -528,10 +726,25 @@ def configure_telemetry(cfg: Mapping[str, Any], log_dir: Optional[str] = None) -
     if proc != 0:
         root, ext = os.path.splitext(path)
         path = f"{root}.{proc}{ext or '.jsonl'}"
+    profiler: Optional[TriggeredProfiler] = None
+    windows = tel_cfg.get("profile_windows") or []
+    slow_factor = float(tel_cfg.get("slow_window_factor", 0.0) or 0.0)
+    if proc == 0 and (windows or slow_factor > 0.0):
+        # process-0 only, like the whole-run profiler: one Perfetto writer
+        # per host is plenty and the traces already carry every local device
+        profiler = TriggeredProfiler(
+            os.path.join(os.path.dirname(path) or ".", "profile_triggered"),
+            windows=[int(w) for w in windows],
+            slow_factor=slow_factor,
+            slow_min_history=int(tel_cfg.get("slow_window_min_history", 8) or 8),
+        )
     tel = RunTelemetry(
         path,
         poll_interval=float(tel_cfg.get("poll_interval", 30.0) or 0.0),
         poll_rtt=bool(tel_cfg.get("poll_rtt", False)),
+        max_bytes=int(tel_cfg.get("max_bytes", 0) or 0),
+        flightrec_events=int(tel_cfg.get("flightrec_events", _FLIGHTREC_EVENTS) or 0),
+        profiler=profiler,
     )
     tel.start(
         run_info={
@@ -565,6 +778,38 @@ def telemetry_mark_warm() -> None:
     tel = _active_telemetry
     if tel is not None:
         tel.mark_warm()
+
+
+@contextmanager
+def telemetry_deliberate_compiles(reason: str):
+    """Allowlist window for deliberate compiles (serve batch-ladder AOT,
+    hot-swap revalidation, AOT cost analysis): inside the context, compiles
+    on this thread never count as post-warmup recompiles (see
+    :meth:`CompileWatchdog.deliberate`). Yields even when telemetry is off."""
+    tel = _active_telemetry
+    if tel is None:
+        yield
+    else:
+        with tel.watchdog.deliberate(reason):
+            yield
+
+
+def telemetry_run_metrics(metrics: Mapping[str, Any]) -> None:
+    """Capture the latest aggregator scalars for the run-registry record
+    (see :meth:`RunTelemetry.record_run_metrics`); no-op when telemetry is
+    off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_run_metrics(metrics)
+
+
+def telemetry_dump_flight_record(trigger: str) -> Optional[str]:
+    """Dump the flight-recorder ring now (see
+    :meth:`RunTelemetry.dump_flight_record`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        return tel.dump_flight_record(trigger)
+    return None
 
 
 def telemetry_train_window(dispatches: int, gradient_steps: int) -> None:

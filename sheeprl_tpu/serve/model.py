@@ -214,15 +214,21 @@ class ModelStore:
         if self._faults is not None and self._faults.poison_swap(attempt):
             params = _poison(params)
 
-        if not host_all_finite(jax.device_get(params)):
-            return self._reject(candidate, "non-finite weights (poisoned checkpoint)")
+        from sheeprl_tpu.obs import telemetry_deliberate_compiles
 
-        try:
-            smoke = self.ladder.run(params, [_zero_obs(self.policy.obs_spec)])
-            if not host_all_finite(smoke):
-                return self._reject(candidate, "smoke inference produced non-finite outputs")
-        except Exception as err:
-            return self._reject(candidate, f"smoke inference failed: {err!r}")
+        # revalidation runs off the request path (watcher/replica threads)
+        # and may trace fresh helpers (finite reduction, device_get trees) —
+        # deliberate work, not a serving-path retrace
+        with telemetry_deliberate_compiles("serve_swap_revalidation"):
+            if not host_all_finite(jax.device_get(params)):
+                return self._reject(candidate, "non-finite weights (poisoned checkpoint)")
+
+            try:
+                smoke = self.ladder.run(params, [_zero_obs(self.policy.obs_spec)])
+                if not host_all_finite(smoke):
+                    return self._reject(candidate, "smoke inference produced non-finite outputs")
+            except Exception as err:
+                return self._reject(candidate, f"smoke inference failed: {err!r}")
 
         with self._lock:
             self._previous = self._current

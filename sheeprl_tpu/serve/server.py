@@ -161,7 +161,13 @@ class PolicyServer:
         returns every rung is compiled and all replicas are pulling."""
         if self._started:
             return self
-        self.ladder = CompiledLadder(self.policy, self.config.batch_ladder)
+        from sheeprl_tpu.obs import telemetry_deliberate_compiles
+
+        # the batch-ladder AOT warmup IS compilation — allowlist it so a
+        # serve session that configured telemetry (and is already warm from
+        # a shared-process drill) doesn't spray RecompileWarnings
+        with telemetry_deliberate_compiles("serve_batch_ladder"):
+            self.ladder = CompiledLadder(self.policy, self.config.batch_ladder)
         self.warmup_s = dict(self.ladder.compile_s)
         self.store = ModelStore(
             self.policy,

@@ -25,6 +25,17 @@ if not os.environ.get("SHEEPRL_TPU_NO_COMPILE_CACHE"):
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
+# Redirect the run registry's default away from the repo's real RUNS.jsonl:
+# every CLI run a test launches (in-process or as a subprocess — both inherit
+# this env var) would otherwise append evidence records to the checked-in
+# registry. Set at import time so _no_env_leaks (which snapshots per test)
+# sees a constant value. Tests that assert on registry contents override via
+# metric.telemetry.runs_jsonl, which takes precedence over the env var.
+os.environ.setdefault(
+    "SHEEPRL_TPU_RUNS_JSONL",
+    os.path.join(tempfile.mkdtemp(prefix="sheeprl_tpu_test_runs_"), "RUNS.jsonl"),
+)
+
 import jax  # noqa: E402
 
 # The env var alone is not enough on machines where a TPU platform plugin

@@ -1,0 +1,123 @@
+"""Flight recorder (bounded event ring -> flightrec.json) and size-capped
+telemetry.jsonl rotation."""
+
+import json
+import os
+
+import pytest
+
+from sheeprl_tpu.obs.telemetry import (
+    TelemetryWriter,
+    configure_telemetry,
+    shutdown_telemetry,
+    telemetry_dump_flight_record,
+)
+
+
+@pytest.fixture()
+def telemetry(tmp_path):
+    """Active telemetry with a tiny 8-event ring; always shut down."""
+    cfg = {
+        "metric": {
+            "telemetry": {
+                "enabled": True,
+                "poll_interval": 0.0,
+                "flightrec_events": 8,
+            }
+        }
+    }
+    tel = configure_telemetry(cfg, log_dir=str(tmp_path))
+    try:
+        yield tel
+    finally:
+        shutdown_telemetry()
+
+
+def test_ring_is_bounded_newest_last(telemetry, tmp_path):
+    for n in range(30):
+        telemetry.emit("unit", n=n)
+    path = telemetry_dump_flight_record("manual")
+    assert path == str(tmp_path / "flightrec.json")
+    with open(path) as f:  # must be one valid JSON document
+        dump = json.load(f)
+    assert dump["schema"] == 1
+    assert dump["trigger"] == "manual"
+    assert dump["ring_capacity"] == 8
+    # only the NEWEST 8 events survive, in order, newest last
+    assert [e["n"] for e in dump["events"]] == list(range(22, 30))
+
+
+def test_abnormal_exit_paths_dump_with_trigger_event_last(telemetry, tmp_path):
+    for n in range(5):
+        telemetry.emit("unit", n=n)
+    telemetry.record_nan_rollback(None, reason="unit", remaining=1)
+    with open(tmp_path / "flightrec.json") as f:
+        dump = json.load(f)
+    assert dump["trigger"] == "nan_rollback"
+    assert dump["events"][-1]["event"] == "nan_rollback"
+
+    # a later abnormal exit overwrites: the newest post-mortem wins
+    telemetry.record_preemption(15)
+    with open(tmp_path / "flightrec.json") as f:
+        dump = json.load(f)
+    assert dump["trigger"] == "preempt"
+    assert dump["events"][-1]["event"] == "preempt"
+    assert dump["events"][-2]["event"] == "nan_rollback"
+
+
+def test_ring_disabled(tmp_path):
+    cfg = {"metric": {"telemetry": {"enabled": True, "poll_interval": 0.0, "flightrec_events": 0}}}
+    tel = configure_telemetry(cfg, log_dir=str(tmp_path))
+    try:
+        tel.emit("unit", n=1)
+        assert tel.dump_flight_record("manual") is None
+        assert not os.path.exists(tmp_path / "flightrec.json")
+    finally:
+        shutdown_telemetry()
+
+
+def test_writer_rotation_bounds_disk(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    writer = TelemetryWriter(path, max_bytes=2000)
+    for n in range(200):
+        writer.write({"event": "unit", "n": n, "pad": "x" * 64})
+        writer.flush()
+    writer.close()
+    assert writer.rotations >= 1
+    assert writer.segments() == [path + ".1", path]
+    # each segment stays around the cap: total disk ~<= 2x max_bytes
+    assert os.path.getsize(path + ".1") <= 2000 + 200
+    assert os.path.getsize(path) <= 2000 + 200
+    # both segments are intact JSONL and jointly hold the newest events
+    events = []
+    for seg in writer.segments():
+        with open(seg) as f:
+            events += [json.loads(line) for line in f if line.strip()]
+    ns = [e["n"] for e in events]
+    assert ns == sorted(ns)
+    assert ns[-1] == 199
+
+
+def test_rotation_off_by_default(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    writer = TelemetryWriter(path)
+    for n in range(200):
+        writer.write({"event": "unit", "n": n, "pad": "x" * 64})
+    writer.close()
+    assert writer.rotations == 0
+    assert writer.segments() == [path]
+
+
+def test_run_end_reports_rotation_and_segments(tmp_path):
+    cfg = {"metric": {"telemetry": {"enabled": True, "poll_interval": 0.0, "max_bytes": 1500}}}
+    tel = configure_telemetry(cfg, log_dir=str(tmp_path))
+    for n in range(100):
+        tel.emit("unit", n=n, pad="x" * 64)
+        tel.writer.flush()
+    shutdown_telemetry()
+    # run_end lands in the CURRENT (newest) segment
+    with open(tmp_path / "telemetry.jsonl") as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    run_end = [e for e in events if e["event"] == "run_end"][-1]
+    assert run_end["telemetry_rotations"] >= 1
+    assert run_end["telemetry_segments"] == ["telemetry.jsonl.1", "telemetry.jsonl"]

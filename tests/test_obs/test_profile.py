@@ -1,0 +1,161 @@
+"""Triggered XLA profiler capture (obs/profile.py): explicit-window and
+slow-window triggers against injected start/stop, plus the CLI acceptance
+run that lands a real Perfetto trace and registers it in RUNS.jsonl."""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.obs.profile import TriggeredProfiler
+
+
+class FakeTracer:
+    def __init__(self, fail_start=False):
+        self.starts = []
+        self.stops = 0
+        self.fail_start = fail_start
+
+    def start(self, path):
+        if self.fail_start:
+            raise RuntimeError("profiler busy")
+        self.starts.append(path)
+
+    def stop(self):
+        self.stops += 1
+
+
+def test_explicit_windows_merge_consecutive(tmp_path):
+    tracer = FakeTracer()
+    prof = TriggeredProfiler(
+        str(tmp_path / "traces"), windows=[2, 3], start_trace=tracer.start, stop_trace=tracer.stop
+    )
+    for window in range(1, 6):
+        prof.on_window(window)
+    captures = prof.finish()
+    # windows 2 and 3 are consecutive: ONE trace spans both
+    assert len(captures) == 1
+    assert captures[0]["trigger"] == "explicit"
+    assert captures[0]["windows"] == [2, 3]
+    assert tracer.starts == [str(tmp_path / "traces" / "window_00002")]
+    assert tracer.stops == 1
+    assert os.path.isdir(captures[0]["trace_dir"])
+    assert captures[0]["t_end"] >= captures[0]["t_start"]
+
+
+def test_disjoint_windows_produce_separate_captures(tmp_path):
+    tracer = FakeTracer()
+    prof = TriggeredProfiler(
+        str(tmp_path / "t"), windows=[1, 4], start_trace=tracer.start, stop_trace=tracer.stop
+    )
+    for window in range(1, 6):
+        prof.on_window(window)
+    captures = prof.finish()
+    assert [c["windows"] for c in captures] == [[1], [4]]
+    assert tracer.stops == 2
+
+
+def test_capture_straddling_run_end_is_closed_by_finish(tmp_path):
+    tracer = FakeTracer()
+    prof = TriggeredProfiler(str(tmp_path / "t"), windows=[3], start_trace=tracer.start, stop_trace=tracer.stop)
+    for window in range(1, 4):
+        prof.on_window(window)  # run ends while window 3 is being traced
+    captures = prof.finish()
+    assert len(captures) == 1 and tracer.stops == 1
+
+
+def test_slow_window_fires_exactly_once_and_captures_next_window(tmp_path):
+    tracer = FakeTracer()
+    prof = TriggeredProfiler(
+        str(tmp_path / "t"),
+        slow_factor=3.0,
+        slow_min_history=4,
+        start_trace=tracer.start,
+        stop_trace=tracer.stop,
+    )
+    slow_at = {6: 1.0, 9: 2.0}  # second anomaly must NOT re-trigger
+    for window in range(1, 12):
+        prof.on_window(window)
+        prof.observe_span("Time/env_interaction_time", 99.0)  # non-train spans ignored
+        prof.observe_span("Time/train_time", slow_at.get(window, 0.1))
+    captures = prof.finish()
+    assert len(captures) == 1
+    assert captures[0]["trigger"] == "slow_window"
+    assert captures[0]["windows"] == [7]  # window 6 already ran untraced
+    assert tracer.starts == [str(tmp_path / "t" / "window_00007")]
+
+
+def test_slow_window_needs_history(tmp_path):
+    tracer = FakeTracer()
+    prof = TriggeredProfiler(
+        str(tmp_path / "t"), slow_factor=3.0, slow_min_history=8, start_trace=tracer.start, stop_trace=tracer.stop
+    )
+    for window in range(1, 5):  # only 4 healthy windows: watchdog not armed
+        prof.on_window(window)
+        prof.observe_span("Time/train_time", 10.0 if window == 4 else 0.1)
+    assert prof.finish() == []
+
+
+def test_failed_start_trace_is_swallowed(tmp_path):
+    tracer = FakeTracer(fail_start=True)
+    prof = TriggeredProfiler(str(tmp_path / "t"), windows=[1], start_trace=tracer.start, stop_trace=tracer.stop)
+    prof.on_window(1)
+    prof.on_window(2)
+    assert prof.finish() == []  # no capture, no crash
+    assert tracer.stops == 0
+
+
+@pytest.mark.profile
+def test_cli_profile_window_lands_trace_and_registry_record(tmp_path, monkeypatch):
+    """ISSUE acceptance: a tiny CartPole PPO run with
+    metric.telemetry.profile_windows=[2] produces a non-empty Perfetto trace
+    dir AND appends a schema-valid RUNS.jsonl record carrying the capture."""
+    from sheeprl_tpu.cli import run
+    from sheeprl_tpu.obs.registry import SCHEMA_VERSION, read_run_records
+
+    monkeypatch.chdir(tmp_path)
+    runs = str(tmp_path / "RUNS.jsonl")
+    run(
+        [
+            "exp=ppo",
+            "env.capture_video=False",
+            "buffer.memmap=False",
+            "algo.total_steps=256",
+            "algo.rollout_steps=32",
+            "algo.per_rank_batch_size=8",
+            "algo.update_epochs=1",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.encoder.cnn_features_dim=16",
+            "algo.encoder.mlp_features_dim=8",
+            "env.num_envs=2",
+            "algo.run_test=False",
+            "checkpoint.save_last=False",
+            "metric.log_level=1",
+            "metric.telemetry.enabled=True",
+            "metric.telemetry.poll_interval=0.0",
+            "metric.telemetry.profile_windows=[2]",
+            f"metric.telemetry.runs_jsonl={runs}",
+            "run_name=evidence",
+            f"log_base_dir={tmp_path}/logs",
+        ]
+    )
+
+    (record,) = read_run_records(runs)
+    assert record["schema"] == SCHEMA_VERSION
+    assert record["kind"] == "train"
+    assert record["outcome"] == "completed"
+    assert record["algo"] == "ppo"
+    assert record["env"] == "CartPole-v1"
+    assert record["backend"] == "cpu"
+    assert record["config_digest"] and record["git_sha"]
+    assert record["sps_env"] > 0 and record["sps_train"] > 0
+    assert record["final_metrics"], "aggregator scalars must reach the record"
+
+    (capture,) = record["profile_captures"]
+    assert capture["trigger"] == "explicit"
+    assert capture["windows"] == [2]
+    trace_dir = capture["trace_dir"]
+    assert os.path.isdir(trace_dir)
+    traced_files = [os.path.join(r, f) for r, _, fs in os.walk(trace_dir) for f in fs]
+    assert traced_files, "jax.profiler must have written trace artifacts"
+    assert any(os.path.getsize(p) > 0 for p in traced_files)

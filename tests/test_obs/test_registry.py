@@ -1,0 +1,146 @@
+"""Run registry (obs/registry.py): record round-trip, path resolution,
+tolerant reads, atomic concurrent appends, and the register_run hook's
+outcome reclassification."""
+
+import json
+import os
+import threading
+
+from sheeprl_tpu.obs.registry import (
+    SCHEMA_VERSION,
+    append_run_record,
+    build_run_record,
+    read_run_records,
+    register_run,
+    runs_jsonl_path,
+)
+from sheeprl_tpu.obs.telemetry import configure_telemetry, shutdown_telemetry
+
+
+def _cfg(runs_path=None):
+    cfg = {
+        "algo": {"name": "ppo"},
+        "env": {"id": "CartPole-v1"},
+        "exp_name": "ppo_CartPole-v1",
+        "run_name": "unit",
+        "seed": 5,
+        "metric": {"telemetry": {"enabled": True, "poll_interval": 0.0}},
+    }
+    if runs_path is not None:
+        cfg["metric"]["telemetry"]["runs_jsonl"] = runs_path
+    return cfg
+
+
+def test_record_round_trip(tmp_path):
+    path = str(tmp_path / "RUNS.jsonl")
+    record = build_run_record(_cfg(), kind="train", outcome="completed", summary={"sps_env": 123.0})
+    append_run_record(record, path)
+    (back,) = read_run_records(path)
+    assert back["schema"] == SCHEMA_VERSION
+    assert back["kind"] == "train"
+    assert back["outcome"] == "completed"
+    assert back["algo"] == "ppo"
+    assert back["env"] == "CartPole-v1"
+    assert back["seed"] == 5
+    assert back["sps_env"] == 123.0
+    assert isinstance(back["t"], float)
+    # the digest is stable across identical configs, sensitive to any change
+    assert back["config_digest"] == build_run_record(_cfg(), kind="train", outcome="completed")["config_digest"]
+    other = _cfg()
+    other["seed"] = 6
+    assert back["config_digest"] != build_run_record(other, kind="train", outcome="completed")["config_digest"]
+
+
+def test_unknown_outcome_recorded_as_crashed():
+    assert build_run_record(None, kind="train", outcome="exploded")["outcome"] == "crashed"
+
+
+def test_path_precedence(tmp_path, monkeypatch):
+    # 1. explicit argument wins over everything
+    assert runs_jsonl_path(_cfg("from_cfg.jsonl"), path="explicit.jsonl") == "explicit.jsonl"
+    # 2. config beats the env var
+    monkeypatch.setenv("SHEEPRL_TPU_RUNS_JSONL", "from_env.jsonl")
+    assert runs_jsonl_path(_cfg("from_cfg.jsonl")) == "from_cfg.jsonl"
+    # config False disables even with the env var set
+    assert runs_jsonl_path(_cfg(False)) is None
+    # 3. env var when the config is silent; empty env var disables
+    assert runs_jsonl_path(_cfg()) == "from_env.jsonl"
+    monkeypatch.setenv("SHEEPRL_TPU_RUNS_JSONL", "")
+    assert runs_jsonl_path(_cfg()) is None
+    # 4. default: <cwd>/RUNS.jsonl
+    monkeypatch.delenv("SHEEPRL_TPU_RUNS_JSONL")
+    monkeypatch.chdir(tmp_path)
+    assert runs_jsonl_path(_cfg()) == str(tmp_path / "RUNS.jsonl")
+
+
+def test_reader_skips_garbage_and_newer_schema(tmp_path):
+    path = str(tmp_path / "RUNS.jsonl")
+    append_run_record({"schema": SCHEMA_VERSION, "kind": "train", "n": 1}, path)
+    with open(path, "a") as f:
+        f.write("{torn line\n")
+        f.write("\n")
+        f.write("[1, 2, 3]\n")  # parseable but not a record
+        f.write(json.dumps({"schema": SCHEMA_VERSION + 1, "kind": "future"}) + "\n")
+    append_run_record({"schema": SCHEMA_VERSION, "kind": "train", "n": 2}, path)
+    records = read_run_records(path)
+    assert [r["n"] for r in records] == [1, 2]
+    assert read_run_records(str(tmp_path / "missing.jsonl")) == []
+
+
+def test_concurrent_appends_interleave_whole_lines(tmp_path):
+    """8 writer threads x 25 records each: every line must parse back — the
+    O_APPEND + flock append can never tear a record."""
+    path = str(tmp_path / "RUNS.jsonl")
+
+    def writer(tid):
+        for n in range(25):
+            append_run_record(
+                {"schema": SCHEMA_VERSION, "kind": "train", "tid": tid, "n": n, "pad": "x" * 256},
+                path,
+            )
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with open(path) as f:
+        lines = [line for line in f if line.strip()]
+    assert len(lines) == 200
+    records = read_run_records(path)
+    assert len(records) == 200
+    assert {(r["tid"], r["n"]) for r in records} == {(t, n) for t in range(8) for n in range(25)}
+
+
+def test_register_run_rolls_up_telemetry_and_reclassifies(tmp_path):
+    """register_run folds the live telemetry's run_summary into the record
+    and reclassifies crashed -> rolled_back when NaN rollbacks happened."""
+    runs = str(tmp_path / "RUNS.jsonl")
+    cfg = _cfg(runs)
+    tel = configure_telemetry(cfg, log_dir=str(tmp_path))
+    try:
+        tel.record_nan_rollback(None, reason="unit", remaining=2)
+        record = register_run(cfg, kind="train", outcome="crashed", error="boom " * 200)
+    finally:
+        shutdown_telemetry()
+    assert record is not None
+    assert record["outcome"] == "rolled_back"
+    assert record["nan_rollbacks"] == 1
+    assert record["backend"] == "cpu"
+    assert len(record["error"]) <= 500
+    (back,) = read_run_records(runs)
+    assert back["outcome"] == "rolled_back"
+    assert back["config_digest"] == record["config_digest"]
+
+
+def test_register_run_disabled_and_without_telemetry(tmp_path, monkeypatch):
+    # runs_jsonl=False: no record, no file — and never raises
+    assert register_run(_cfg(False), kind="eval", outcome="completed") is None
+    # telemetry off but registry on: identity-only record still lands
+    monkeypatch.chdir(tmp_path)
+    cfg = _cfg(str(tmp_path / "RUNS.jsonl"))
+    cfg["metric"]["telemetry"]["enabled"] = False
+    record = register_run(cfg, kind="eval", outcome="completed", checkpoint="x.ckpt")
+    assert record["algo"] == "ppo" and record["checkpoint"] == "x.ckpt"
+    assert "backend" not in record  # no telemetry -> no rollup
+    assert len(read_run_records(str(tmp_path / "RUNS.jsonl"))) == 1

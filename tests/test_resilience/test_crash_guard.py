@@ -132,13 +132,21 @@ def test_crash_drill_emergency_save_and_auto_resume(tmp_path, monkeypatch):
     assert read_manifest(emergency.path)["emergency"] is True
     assert load_checkpoint(emergency.path)["update"] == 1  # update 2 never ran
 
-    events, _ = _telemetry_events(tmp_path)
+    events, jsonl = _telemetry_events(tmp_path)
     crashes = [e for e in events if e["event"] == "crash_checkpoint"]
     assert len(crashes) == 1
     assert crashes[0]["path"] == emergency.path
     assert "injected train-loop crash" in crashes[0]["error"]
     run_end = [e for e in events if e["event"] == "run_end"][-1]
     assert run_end["crash_checkpoints"] == 1
+
+    # the crash-guard path also dumped the flight recorder (evidence engine):
+    # the crash_checkpoint event is the newest thing in the ring
+    with open(os.path.join(os.path.dirname(jsonl), "flightrec.json")) as f:
+        flight = json.load(f)
+    assert flight["trigger"] == "crash"
+    assert flight["events"][-1]["event"] == "crash_checkpoint"
+    assert len(flight["events"]) <= flight["ring_capacity"]
 
     # the crashed run restarts exactly like a preempted one
     run(args + ["checkpoint.resume_from=auto"])

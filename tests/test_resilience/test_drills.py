@@ -163,6 +163,18 @@ def test_nan_drill_one_rollback_run_completes(tmp_path, monkeypatch):
     run_end = [e for e in events if e["event"] == "run_end"][-1]
     assert run_end["nan_rollbacks"] == 1
 
+    # evidence engine (ISSUE acceptance): the rollback dumped the flight
+    # recorder next to telemetry.jsonl — one valid JSON document, bounded
+    # ring, the nan_rollback trigger event LAST among its events
+    flight_path = os.path.join(os.path.dirname(jsonl), "flightrec.json")
+    assert os.path.exists(flight_path)
+    with open(flight_path) as f:
+        flight = json.load(f)
+    assert flight["trigger"] == "nan_rollback"
+    assert len(flight["events"]) <= flight["ring_capacity"]
+    assert flight["events"][-1]["event"] == "nan_rollback"
+    assert flight["events"][-1]["update"] == 3
+
     # the run completed: the save_last checkpoint carries the final update
     finals = [
         c for d in _ckpt_dirs(tmp_path) for c in committed_checkpoints(d) if c.step == 256

@@ -1,0 +1,383 @@
+#!/usr/bin/env python3
+"""Regression gates over the run registry (``RUNS.jsonl``) → ``SCENARIOS.json``.
+
+ROADMAP item 5's scenario health grid, fed mechanically: every registry
+record (appended by the entrypoints at run end, see
+``sheeprl_tpu/obs/registry.py`` and ``howto/evidence.md``) lands in a
+*scenario cell* keyed ``kind:algo:env:topology``. For each cell the newest
+completed record is compared, metric by metric, against a tolerance-banded
+baseline — the median of up to ``--window`` prior completed records — and
+the per-cell verdicts (``pass`` / ``regress`` / ``insufficient_history``)
+are written as a grid to ``SCENARIOS.json``. Exit status is nonzero when any
+cell regresses, so a nightly job can gate on it.
+
+Gated metrics (direction, and an absolute slack for count metrics so a
+single flaky restart doesn't page anyone):
+
+==================  ======  =====================================
+metric              better  source
+==================  ======  =====================================
+sps_env             higher  heartbeat rollup (run-average)
+sps_train           higher  heartbeat rollup (run-average)
+mfu                 higher  last heartbeat MFU
+serve_qps           higher  serve run_end stats (``serve.stats.qps``)
+serve_p95_ms        lower   serve run_end stats (``serve.stats.p95_ms``)
+worker_restarts     lower   rollout supervision totals (slack 1)
+masked_slots        lower   rollout supervision totals (slack 1)
+nan_rollbacks       lower   resilience totals (slack 1)
+recompiles          lower   compile watchdog totals (slack 1)
+==================  ======  =====================================
+
+``--bench`` additionally folds the repo's ``BENCH_r*.json`` driver records
+into synthetic ``bench:*`` cells so the historical chip numbers participate
+even though they predate the registry.
+
+Deliberately dependency-free (stdlib only): ``bench.py --regress`` loads
+this file in the jax-free parent process, and CI can run it on any box.
+
+``--self-test`` runs the verdict logic against a synthetic history
+(pass / regress / insufficient) and exits nonzero on any mismatch — the
+pytest-visible smoke for the gate itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as globlib
+import json
+import os
+import sys
+import time
+from statistics import median
+from typing import Any, Dict, List, Optional, Tuple
+
+SCHEMA_VERSION = 1
+DEFAULT_TOL = 0.2
+DEFAULT_WINDOW = 5
+DEFAULT_MIN_HISTORY = 2
+
+# metric -> (higher_is_better, absolute_slack)
+METRICS: Dict[str, Tuple[bool, float]] = {
+    "sps_env": (True, 0.0),
+    "sps_train": (True, 0.0),
+    "mfu": (True, 0.0),
+    "serve_qps": (True, 0.0),
+    "serve_p95_ms": (False, 0.0),
+    "worker_restarts": (False, 1.0),
+    "masked_slots": (False, 1.0),
+    "nan_rollbacks": (False, 1.0),
+    "recompiles": (False, 1.0),
+}
+
+
+# ------------------------------------------------------------------ loading ----
+
+
+def read_records(path: str) -> List[Dict[str, Any]]:
+    """Tolerant JSONL reader: skips blank/unparsable lines and records from
+    a newer schema (mirrors obs/registry.py without importing the package)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and int(rec.get("schema", 1) or 1) <= SCHEMA_VERSION:
+                    out.append(rec)
+    except OSError:
+        return []
+    return out
+
+
+def bench_records(pattern: str) -> List[Dict[str, Any]]:
+    """Fold the driver-captured ``BENCH_r*.json`` files into synthetic
+    registry records (kind ``bench``), skipping outage rounds whose numbers
+    are cached replays of older windows."""
+    out: List[Dict[str, Any]] = []
+    for path in sorted(globlib.glob(pattern)):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed") if isinstance(doc, dict) else None
+        if not isinstance(parsed, dict) or parsed.get("outage"):
+            continue
+        t = float(doc.get("n", 0) or 0)  # round index orders the history
+        sections = [parsed] + ([parsed["secondary"]] if isinstance(parsed.get("secondary"), dict) else [])
+        for sec in sections:
+            name, value = sec.get("metric"), sec.get("value")
+            if not name or value is None:
+                continue
+            algo = str(name).split("_env_steps", 1)[0].split("_cartpole", 1)[0]
+            out.append(
+                {
+                    "schema": SCHEMA_VERSION,
+                    "t": t,
+                    "kind": "bench",
+                    "algo": algo,
+                    "env": "bench",
+                    "outcome": "completed",
+                    "sps_env": float(value),
+                }
+            )
+    return out
+
+
+# ---------------------------------------------------------------- cells ----
+
+
+def cell_key(rec: Dict[str, Any]) -> str:
+    backend = rec.get("backend") or "?"
+    devices = rec.get("local_device_count")
+    procs = rec.get("process_count")
+    topo = f"{backend}x{devices or '?'}p{procs or '?'}"
+    return f"{rec.get('kind', 'train')}:{rec.get('algo') or '?'}:{rec.get('env') or '?'}:{topo}"
+
+
+def record_metrics(rec: Dict[str, Any]) -> Dict[str, float]:
+    """Extract the gated metrics present in one registry record."""
+    out: Dict[str, float] = {}
+    for key in ("sps_env", "sps_train", "mfu", "worker_restarts", "masked_slots", "nan_rollbacks", "recompiles"):
+        value = rec.get(key)
+        if isinstance(value, (int, float)):
+            out[key] = float(value)
+    serve = rec.get("serve") or {}
+    stats = serve.get("stats") if isinstance(serve, dict) else None
+    if not isinstance(stats, dict):
+        stats = rec.get("serve_stats") if isinstance(rec.get("serve_stats"), dict) else {}
+    if isinstance(stats.get("qps"), (int, float)):
+        out["serve_qps"] = float(stats["qps"])
+    if isinstance(stats.get("p95_ms"), (int, float)):
+        out["serve_p95_ms"] = float(stats["p95_ms"])
+    return out
+
+
+def _metric_verdict(
+    name: str, newest: float, history: List[float], tol: float, min_history: int
+) -> Dict[str, Any]:
+    if len(history) < min_history:
+        return {"newest": newest, "history": len(history), "verdict": "insufficient_history"}
+    higher_better, slack = METRICS[name]
+    base = median(history)
+    if higher_better:
+        allowed = base * (1.0 - tol) - slack
+        regressed = newest < allowed
+    else:
+        allowed = base * (1.0 + tol) + slack
+        regressed = newest > allowed
+    return {
+        "newest": newest,
+        "baseline": base,
+        "allowed": allowed,
+        "history": len(history),
+        "verdict": "regress" if regressed else "pass",
+    }
+
+
+def evaluate(
+    records: List[Dict[str, Any]],
+    *,
+    tol: float = DEFAULT_TOL,
+    window: int = DEFAULT_WINDOW,
+    min_history: int = DEFAULT_MIN_HISTORY,
+) -> Dict[str, Any]:
+    """Group completed records into cells and gate the newest of each
+    against its own history. Returns the SCENARIOS.json document."""
+    completed = [r for r in records if r.get("outcome") == "completed"]
+    cells: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in sorted(completed, key=lambda r: float(r.get("t", 0) or 0)):
+        cells.setdefault(cell_key(rec), []).append(rec)
+
+    grid: Dict[str, Any] = {}
+    counts = {"pass": 0, "regress": 0, "insufficient_history": 0}
+    for key, recs in sorted(cells.items()):
+        newest = recs[-1]
+        prior = recs[:-1][-window:]
+        newest_metrics = record_metrics(newest)
+        verdicts: Dict[str, Any] = {}
+        for name, value in sorted(newest_metrics.items()):
+            history = [record_metrics(r)[name] for r in prior if name in record_metrics(r)]
+            verdicts[name] = _metric_verdict(name, value, history, tol, min_history)
+        states = {v["verdict"] for v in verdicts.values()}
+        if "regress" in states:
+            cell_state = "regress"
+        elif "pass" in states:
+            cell_state = "pass"
+        else:
+            cell_state = "insufficient_history"
+        counts[cell_state] += 1
+        grid[key] = {
+            "verdict": cell_state,
+            "runs": len(recs),
+            "newest_t": newest.get("t"),
+            "newest_outcome": newest.get("outcome"),
+            "metrics": verdicts,
+        }
+    ignored = len(records) - len(completed)
+    return {
+        "schema": SCHEMA_VERSION,
+        "generated_t": time.time(),
+        "tolerance": tol,
+        "window": window,
+        "min_history": min_history,
+        "records": len(records),
+        "records_ignored_not_completed": ignored,
+        "summary": counts,
+        "cells": grid,
+    }
+
+
+# ---------------------------------------------------------------- output ----
+
+
+def write_scenarios(doc: Dict[str, Any], path: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, default=str)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def render_grid(doc: Dict[str, Any], stream=sys.stdout) -> None:
+    marks = {"pass": "PASS   ", "regress": "REGRESS", "insufficient_history": "HISTORY"}
+    for key, cell in doc["cells"].items():
+        print(f"{marks[cell['verdict']]} {key} (runs={cell['runs']})", file=stream)
+        if cell["verdict"] == "regress":
+            for name, v in cell["metrics"].items():
+                if v["verdict"] == "regress":
+                    print(
+                        f"        {name}: {v['newest']:.4g} vs baseline {v['baseline']:.4g} "
+                        f"(allowed {v['allowed']:.4g})",
+                        file=stream,
+                    )
+    s = doc["summary"]
+    print(
+        f"# {len(doc['cells'])} cells: {s['pass']} pass, {s['regress']} regress, "
+        f"{s['insufficient_history']} insufficient history "
+        f"({doc['records']} records, {doc['records_ignored_not_completed']} not-completed ignored)",
+        file=stream,
+    )
+
+
+# -------------------------------------------------------------- self-test ----
+
+
+def self_test() -> int:
+    """Verdict logic against synthetic history: a stable cell passes, a
+    collapsed-SPS cell regresses, a single-record cell reports insufficient
+    history — and not-completed records never enter a baseline."""
+
+    def rec(t, algo, sps, outcome="completed", **extra):
+        return {
+            "schema": SCHEMA_VERSION,
+            "t": t,
+            "kind": "train",
+            "algo": algo,
+            "env": "CartPole-v1",
+            "backend": "cpu",
+            "local_device_count": 1,
+            "process_count": 1,
+            "outcome": outcome,
+            "sps_env": sps,
+            **extra,
+        }
+
+    records = [
+        # stable cell: newest within the band
+        rec(1, "ppo", 100.0),
+        rec(2, "ppo", 104.0),
+        rec(3, "ppo", 98.0),
+        rec(4, "ppo", 101.0),
+        # regressed cell: newest collapses far past the tolerance band
+        rec(1, "sac", 200.0),
+        rec(2, "sac", 198.0),
+        rec(3, "sac", 202.0),
+        rec(4, "sac", 90.0),
+        # crashed runs must not count as history OR newest
+        rec(5, "sac", 1.0, outcome="crashed"),
+        # insufficient history: a single record
+        rec(1, "dreamer_v3", 50.0),
+    ]
+    doc = evaluate(records)
+    got = {key.split(":")[1]: cell["verdict"] for key, cell in doc["cells"].items()}
+    want = {"ppo": "pass", "sac": "regress", "dreamer_v3": "insufficient_history"}
+    failures = [f"{k}: want {want[k]}, got {got.get(k)}" for k in want if got.get(k) != want[k]]
+    sac = doc["cells"]["train:sac:CartPole-v1:cpux1p1"]
+    if sac["newest_outcome"] != "completed":
+        failures.append("crashed record selected as newest")
+    if exit_code(doc) != 1:
+        failures.append(f"exit code: want 1, got {exit_code(doc)}")
+    if exit_code(evaluate([r for r in records if r["algo"] != "sac"])) != 0:
+        failures.append("exit code without the regressed cell: want 0")
+    if failures:
+        print("regress self-test FAILED:\n  " + "\n  ".join(failures), file=sys.stderr)
+        return 1
+    print("regress self-test: ok (pass / regress / insufficient_history verdicts verified)")
+    return 0
+
+
+def exit_code(doc: Dict[str, Any]) -> int:
+    return 1 if doc["summary"]["regress"] else 0
+
+
+# ------------------------------------------------------------------- main ----
+
+
+def run_gate(
+    runs_path: str,
+    out_path: Optional[str] = None,
+    *,
+    bench_pattern: Optional[str] = None,
+    tol: float = DEFAULT_TOL,
+    window: int = DEFAULT_WINDOW,
+    min_history: int = DEFAULT_MIN_HISTORY,
+    quiet: bool = False,
+) -> int:
+    """Load → evaluate → write grid → render. Returns the process exit code
+    (``1`` on any regressed cell). The shared entry for the CLI here and
+    ``bench.py --regress``."""
+    records = read_records(runs_path)
+    if bench_pattern:
+        records += bench_records(bench_pattern)
+    doc = evaluate(records, tol=tol, window=window, min_history=min_history)
+    if out_path:
+        write_scenarios(doc, out_path)
+    if not quiet:
+        render_grid(doc)
+    return exit_code(doc)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--runs", default="RUNS.jsonl", help="run-registry JSONL (default: ./RUNS.jsonl)")
+    parser.add_argument("--out", default="SCENARIOS.json", help="verdict-grid output (default: ./SCENARIOS.json)")
+    parser.add_argument("--bench", metavar="GLOB", help="also fold driver bench records, e.g. 'BENCH_r*.json'")
+    parser.add_argument("--tol", type=float, default=DEFAULT_TOL, help="relative tolerance band (default 0.2)")
+    parser.add_argument("--window", type=int, default=DEFAULT_WINDOW, help="baseline history window (default 5)")
+    parser.add_argument(
+        "--min-history", type=int, default=DEFAULT_MIN_HISTORY, help="prior runs required to gate (default 2)"
+    )
+    parser.add_argument("--quiet", action="store_true", help="no grid on stdout, exit code only")
+    parser.add_argument("--self-test", action="store_true", help="verify the verdict logic and exit")
+    args = parser.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    return run_gate(
+        args.runs,
+        args.out,
+        bench_pattern=args.bench,
+        tol=args.tol,
+        window=args.window,
+        min_history=args.min_history,
+        quiet=args.quiet,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())
