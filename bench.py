@@ -951,7 +951,45 @@ if __name__ == "__main__":
     parser.add_argument(
         "--bench-glob", default="BENCH_r*.json", help="driver bench records folded into --regress ('' disables)"
     )
+    parser.add_argument(
+        "--static",
+        action="store_true",
+        help="static gate: run the jaxcheck rule scan + config-matrix "
+        "validation (tools/jaxcheck) in a subprocess, print a one-line "
+        "summary, exit nonzero on any new finding or failed config cell",
+    )
     args = parser.parse_args()
+    if args.static:
+        # jaxcheck imports the config plane with algo imports gated off, so
+        # the child never loads jax; a subprocess keeps this parent identical
+        # to the --regress path (jax-free, timeout-safe)
+        import subprocess
+
+        env = dict(os.environ, SHEEPRL_TPU_SKIP_ALGO_IMPORTS="1")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.jaxcheck", "--json", "--scenarios", args.scenarios_out],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        try:
+            report = json.loads(proc.stdout)
+        except ValueError:
+            sys.stderr.write(proc.stdout + proc.stderr)
+            sys.exit(proc.returncode or 2)
+        by_rule = ", ".join(f"{k}:{v}" for k, v in report["counts_by_rule"].items()) or "none"
+        cfg = report.get("config") or {}
+        print(
+            f"static: {report['findings_total']} findings ({by_rule}), "
+            f"{report['baseline_suppressed']} baseline-suppressed, {len(report['new'])} new; "
+            f"config cells {cfg.get('pass', 0)}/{cfg.get('cells', 0)} pass "
+            f"({cfg.get('fail', 0)} fail, {cfg.get('warnings', 0)} warnings)"
+        )
+        for line in report["new"]:
+            print(f"  NEW {line}")
+        sys.exit(proc.returncode)
     if args.regress:
         # the gate is stdlib-only; load it by file path so this parent
         # process stays jax-free (same reason main() shells out workloads)

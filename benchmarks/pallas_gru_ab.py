@@ -80,9 +80,12 @@ def main() -> None:
         if not fits_vmem(x_dim, dense, hidden):
             print(f"{label}: exceeds the VMEM kernel budget, skipped")
             continue
-        p = _params(key, x_dim, dense, hidden)
+        # distinct streams for the params and the input batch — drawing both
+        # from the same key would correlate them (and flags JX01)
+        key, p_key, x_key = jax.random.split(key, 3)
+        p = _params(p_key, x_dim, dense, hidden)
         h0 = jnp.zeros((B, hidden))
-        xs = jax.random.normal(key, (T * REPEAT, B, x_dim))
+        xs = jax.random.normal(x_key, (T * REPEAT, B, x_dim))
 
         results = {}
         for name, step in (("pallas", fused_recurrent_step), ("flax", reference_step)):

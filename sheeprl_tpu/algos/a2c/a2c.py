@@ -273,7 +273,11 @@ def main(fabric, cfg: Dict[str, Any]):
         with timer("Time/train_time"):
             params, opt_state, metrics = train_fn(params, opt_state, flat)
             metrics = jax.block_until_ready(metrics)
-        if not resil.check_finite(np.asarray(metrics), update):
+        # one host fetch serves the NaN sentinel and the aggregator scalars
+        # below — float(metrics[i]) on the device array would be a blocking
+        # transfer per scalar per update
+        metrics = np.asarray(metrics)
+        if not resil.check_finite(metrics, update):
             # restore the newest committed checkpoint and fork the action key
             # away from the stream that diverged; the loop keeps advancing
             restored = resil.rollback(update=update)

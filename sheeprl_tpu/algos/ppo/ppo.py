@@ -430,7 +430,11 @@ def main(fabric, cfg: Dict[str, Any]):
                 np.float32(ent_coef),
             )
             metrics = jax.block_until_ready(metrics)
-        if not resil.check_finite(np.asarray(metrics), update):
+        # one host fetch serves the NaN sentinel and the aggregator scalars
+        # below — float(metrics[i]) on the device array would be a blocking
+        # transfer per scalar per update
+        metrics = np.asarray(metrics)
+        if not resil.check_finite(metrics, update):
             # restore the newest committed checkpoint in place of the
             # poisoned params/opt state, fork the sample key away from the
             # stream that diverged, and move on to the next update — the

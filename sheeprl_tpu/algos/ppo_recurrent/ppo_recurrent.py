@@ -450,10 +450,15 @@ def main(fabric, cfg: Dict[str, Any]):
                 hx0,
                 cx0,
                 train_key,
-                jnp.float32(clip_coef),
-                jnp.float32(ent_coef),
+                # host numpy scalars — jnp.float32 would materialize them on
+                # the default backend every update (see ppo.py)
+                np.float32(clip_coef),
+                np.float32(ent_coef),
             )
             metrics = jax.block_until_ready(metrics)
+        # one host fetch for the three aggregator scalars below instead of a
+        # blocking device transfer per float()
+        metrics = np.asarray(metrics)
         player.params = params
         train_step += world_size
 

@@ -178,9 +178,19 @@ class CompileWatchdog:
                 self.deliberate_compiles[reason] = self.deliberate_compiles.get(reason, 0) + 1
             elif post_warm:
                 self.recompiles += 1
+                # a dedicated event carrying the offending function's
+                # qualified name, so runtime retraces can be cross-referenced
+                # against jaxcheck's static JX05 findings (tools/jaxcheck,
+                # howto/static_analysis.md) — the `compile` stream below is
+                # shared with warmup and deliberate compiles
+                try:
+                    self._emit("recompile", name=name, qualname=name, dur=duration, count=self.recompiles)
+                except Exception:
+                    pass
                 warnings.warn(
                     f"recompile after warmup: {name} was re-traced/re-lowered "
-                    f"({duration:.3f}s). Check for weak-type or shape drift in its inputs.",
+                    f"({duration:.3f}s). Check for weak-type or shape drift in its inputs. "
+                    f"Static complement: python -m tools.jaxcheck (JX05).",
                     RecompileWarning,
                     stacklevel=2,
                 )

@@ -1,0 +1,182 @@
+"""Config-matrix validation tests: carrier resolution, mandatory-value
+stubbing, the divisibility/mesh invariants, SCENARIOS.json folding, and the
+full repo matrix composing clean."""
+
+import json
+import os
+
+import pytest
+
+from tools.jaxcheck import configcheck
+
+
+def _write(root, rel, text):
+    path = os.path.join(root, rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(text)
+
+
+@pytest.fixture
+def config_tree(tmp_path):
+    """A miniature config tree with one mandatory value and one interpolation."""
+    root = str(tmp_path / "configs")
+    _write(
+        root,
+        "config.yaml",
+        "defaults:\n  - algo: null\n  - exp: ???\n  - _self_\nrun_name: ${algo.name}\n",
+    )
+    _write(root, "algo/tiny.yaml", "name: tiny\nlr: ???\n")
+    _write(
+        root,
+        "exp/smoke.yaml",
+        "# @package _global_\ndefaults:\n  - override /algo: tiny\n  - _self_\nseed: 1\n",
+    )
+    return [root]
+
+
+def test_carrier_exp_resolution():
+    exps = ["ppo", "dreamer_v3", "p2e_dv1_exploration", "p2e_dv1_finetuning"]
+    assert configcheck.carrier_exp("ppo", exps) == "ppo"
+    assert configcheck.carrier_exp("dreamer_v3_XS", exps) == "dreamer_v3"
+    assert configcheck.carrier_exp("p2e_dv1", exps) == "p2e_dv1_exploration"
+    assert configcheck.carrier_exp("unrelated", exps) is None
+
+
+def test_stub_values_are_type_plausible():
+    assert configcheck._stub_value("checkpoint.exploration_ckpt_path") == "/dev/null"
+    assert configcheck._stub_value("env.wrapper") == {}
+    assert configcheck._stub_value("algo.total_steps") == 1
+    assert configcheck._stub_value("algo.name") == "stub"
+
+
+def test_compose_cell_stubs_mandatory_values(config_tree):
+    cfg, stubbed, error = configcheck.compose_cell(["exp=smoke"], search_path=config_tree)
+    assert error is None
+    assert cfg["algo"]["name"] == "tiny"
+    assert cfg["run_name"] == "tiny"  # interpolation resolved
+    assert stubbed == {"algo.lr": 1}  # ??? auto-stubbed and recorded
+
+
+def test_compose_cell_reports_missing_group(config_tree):
+    # exp is a mandatory *group* choice — not stubbable with a value
+    cfg, _, error = configcheck.compose_cell([], search_path=config_tree)
+    assert cfg is None
+    assert "exp" in error
+
+
+def test_compose_cell_reports_bad_option(config_tree):
+    cfg, _, error = configcheck.compose_cell(["exp=nope"], search_path=config_tree)
+    assert cfg is None and error
+
+
+def _base_cfg(**over):
+    cfg = {
+        "algo": {"name": "ppo", "total_steps": 1024, "per_rank_batch_size": 64, "rollout_steps": 128},
+        "env": {"id": "CartPole-v1", "num_envs": 4},
+        "fabric": {"accelerator": "cpu", "devices": "auto", "mesh_axes": ["data"], "mesh_shape": None},
+        "buffer": {"size": 128},
+    }
+    for key, value in over.items():
+        node = cfg
+        parts = key.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return cfg
+
+
+def test_invariants_clean_cell():
+    violations, warnings = configcheck.check_invariants(_base_cfg())
+    assert violations == []
+    assert warnings == []
+
+
+def test_invariants_missing_required_key():
+    cfg = _base_cfg()
+    del cfg["algo"]["name"]
+    violations, _ = configcheck.check_invariants(cfg)
+    assert any("algo.name" in v for v in violations)
+
+
+def test_invariants_unpinned_topology_mismatch_is_a_warning():
+    # 5 steps × 4 envs = 20 does not divide over 8 devices, but the cell does
+    # not pin 8 devices — elasticity advisory, not an error
+    violations, warnings = configcheck.check_invariants(
+        _base_cfg(**{"algo.rollout_steps": 5, "algo.per_rank_batch_size": 4, "buffer.size": 8})
+    )
+    assert violations == []
+    assert any("8-device" in w for w in warnings)
+
+
+def test_invariants_pinned_topology_mismatch_is_a_violation():
+    violations, _ = configcheck.check_invariants(
+        _base_cfg(
+            **{
+                "algo.rollout_steps": 5,
+                "algo.per_rank_batch_size": 4,
+                "buffer.size": 8,
+                "fabric.devices": 8,
+            }
+        )
+    )
+    assert any("8-device" in v for v in violations)
+
+
+def test_invariants_mesh_shape_consistency():
+    violations, _ = configcheck.check_invariants(
+        _base_cfg(**{"fabric.mesh_shape": [2, 2], "fabric.mesh_axes": ["data"]})
+    )
+    assert any("mesh_axes" in v for v in violations)
+    violations, _ = configcheck.check_invariants(
+        _base_cfg(**{"fabric.mesh_shape": [4], "fabric.devices": 8})
+    )
+    assert any("fabric.devices" in v for v in violations)
+
+
+def test_invariants_zero_minibatch_is_a_violation():
+    violations, _ = configcheck.check_invariants(
+        _base_cfg(**{"algo.rollout_steps": 8, "env.num_envs": 1, "algo.per_rank_batch_size": 64, "buffer.size": 8})
+    )
+    assert any("zero minibatches" in v for v in violations)
+
+
+def test_buffer_smaller_than_rollout_is_a_violation():
+    violations, _ = configcheck.check_invariants(_base_cfg(**{"buffer.size": 16}))
+    assert any("buffer.size" in v for v in violations)
+
+
+def test_fold_into_scenarios_preserves_existing_grid(tmp_path):
+    path = str(tmp_path / "SCENARIOS.json")
+    with open(path, "w") as f:
+        json.dump({"schema": 1, "cells": {"train:ppo": {"verdict": "pass"}}, "summary": {"pass": 1}}, f)
+    doc = {
+        "schema": 1,
+        "topologies": [1, 8],
+        "cells": 1,
+        "summary": {"pass": 1, "fail": 0, "stubbed_cells": 0, "warnings": 0},
+        "grid": {"config:exp=x:fabric=cpu": {"verdict": "pass"}},
+    }
+    configcheck.fold_into_scenarios(path, doc, static_summary={"total": 0, "new": 0})
+    merged = json.load(open(path))
+    assert merged["cells"] == {"train:ppo": {"verdict": "pass"}}  # regress grid intact
+    assert merged["config_cells"] == {"config:exp=x:fabric=cpu": {"verdict": "pass"}}
+    assert merged["config_summary"]["pass"] == 1
+    assert merged["static_findings"] == {"total": 0, "new": 0}
+
+
+def test_full_repo_matrix_composes_clean():
+    """Acceptance: 100% of the scenario matrix composes, with per-cell
+    verdicts, on the real config tree."""
+    doc = configcheck.run_configcheck()
+    assert doc["cells"] == len(doc["grid"])
+    assert doc["cells"] > 100
+    failed = {k: v for k, v in doc["grid"].items() if v["verdict"] != "pass"}
+    assert failed == {}
+    # the exp axis covers every exp option under both explicit fabrics
+    exps = {k.split(":")[1] for k in doc["grid"] if k.startswith("config:exp=")}
+    assert {"exp=ppo", "exp=dreamer_v3", "exp=sac"} <= exps
+    assert any(k.endswith("fabric=tpu") for k in doc["grid"])
+    # stubbed cells record exactly which CLI values they needed
+    stubbed = [v for v in doc["grid"].values() if v.get("stubbed")]
+    assert stubbed and all(isinstance(v["stubbed"], dict) for v in stubbed)

@@ -1,0 +1,192 @@
+"""Rule-engine tests for tools/jaxcheck: every rule has a positive and a
+negative fixture, every rule honours --disable, and the baseline keys survive
+unrelated edits (they carry no line numbers)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools import jaxcheck
+from tools.jaxcheck import selftest
+from tools.jaxcheck.core import compare_to_baseline, load_baseline, write_baseline
+
+REPO = jaxcheck.repo_root()
+FIXTURE_PATH = selftest.FIXTURE_PATH
+
+
+def _analyze(source, disabled=None):
+    return jaxcheck.analyze_source(textwrap.dedent(source), FIXTURE_PATH, disabled=disabled)
+
+
+@pytest.mark.parametrize("code", sorted(selftest.FIXTURES))
+def test_positive_fixture_fires(code):
+    positive, _ = selftest.FIXTURES[code]
+    assert code in {f.rule for f in _analyze(positive)}
+
+
+@pytest.mark.parametrize("code", sorted(selftest.FIXTURES))
+def test_negative_fixture_is_quiet(code):
+    _, negative = selftest.FIXTURES[code]
+    assert code not in {f.rule for f in _analyze(negative)}
+
+
+@pytest.mark.parametrize("code", sorted(selftest.FIXTURES))
+def test_disabling_the_rule_silences_it(code):
+    positive, _ = selftest.FIXTURES[code]
+    assert code in {f.rule for f in _analyze(positive)}
+    assert code not in {f.rule for f in _analyze(positive, disabled={code})}
+
+
+def test_hot_loop_taint_mode():
+    # float() per loop iteration on a train_fn result fires; the same loop
+    # after a single np.asarray host fetch is quiet — the exact shape of the
+    # ppo/a2c per-update loops
+    assert "JX02" in {f.rule for f in _analyze(selftest.HOT_LOOP_POSITIVE)}
+    assert "JX02" not in {f.rule for f in _analyze(selftest.HOT_LOOP_NEGATIVE)}
+
+
+def test_hot_loop_mode_only_applies_under_algos():
+    findings = jaxcheck.analyze_source(
+        textwrap.dedent(selftest.HOT_LOOP_POSITIVE), "sheeprl_tpu/serve/whatever.py"
+    )
+    assert "JX02" not in {f.rule for f in findings}
+
+
+def test_jit_factory_donation_tracked_across_functions():
+    # donate_argnums declared inside make_train_fn must reach the call site
+    source = """
+    import jax
+
+    def make_train_fn(step):
+        return jax.jit(step, donate_argnums=(0,))
+
+    def main(step, params, batch):
+        train_fn = make_train_fn(step)
+        out = train_fn(params, batch)
+        return params
+    """
+    findings = [f for f in _analyze(source) if f.rule == "JX03"]
+    assert findings and "params" in findings[0].message
+
+
+def test_finding_keys_have_no_line_numbers():
+    positive, _ = selftest.FIXTURES["JX01"]
+    (finding,) = [f for f in _analyze(positive) if f.rule == "JX01"]
+    assert finding.key == f"JX01:{FIXTURE_PATH}::sample"
+    assert str(finding.line) not in finding.key.split("::")[-1]
+
+
+def test_baseline_round_trip_survives_unrelated_edit(tmp_path):
+    positive, _ = selftest.FIXTURES["JX01"]
+    baseline_path = str(tmp_path / "baseline.json")
+    write_baseline(baseline_path, _analyze(positive))
+    # unrelated edit: new header lines shift every line number
+    edited = "# a comment\nHELPER = 1\n\n" + textwrap.dedent(positive)
+    shifted = jaxcheck.analyze_source(edited, FIXTURE_PATH)
+    assert shifted, "fixture still has its finding"
+    new, stale = compare_to_baseline(shifted, load_baseline(baseline_path))
+    assert new == [] and stale == []
+
+
+def test_baseline_catches_second_hazard_in_same_function(tmp_path):
+    positive, _ = selftest.FIXTURES["JX01"]
+    baseline_path = str(tmp_path / "baseline.json")
+    write_baseline(baseline_path, _analyze(positive))
+    worse = textwrap.dedent(positive) + textwrap.dedent(
+        """
+        def another(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.uniform(key, (2,))
+            return a + b
+        """
+    )
+    new, _ = compare_to_baseline(
+        jaxcheck.analyze_source(worse, FIXTURE_PATH), load_baseline(baseline_path)
+    )
+    assert [f.qualname for f in new] == ["another"]
+
+
+def test_baseline_reports_stale_suppressions(tmp_path):
+    _, negative = selftest.FIXTURES["JX01"]
+    baseline_path = str(tmp_path / "baseline.json")
+    write_baseline(baseline_path, _analyze(selftest.FIXTURES["JX01"][0]))
+    new, stale = compare_to_baseline(_analyze(negative), load_baseline(baseline_path))
+    assert new == []
+    assert stale == [f"JX01:{FIXTURE_PATH}::sample"]
+
+
+def test_checked_in_baseline_documents_every_suppression():
+    baseline = load_baseline(os.path.join(REPO, "tools", "jaxcheck_baseline.json"))
+    for key, entry in baseline.items():
+        assert entry.get("note"), f"baseline entry {key} has no justification note"
+
+
+def test_cli_self_test():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.jaxcheck", "--self-test"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_repo_scan_is_clean(tmp_path):
+    """The tier-1 gate: the repo-wide scan + config matrix must exit 0 with
+    only strictly-documented baseline suppressions."""
+    scenarios = tmp_path / "SCENARIOS.json"
+    env = dict(os.environ, SHEEPRL_TPU_SKIP_ALGO_IMPORTS="1")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.jaxcheck", "--json", "--scenarios", str(scenarios)],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["new"] == []
+    assert report["parse_errors"] == []
+    assert report["config"]["fail"] == 0
+    assert report["config"]["cells"] > 100
+    # verdicts folded into the grid file
+    doc = json.loads(scenarios.read_text())
+    assert doc["config_summary"]["pass"] == report["config"]["pass"]
+    assert doc["static_findings"]["new"] == 0
+    assert len(doc["config_cells"]) == report["config"]["cells"]
+
+
+def test_regress_rewrite_preserves_jaxcheck_keys(tmp_path):
+    """tools/regress.py owns SCENARIOS.json's runtime grid; rewriting it must
+    carry the static config_cells/config_summary/static_findings forward."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_regress_under_test", os.path.join(REPO, "tools", "regress.py")
+    )
+    regress = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(regress)
+
+    path = str(tmp_path / "SCENARIOS.json")
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "schema": 1,
+                "config_cells": {"config:exp=x:fabric=cpu": {"verdict": "pass"}},
+                "config_summary": {"cells": 1, "pass": 1, "fail": 0},
+                "static_findings": {"total": 0, "new": 0},
+            },
+            f,
+        )
+    regress.write_scenarios(regress.evaluate([]), path)
+    doc = json.load(open(path))
+    assert doc["config_cells"] == {"config:exp=x:fabric=cpu": {"verdict": "pass"}}
+    assert doc["config_summary"]["pass"] == 1
+    assert doc["static_findings"]["new"] == 0
+    assert "cells" in doc and "summary" in doc  # the regress grid is still there

@@ -119,6 +119,13 @@ def test_recompile_watchdog_counts_deliberate_retraces(telemetry):
     ]
     assert len(post_warm) >= 2
     assert all("dur" in e for e in post_warm)
+    # each post-warm retrace also emits a dedicated `recompile` event naming
+    # the offending function, for cross-referencing against jaxcheck's
+    # static JX05 findings
+    recompile_events = [e for e in _events(telemetry) if e["event"] == "recompile"]
+    assert len(recompile_events) >= 2
+    assert all(e["qualname"] for e in recompile_events)
+    assert recompile_events[-1]["count"] == telemetry.watchdog.recompiles
 
 
 class _FakeLogger:

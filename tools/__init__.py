@@ -1,0 +1,2 @@
+# Repo tooling (stdlib-first): tools.regress (scenario regression gates),
+# tools.jaxcheck (static JAX/TPU hazard analysis + config-matrix validation).

@@ -1,0 +1,133 @@
+"""``python -m tools.jaxcheck`` — the repo's static-analysis gate.
+
+Default run: scan the source tree with rules JX01–JX05, gate findings
+against ``tools/jaxcheck_baseline.json`` (only *new* findings fail), compose
+and validate the full config matrix, fold verdicts into ``SCENARIOS.json``,
+and exit nonzero on any new finding or failed config cell.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from . import (
+    DEFAULT_BASELINE,
+    RULES,
+    compare_to_baseline,
+    configcheck,
+    counts_by_rule,
+    load_baseline,
+    repo_root,
+    scan,
+    write_baseline,
+)
+from .selftest import self_test
+
+import os
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="tools.jaxcheck", description=__doc__.splitlines()[0])
+    parser.add_argument("paths", nargs="*", help="files/dirs to scan (default: the source tree)")
+    parser.add_argument("--baseline", default=None, help=f"suppression file (default: {DEFAULT_BASELINE})")
+    parser.add_argument("--write-baseline", action="store_true", help="rewrite the baseline from this scan")
+    parser.add_argument("--disable", action="append", metavar="CODE", help="disable a rule (repeatable)")
+    parser.add_argument("--json", action="store_true", help="machine-readable report on stdout")
+    parser.add_argument("--self-test", action="store_true", help="run the built-in rule fixtures and exit")
+    parser.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    parser.add_argument("--no-configcheck", action="store_true", help="skip the config-matrix validation")
+    parser.add_argument(
+        "--scenarios",
+        default=None,
+        metavar="PATH",
+        help="SCENARIOS.json to fold config verdicts into (default: <repo>/SCENARIOS.json)",
+    )
+    parser.add_argument("--no-scenarios", action="store_true", help="do not touch SCENARIOS.json")
+    parser.add_argument("-v", "--verbose", action="store_true", help="also list passing config cells")
+    args = parser.parse_args(argv)
+
+    if args.self_test:
+        return self_test()
+    if args.list_rules:
+        for code in sorted(RULES):
+            rule = RULES[code]
+            print(f"{code}  {rule.title}")
+            doc = (rule.__doc__ or "").strip().splitlines()
+            for line in doc:
+                print(f"      {line.strip()}")
+        return 0
+
+    root = repo_root()
+    disabled = set(args.disable or [])
+    findings, files_scanned, parse_errors = scan(args.paths or None, root=root, disabled=disabled)
+
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"jaxcheck: baseline rewritten with {len(findings)} findings -> {baseline_path}")
+    baseline = load_baseline(baseline_path)
+    new, stale = compare_to_baseline(findings, baseline)
+    if args.write_baseline:
+        new, stale = [], []
+
+    config_doc = None
+    if not args.no_configcheck:
+        config_doc = configcheck.run_configcheck()
+        if not args.no_scenarios:
+            scenarios_path = args.scenarios or os.path.join(root, "SCENARIOS.json")
+            configcheck.fold_into_scenarios(
+                scenarios_path,
+                config_doc,
+                static_summary={
+                    "files": files_scanned,
+                    "total": len(findings),
+                    "new": len(new),
+                    "by_rule": counts_by_rule(findings),
+                    "baseline_suppressed": len(findings) - len(new),
+                },
+            )
+
+    failed = bool(new) or bool(parse_errors) or bool(config_doc and config_doc["summary"]["fail"])
+
+    if args.json:
+        report = {
+            "files": files_scanned,
+            "parse_errors": parse_errors,
+            "findings_total": len(findings),
+            "counts_by_rule": counts_by_rule(findings),
+            "baseline_suppressed": len(findings) - len(new),
+            "new": [f.render() for f in new],
+            "stale_baseline": stale,
+            "config": (
+                {"cells": config_doc["cells"], **config_doc["summary"]} if config_doc else None
+            ),
+            "exit": 1 if failed else 0,
+        }
+        json.dump(report, sys.stdout, indent=1)
+        print()
+        return 1 if failed else 0
+
+    for f in new:
+        print(f.render())
+    for path in parse_errors:
+        print(f"PARSE-ERROR {path}")
+    if stale:
+        print(f"note: {len(stale)} stale baseline entries (fixed findings) — rerun --write-baseline to shrink:")
+        for key in stale:
+            print(f"  - {key}")
+    counts = counts_by_rule(findings)
+    summary = ", ".join(f"{k}:{v}" for k, v in counts.items()) or "none"
+    print(
+        f"# jaxcheck: {files_scanned} files, {len(findings)} findings ({summary}), "
+        f"{len(findings) - len(new)} baseline-suppressed, {len(new)} new"
+    )
+    if config_doc is not None:
+        configcheck.render(config_doc, verbose=args.verbose)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,389 @@
+"""Static validation of the full Hydra config space (ROADMAP item 5).
+
+Every scenario-matrix cell — ``exp × fabric``, every ``env`` and every
+``algo`` option riding a carrier exp — is composed through the first-party
+compose API (``sheeprl_tpu/config/compose.py``) without executing any algo
+code: ``SHEEPRL_TPU_SKIP_ALGO_IMPORTS=1`` keeps the import jax-free, so the
+whole matrix (~200 cells) checks in about a second on any box.
+
+Per cell:
+
+* **compose** — defaults lists, overrides, ``${...}`` interpolations all
+  resolve.  Mandatory ``???`` values are auto-stubbed (the stubbed keys are
+  recorded in the cell verdict) so a cell that only *requires a CLI arg* is
+  distinguished from one that is actually broken.
+* **invariants** — required keys present and positive
+  (``algo.per_rank_batch_size``, ``env.num_envs``, …), ``fabric.mesh_shape``
+  consistent with ``fabric.mesh_axes``/``fabric.devices``, and the
+  rollout/batch divisibility algebra of ``elastic_per_rank_batch_size``
+  checked against the 1-chip and 8-chip topologies (non-dividing global
+  batches are *violations*; dropped-sample remainders are *warnings*,
+  matching the runtime's behaviour of raising vs warning).
+
+Verdicts fold into the PR-7 ``SCENARIOS.json`` grid under ``config_cells`` /
+``config_summary`` so the static matrix and the runtime regression grid live
+in one document.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+SCHEMA_VERSION = 1
+DEFAULT_TOPOLOGIES = (1, 8)
+_QUOTED = re.compile(r"'([^']+)'")
+_MAX_STUBS = 24
+
+
+def _compose_api():
+    """Import the compose module with algo imports (and therefore jax) gated
+    off — configcheck must run on a box with no accelerator stack at all.
+    The gate env var is only read at ``sheeprl_tpu/__init__`` import time, so
+    it is set just around the import and restored (no env leak into the
+    calling process)."""
+    import importlib
+
+    prev = os.environ.get("SHEEPRL_TPU_SKIP_ALGO_IMPORTS")
+    os.environ["SHEEPRL_TPU_SKIP_ALGO_IMPORTS"] = prev or "1"
+    try:
+        # sheeprl_tpu.config re-exports compose() the *function*; we need the module
+        return importlib.import_module("sheeprl_tpu.config.compose")
+    finally:
+        if prev is None:
+            os.environ.pop("SHEEPRL_TPU_SKIP_ALGO_IMPORTS", None)
+        else:
+            os.environ["SHEEPRL_TPU_SKIP_ALGO_IMPORTS"] = prev
+
+
+# ----------------------------------------------------------------- matrix ----
+
+
+def list_groups(search_path: Optional[Sequence[str]] = None) -> Dict[str, List[str]]:
+    api = _compose_api()
+    return {
+        group: [o for o in api.group_options(group, search_path) if o != "default"]
+        for group in ("exp", "env", "algo", "fabric")
+    }
+
+
+def carrier_exp(algo: str, exps: Sequence[str]) -> Optional[str]:
+    """The exp config that exercises an algo option: exact name first, then
+    the longest exp that is a prefix (``dreamer_v3_XS`` rides ``dreamer_v3``),
+    then the alphabetically-first exp extending the algo name (``p2e_dv1``
+    rides ``p2e_dv1_exploration``, not ``_finetuning`` — the phase-1 exp
+    composes without a checkpoint stub)."""
+    if algo in exps:
+        return algo
+    prefixes = [e for e in exps if algo.startswith(e)]
+    if prefixes:
+        return max(prefixes, key=len)
+    extensions = [e for e in exps if e.startswith(algo)]
+    if extensions:
+        return min(extensions)
+    return None
+
+
+def build_matrix(search_path: Optional[Sequence[str]] = None) -> List[Dict[str, Any]]:
+    """Every cell of the static scenario matrix: the primary ``exp × fabric``
+    grid, plus env and algo sweeps riding carrier exps."""
+    groups = list_groups(search_path)
+    fabrics = [f for f in ("cpu", "tpu") if f in groups["fabric"]] or groups["fabric"]
+    cells: List[Dict[str, Any]] = []
+    for exp in groups["exp"]:
+        for fab in fabrics:
+            cells.append(
+                {
+                    "key": f"config:exp={exp}:fabric={fab}",
+                    "overrides": [f"exp={exp}", f"fabric={fab}"],
+                }
+            )
+    env_carrier = "ppo" if "ppo" in groups["exp"] else (groups["exp"][0] if groups["exp"] else None)
+    if env_carrier:
+        for env in groups["env"]:
+            cells.append(
+                {
+                    "key": f"config:env={env}:exp={env_carrier}",
+                    "overrides": [f"exp={env_carrier}", f"env={env}"],
+                }
+            )
+    for algo in groups["algo"]:
+        carrier = carrier_exp(algo, groups["exp"])
+        if carrier is None:
+            cells.append(
+                {
+                    "key": f"config:algo={algo}",
+                    "overrides": None,
+                    "error": f"no carrier exp found for algo option {algo!r}",
+                }
+            )
+            continue
+        cells.append(
+            {
+                "key": f"config:algo={algo}:exp={carrier}",
+                "overrides": [f"exp={carrier}", f"algo={algo}"],
+            }
+        )
+    return cells
+
+
+# ---------------------------------------------------------------- compose ----
+
+
+def _stub_value(key: str) -> Any:
+    """A type-plausible stand-in for a mandatory ``???`` value, good enough
+    for interpolation and invariant checking."""
+    leaf = key.rsplit(".", 1)[-1].lower()
+    if any(tok in leaf for tok in ("path", "dir", "ckpt", "file")):
+        return "/dev/null"
+    if leaf in ("wrapper",):
+        return {}
+    if any(tok in leaf for tok in ("steps", "size", "length", "envs", "every", "freq", "iters")):
+        return 1
+    if leaf in ("lr", "gamma", "tau", "seed") or leaf.endswith(("_lr", "_rate", "_coef")):
+        return 1
+    return "stub"
+
+
+def compose_cell(
+    overrides: Sequence[str],
+    search_path: Optional[Sequence[str]] = None,
+) -> Tuple[Optional[Dict[str, Any]], Dict[str, Any], Optional[str]]:
+    """Compose one cell, auto-stubbing mandatory values.
+
+    Returns ``(cfg, stubbed, error)`` — ``cfg`` is None on a genuine
+    composition error (unresolvable interpolation, unknown option, a
+    mandatory *group* choice, or a stub loop that does not converge)."""
+    api = _compose_api()
+    stubbed: Dict[str, Any] = {}
+    ovs = list(overrides)
+    for _ in range(_MAX_STUBS):
+        try:
+            cfg = api.compose("config", ovs, search_path=search_path)
+            return dict(cfg), stubbed, None
+        except api.MissingMandatoryValue as e:
+            msg = str(e)
+            m = _QUOTED.search(msg)
+            if not m:
+                return None, stubbed, msg
+            token = m.group(1)
+            if token.endswith("=<option>"):
+                # a mandatory *group* selection can't be stubbed with a value
+                return None, stubbed, msg
+            if token in stubbed:
+                return None, stubbed, f"stub for {token!r} did not satisfy compose: {msg}"
+            value = _stub_value(token)
+            stubbed[token] = value
+            ovs = ovs + [f"{token}={json.dumps(value) if isinstance(value, dict) else value}"]
+        except api.ConfigCompositionError as e:
+            return None, stubbed, str(e)
+    return None, stubbed, f"gave up after stubbing {_MAX_STUBS} mandatory values"
+
+
+# -------------------------------------------------------------- invariants ----
+
+
+def _get(cfg: Dict[str, Any], dotted: str) -> Any:
+    node: Any = cfg
+    for part in dotted.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+def check_invariants(
+    cfg: Dict[str, Any], topologies: Sequence[int] = DEFAULT_TOPOLOGIES
+) -> Tuple[List[str], List[str]]:
+    """Structural checks a composed cell must satisfy before it is worth a
+    chip window.  Returns (violations, warnings)."""
+    violations: List[str] = []
+    warnings: List[str] = []
+
+    for key in ("algo.name", "env.id", "fabric.accelerator"):
+        value = _get(cfg, key)
+        if not isinstance(value, str) or not value:
+            violations.append(f"required key {key!r} missing or empty")
+    for key in ("algo.per_rank_batch_size", "env.num_envs", "algo.total_steps"):
+        value = _get(cfg, key)
+        if value is None:
+            violations.append(f"required key {key!r} missing")
+        elif not isinstance(value, (int, float)) or value <= 0:
+            violations.append(f"{key}={value!r} must be a positive number")
+
+    mesh_shape = _get(cfg, "fabric.mesh_shape")
+    mesh_axes = _get(cfg, "fabric.mesh_axes")
+    if mesh_shape is not None:
+        if not isinstance(mesh_shape, (list, tuple)):
+            violations.append(f"fabric.mesh_shape={mesh_shape!r} must be null or a list")
+        else:
+            if isinstance(mesh_axes, (list, tuple)) and len(mesh_shape) != len(mesh_axes):
+                violations.append(
+                    f"fabric.mesh_shape has {len(mesh_shape)} dims but fabric.mesh_axes "
+                    f"names {len(mesh_axes)} axes"
+                )
+            devices = _get(cfg, "fabric.devices")
+            if isinstance(devices, int) and mesh_shape:
+                product = 1
+                for d in mesh_shape:
+                    product *= int(d)
+                if product != devices:
+                    violations.append(
+                        f"prod(fabric.mesh_shape)={product} != fabric.devices={devices}"
+                    )
+
+    # rollout/batch divisibility algebra (on-policy family), mirroring
+    # utils/checkpoint.py:elastic_per_rank_batch_size and ppo's runtime checks
+    rollout_steps = _get(cfg, "algo.rollout_steps")
+    num_envs = _get(cfg, "env.num_envs")
+    batch = _get(cfg, "algo.per_rank_batch_size")
+    if isinstance(rollout_steps, int) and isinstance(num_envs, int) and rollout_steps > 0 and num_envs > 0:
+        buffer_size = _get(cfg, "buffer.size")
+        if isinstance(buffer_size, int) and buffer_size < rollout_steps:
+            violations.append(f"buffer.size={buffer_size} < algo.rollout_steps={rollout_steps}")
+        n_global = rollout_steps * num_envs
+        # a topology the cell actually pins (fabric.devices int, or a mesh
+        # shape) must divide — that run would raise in
+        # elastic_per_rank_batch_size.  The remaining probe topologies are
+        # elasticity advisories: the cell runs today, but could not resume
+        # there, so non-divisibility is a warning.
+        required = {1}
+        devices = _get(cfg, "fabric.devices")
+        if isinstance(devices, int) and devices > 0:
+            required.add(devices)
+        if isinstance(mesh_shape, (list, tuple)) and mesh_shape:
+            product = 1
+            for d in mesh_shape:
+                product *= int(d)
+            required.add(product)
+        for d in sorted(set(topologies) | required):
+            sink = violations if d in required else warnings
+            if n_global % d:
+                sink.append(
+                    f"rollout batch {n_global} (= {rollout_steps} steps × {num_envs} envs) "
+                    f"does not divide over a {d}-device data axis"
+                )
+                continue
+            per_device = n_global // d
+            if isinstance(batch, int) and batch > 0:
+                if per_device < batch:
+                    sink.append(
+                        f"per-device rollout {per_device} < per_rank_batch_size {batch} "
+                        f"on a {d}-device data axis (zero minibatches)"
+                    )
+                elif per_device % batch:
+                    warnings.append(
+                        f"per-device rollout {per_device} % per_rank_batch_size {batch} != 0 "
+                        f"on a {d}-device data axis ({per_device % batch} samples dropped)"
+                    )
+    return violations, warnings
+
+
+# ------------------------------------------------------------------- runs ----
+
+
+def run_configcheck(
+    search_path: Optional[Sequence[str]] = None,
+    topologies: Sequence[int] = DEFAULT_TOPOLOGIES,
+) -> Dict[str, Any]:
+    """Compose + validate every matrix cell.  Returns the configcheck doc."""
+    cells = build_matrix(search_path)
+    grid: Dict[str, Any] = {}
+    counts = {"pass": 0, "fail": 0}
+    stubbed_cells = 0
+    warning_total = 0
+    for cell in cells:
+        if cell.get("overrides") is None:
+            grid[cell["key"]] = {"verdict": "fail", "error": cell.get("error")}
+            counts["fail"] += 1
+            continue
+        cfg, stubbed, error = compose_cell(cell["overrides"], search_path)
+        if cfg is None:
+            grid[cell["key"]] = {
+                "verdict": "fail",
+                "overrides": cell["overrides"],
+                "stubbed": stubbed,
+                "error": error,
+            }
+            counts["fail"] += 1
+            continue
+        violations, warns = check_invariants(cfg, topologies)
+        verdict = "fail" if violations else "pass"
+        counts[verdict] += 1
+        if stubbed:
+            stubbed_cells += 1
+        warning_total += len(warns)
+        entry: Dict[str, Any] = {"verdict": verdict, "overrides": cell["overrides"]}
+        if stubbed:
+            entry["stubbed"] = stubbed
+        if violations:
+            entry["violations"] = violations
+        if warns:
+            entry["warnings"] = warns
+        grid[cell["key"]] = entry
+    return {
+        "schema": SCHEMA_VERSION,
+        "topologies": list(topologies),
+        "cells": len(cells),
+        "summary": {
+            "pass": counts["pass"],
+            "fail": counts["fail"],
+            "stubbed_cells": stubbed_cells,
+            "warnings": warning_total,
+        },
+        "grid": grid,
+    }
+
+
+def fold_into_scenarios(
+    path: str,
+    config_doc: Dict[str, Any],
+    static_summary: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Merge configcheck verdicts (and the rule-engine summary) into the
+    SCENARIOS.json grid, preserving whatever the regression gate wrote."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            doc = {}
+    except (OSError, ValueError):
+        doc = {"schema": SCHEMA_VERSION}
+    doc["config_cells"] = config_doc["grid"]
+    doc["config_summary"] = {
+        "cells": config_doc["cells"],
+        "topologies": config_doc["topologies"],
+        **config_doc["summary"],
+    }
+    if static_summary is not None:
+        doc["static_findings"] = static_summary
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, default=str)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def render(doc: Dict[str, Any], verbose: bool = False, stream=None) -> None:
+    import sys
+
+    stream = stream or sys.stdout
+    for key, cell in doc["grid"].items():
+        if cell["verdict"] == "fail":
+            print(f"FAIL {key}", file=stream)
+            for v in cell.get("violations", []):
+                print(f"        {v}", file=stream)
+            if cell.get("error"):
+                print(f"        {cell['error']}", file=stream)
+        elif verbose:
+            mark = "PASS" + ("*" if cell.get("stubbed") else " ")
+            print(f"{mark} {key}", file=stream)
+    s = doc["summary"]
+    print(
+        f"# configcheck: {doc['cells']} cells — {s['pass']} pass, {s['fail']} fail "
+        f"({s['stubbed_cells']} needed CLI stubs, {s['warnings']} divisibility warnings) "
+        f"over topologies {doc['topologies']}",
+        file=stream,
+    )

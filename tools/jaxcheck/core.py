@@ -1,0 +1,329 @@
+"""Analysis substrate shared by the jaxcheck rules.
+
+Everything here is stdlib-``ast``: no jax import, no execution. A module is
+parsed once into a :class:`ModuleInfo` that pre-computes the facts every rule
+needs — parent links, function qualnames, which functions are *traced*
+(jit-decorated, jit/shard_map-wrapped, or ``lax.scan``/``while_loop``/``cond``
+bodies) and which module-level functions are *jit factories* (they return a
+``jax.jit(...)`` result, optionally with ``donate_argnums``) so call sites of
+``train_fn = make_train_fn(...)`` inherit tracing/donation facts across the
+factory boundary.
+
+Findings are keyed by ``rule:path::qualname`` (never by line number) so a
+baseline suppression survives unrelated edits to the same file.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+BASELINE_SCHEMA = 1
+
+# call-name suffixes that wrap a python function into a traced/compiled one
+JIT_SUFFIXES = {"jit", "pjit"}
+SHARD_MAP_SUFFIXES = {"shard_map"}
+# lax control-flow primitives whose function arguments are traced.  "map" is
+# deliberately absent: ``jax.tree.map`` / ``tree_util.tree_map`` callbacks run
+# as plain python, and they vastly outnumber ``lax.map`` in this codebase.
+TRACED_ARG_CALLS = {"scan", "while_loop", "fori_loop", "cond", "switch", "associative_scan"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative posix path
+    qualname: str  # dotted function path within the module ("<module>" for top level)
+    line: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Baseline key: stable across unrelated edits (no line number)."""
+        return f"{self.rule}:{self.path}::{self.qualname}"
+
+    def render(self) -> str:
+        return f"{self.rule} {self.path}:{self.line} [{self.qualname}] {self.message}"
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.random.split`` for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_part(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def is_jit_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and last_part(dotted_name(node.func)) in JIT_SUFFIXES
+
+
+def _const_int_set(node: ast.AST) -> Optional[Set[int]]:
+    """donate_argnums literal -> set of ints (int or tuple/list of ints)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) and not isinstance(node.value, bool):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant) and isinstance(elt.value, int)):
+                return None
+            out.add(elt.value)
+        return out
+    return None
+
+
+def _const_str_set(node: ast.AST) -> Optional[Set[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant) and isinstance(elt.value, str)):
+                return None
+            out.add(elt.value)
+        return out
+    return None
+
+
+@dataclass
+class DonationSpec:
+    argnums: Set[int]
+    argnames: Set[str]
+
+    def __bool__(self) -> bool:
+        return bool(self.argnums or self.argnames)
+
+
+def jit_donation(call: ast.Call) -> DonationSpec:
+    spec = DonationSpec(set(), set())
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            spec.argnums |= _const_int_set(kw.value) or set()
+        elif kw.arg == "donate_argnames":
+            spec.argnames |= _const_str_set(kw.value) or set()
+    return spec
+
+
+FuncNode = Any  # ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda | ast.Module
+
+
+class ModuleInfo:
+    """One parsed module plus the cross-rule pre-pass facts."""
+
+    def __init__(self, tree: ast.Module, path: str) -> None:
+        self.tree = tree
+        self.path = path
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+
+        # (node, qualname) for the module scope and every def, outermost first
+        self.functions: List[Tuple[FuncNode, str]] = [(tree, "<module>")]
+        self._collect_functions(tree, prefix="")
+        self._by_name: Dict[str, List[FuncNode]] = {}
+        for node, qual in self.functions[1:]:
+            if not isinstance(node, ast.Lambda):
+                self._by_name.setdefault(node.name, []).append(node)
+
+        self.traced: Set[ast.AST] = set()
+        # function name -> donation union over its returned jax.jit(...) calls;
+        # presence alone marks a *jit factory*
+        self.factories: Dict[str, DonationSpec] = {}
+        self._pre_pass()
+
+    # ------------------------------------------------------------- pre-pass --
+
+    def _collect_functions(self, node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                self.functions.append((child, qual))
+                self._collect_functions(child, prefix=f"{qual}.")
+            elif isinstance(child, ast.ClassDef):
+                self._collect_functions(child, prefix=f"{prefix}{child.name}.")
+            else:
+                self._collect_functions(child, prefix=prefix)
+
+    def _pre_pass(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if last_part(dotted_name(target)) in JIT_SUFFIXES | SHARD_MAP_SUFFIXES:
+                        self.traced.add(node)
+                # jit factory: any return statement wrapping jax.jit(...)
+                spec = DonationSpec(set(), set())
+                is_factory = False
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Return) and sub.value is not None:
+                        for call in ast.walk(sub.value):
+                            if is_jit_call(call):
+                                is_factory = True
+                                d = jit_donation(call)
+                                spec.argnums |= d.argnums
+                                spec.argnames |= d.argnames
+                if is_factory:
+                    self.factories[node.name] = spec
+            if isinstance(node, ast.Call):
+                suffix = last_part(dotted_name(node.func))
+                fn_args: List[ast.AST] = []
+                if suffix in JIT_SUFFIXES | SHARD_MAP_SUFFIXES and node.args:
+                    fn_args = [node.args[0]]
+                elif suffix in TRACED_ARG_CALLS:
+                    # scan/while_loop/fori_loop/cond take one or more fn args
+                    fn_args = list(node.args[:3])
+                for arg in fn_args:
+                    if isinstance(arg, ast.Lambda):
+                        self.traced.add(arg)
+                    elif isinstance(arg, ast.Name):
+                        for fdef in self._by_name.get(arg.id, []):
+                            self.traced.add(fdef)
+
+    # -------------------------------------------------------------- queries --
+
+    def qualname_of(self, node: ast.AST) -> str:
+        for fnode, qual in self.functions:
+            if fnode is node:
+                return qual
+        return "<module>"
+
+    def enclosing_function(self, node: ast.AST) -> FuncNode:
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            cur = self.parents.get(cur)
+        return cur if cur is not None else self.tree
+
+    def is_traced(self, node: ast.AST) -> bool:
+        """Traced directly, or lexically nested inside a traced function."""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if cur in self.traced:
+                return True
+            cur = self.parents.get(cur)
+        return False
+
+    def in_loop(self, node: ast.AST) -> bool:
+        """Inside a For/While body of the *same* function scope."""
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+                return True
+            cur = self.parents.get(cur)
+        return False
+
+    def own_statements(self, scope: FuncNode) -> Iterator[ast.stmt]:
+        """Statements of a scope in source order, recursing into compound
+        statements but NOT into nested function/class definitions (those are
+        separate scopes analysed on their own)."""
+        body = scope.body if not isinstance(scope, ast.Lambda) else []
+        yield from self._walk_stmts(body)
+
+    def _walk_stmts(self, body: Sequence[ast.stmt]) -> Iterator[ast.stmt]:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            yield stmt
+            for field in ("body", "orelse", "finalbody"):
+                inner = getattr(stmt, field, None)
+                if inner:
+                    yield from self._walk_stmts(inner)
+            for handler in getattr(stmt, "handlers", []) or []:
+                yield from self._walk_stmts(handler.body)
+
+
+def stmt_exprs(stmt: ast.stmt) -> Iterator[ast.expr]:
+    """Expression nodes directly owned by one statement — NOT the nested
+    statement bodies (a linearized-statement walk visits those on their own,
+    so walking whole compound statements would double-count)."""
+    for _field, value in ast.iter_fields(stmt):
+        if isinstance(value, ast.expr):
+            yield value
+        elif isinstance(value, list):
+            for v in value:
+                if isinstance(v, ast.expr):
+                    yield v
+                elif isinstance(v, ast.withitem):
+                    yield v.context_expr
+                    if v.optional_vars is not None:
+                        yield v.optional_vars
+
+
+def walk_exprs(stmt: ast.stmt, include_lambda: bool = True) -> Iterator[ast.AST]:
+    """Walk the expressions of one statement (see :func:`stmt_exprs`).
+    ``include_lambda=False`` skips lambda bodies — deferred code, not part of
+    the statement's own evaluation."""
+    stack: List[ast.AST] = list(stmt_exprs(stmt))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not include_lambda and isinstance(node, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ----------------------------------------------------------------- baseline --
+
+
+def load_baseline(path: str) -> Dict[str, Dict[str, Any]]:
+    """``key -> {"count": n, "note": str}``; tolerant of a missing file."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(doc, dict) or int(doc.get("schema", 1) or 1) > BASELINE_SCHEMA:
+        return {}
+    sup = doc.get("suppressions")
+    return {str(k): dict(v) for k, v in sup.items()} if isinstance(sup, dict) else {}
+
+
+def write_baseline(path: str, findings: Sequence[Finding], notes: Optional[Dict[str, str]] = None) -> None:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    existing = load_baseline(path)
+    doc = {
+        "schema": BASELINE_SCHEMA,
+        "generated_by": "python -m tools.jaxcheck --write-baseline",
+        "suppressions": {
+            key: {
+                "count": n,
+                "note": (notes or {}).get(key) or existing.get(key, {}).get("note", ""),
+            }
+            for key, n in sorted(counts.items())
+        },
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def compare_to_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, Dict[str, Any]]
+) -> Tuple[List[Finding], List[str]]:
+    """Returns (new findings beyond the suppressed counts, stale baseline keys
+    whose findings no longer occur — shrink the file)."""
+    grouped: Dict[str, List[Finding]] = {}
+    for f in findings:
+        grouped.setdefault(f.key, []).append(f)
+    new: List[Finding] = []
+    for key, group in sorted(grouped.items()):
+        allowed = int(baseline.get(key, {}).get("count", 0) or 0)
+        if len(group) > allowed:
+            new.extend(sorted(group, key=lambda f: f.line)[allowed:])
+    stale = sorted(k for k in baseline if k not in grouped)
+    return new, stale

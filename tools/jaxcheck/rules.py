@@ -1,0 +1,608 @@
+"""The jaxcheck rule registry: JX01–JX05.
+
+| code | hazard                                                        |
+|------|---------------------------------------------------------------|
+| JX01 | PRNG key reuse — a key consumed by two samplers without an    |
+|      | interleaving ``split``/``fold_in`` reassignment               |
+| JX02 | host sync in a hot path — ``.item()``/``float()``/``bool()``/ |
+|      | ``np.asarray``/``device_get`` inside traced code, or on a     |
+|      | device-origin value inside an ``algos/*`` per-update loop     |
+| JX03 | use-after-donate — args passed to a ``donate_argnums`` jit    |
+|      | and referenced afterwards without reassignment                |
+| JX04 | Python ``if``/``while`` on tracer-derived values inside       |
+|      | jitted/scanned functions                                      |
+| JX05 | retrace hazard — ``jax.jit`` inside a loop body, or an        |
+|      | immediately-invoked ``jax.jit(f)(...)`` wrapper               |
+
+Every rule deliberately under-approximates: it only fires on patterns it can
+prove locally (straight-line data flow inside one function, plus the
+jit-factory pre-pass in :mod:`tools.jaxcheck.core`), so a finding is worth
+reading.  Soundness is the runtime watchdog's job; this is the cheap,
+hardware-free first line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from .core import (
+    DonationSpec,
+    Finding,
+    FuncNode,
+    ModuleInfo,
+    dotted_name,
+    is_jit_call,
+    jit_donation,
+    last_part,
+    walk_exprs,
+    JIT_SUFFIXES,
+    SHARD_MAP_SUFFIXES,
+)
+
+
+class Rule:
+    code = "JX00"
+    title = "abstract rule"
+
+    def run(self, info: ModuleInfo) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, info: ModuleInfo, qual: str, node: ast.AST, message: str) -> Finding:
+        return Finding(self.code, info.path, qual, getattr(node, "lineno", 0), message)
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    RULES[cls.code] = cls()
+    return cls
+
+
+def _assign_target_names(stmt: ast.stmt) -> List[str]:
+    """Plain-Name targets of an Assign/AugAssign/AnnAssign/for-loop binding."""
+    out: List[str] = []
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    for t in targets:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            out.extend(e.id for e in t.elts if isinstance(e, ast.Name))
+    return out
+
+
+def _uses_any(expr: ast.AST, names: Set[str]) -> bool:
+    """True when any Load of a name in ``names`` appears in the expression."""
+    return any(
+        isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) and n.id in names
+        for n in ast.walk(expr)
+    )
+
+
+def _param_names(scope: FuncNode) -> List[str]:
+    if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return []
+    a = scope.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+# ---------------------------------------------------------------------- JX01 --
+
+
+@register
+class PRNGKeyReuse(Rule):
+    """A key variable consumed by two ``jax.random`` samplers without an
+    interleaving ``split``/``fold_in``: both draws return identical bits."""
+
+    code = "JX01"
+    title = "PRNG key reuse"
+
+    # jax.random attributes that do NOT consume a key's entropy budget
+    NON_CONSUMING = {
+        "split", "fold_in", "PRNGKey", "key", "key_data", "wrap_key_data",
+        "clone", "key_impl", "default_prng_impl",
+    }
+    PRODUCERS = {"split", "fold_in", "PRNGKey", "key", "clone", "wrap_key_data"}
+    KEY_PARAM_HINTS = ("key", "rng")
+
+    def run(self, info: ModuleInfo) -> Iterator[Finding]:
+        for scope, qual in info.functions:
+            state: Dict[str, str] = {}  # name -> "fresh" | "used"
+            for p in _param_names(scope):
+                low = p.lower()
+                if low == "key" or low.endswith("_key") or low.startswith("rng"):
+                    state[p] = "fresh"
+            body = [] if isinstance(scope, ast.Lambda) else scope.body
+            seen: Set[Tuple[int, str]] = set()
+            findings: List[Finding] = []
+            self._scan(info, qual, body, state, seen, findings)
+            yield from findings
+
+    def _is_random_call(self, call: ast.Call) -> Optional[str]:
+        """Return the jax.random function name if this call is one."""
+        name = dotted_name(call.func)
+        if not name:
+            return None
+        parts = name.split(".")
+        tail = parts[-1]
+        # jax.random.normal / random.normal / jrandom.normal / jr.normal
+        if len(parts) >= 2 and parts[-2] in ("random",):
+            return tail
+        if len(parts) == 2 and parts[0] in ("jrandom", "jr"):
+            return tail
+        return None
+
+    def _scan(
+        self,
+        info: ModuleInfo,
+        qual: str,
+        body: List[ast.stmt],
+        state: Dict[str, str],
+        seen: Set[Tuple[int, str]],
+        findings: List[Finding],
+    ) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            # evaluate the expressions owned by this statement head
+            for expr in self._head_exprs(stmt):
+                self._consume(info, qual, expr, state, seen, findings)
+            # producer/killer bookkeeping for assignments
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = stmt.value
+                produced = False
+                if isinstance(value, ast.Call):
+                    fn = self._is_random_call(value)
+                    if fn in self.PRODUCERS:
+                        produced = True
+                for name in _assign_target_names(stmt):
+                    if produced:
+                        state[name] = "fresh"
+                    else:
+                        state.pop(name, None)
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                # two passes over the loop body: the second simulates the next
+                # iteration, catching keys consumed once per iteration without
+                # an in-loop split/fold_in
+                inner = dict(state)
+                for _ in range(2):
+                    self._scan(info, qual, stmt.body, inner, seen, findings)
+                self._scan(info, qual, stmt.orelse, dict(state), seen, findings)
+            elif isinstance(stmt, ast.If):
+                self._scan(info, qual, stmt.body, dict(state), seen, findings)
+                self._scan(info, qual, stmt.orelse, dict(state), seen, findings)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._scan(info, qual, stmt.body, state, seen, findings)
+            elif isinstance(stmt, ast.Try):
+                self._scan(info, qual, stmt.body, dict(state), seen, findings)
+                for handler in stmt.handlers:
+                    self._scan(info, qual, handler.body, dict(state), seen, findings)
+                self._scan(info, qual, stmt.orelse, dict(state), seen, findings)
+                self._scan(info, qual, stmt.finalbody, dict(state), seen, findings)
+
+    def _head_exprs(self, stmt: ast.stmt) -> List[ast.AST]:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)) and stmt.value is not None:
+            return [stmt.value]
+        if isinstance(stmt, ast.Expr):
+            return [stmt.value]
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            return [stmt.value]
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return [stmt.iter]
+        if isinstance(stmt, (ast.While, ast.If)):
+            return [stmt.test]
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return [item.context_expr for item in stmt.items]
+        return []
+
+    def _consume(
+        self,
+        info: ModuleInfo,
+        qual: str,
+        expr: ast.AST,
+        state: Dict[str, str],
+        seen: Set[Tuple[int, str]],
+        findings: List[Finding],
+    ) -> None:
+        for call in ast.walk(expr):
+            if not isinstance(call, ast.Call):
+                continue
+            fn = self._is_random_call(call)
+            if fn is None or fn in self.NON_CONSUMING:
+                continue
+            key_arg: Optional[ast.Name] = None
+            if call.args and isinstance(call.args[0], ast.Name):
+                key_arg = call.args[0]
+            else:
+                for kw in call.keywords:
+                    if kw.arg == "key" and isinstance(kw.value, ast.Name):
+                        key_arg = kw.value
+            if key_arg is None:
+                continue
+            name = key_arg.id
+            if state.get(name) == "used":
+                mark = (call.lineno, name)
+                if mark not in seen:
+                    seen.add(mark)
+                    findings.append(
+                        self.finding(
+                            info,
+                            qual,
+                            call,
+                            f"PRNG key '{name}' reused by jax.random.{fn} without an "
+                            f"interleaving split/fold_in — both draws return identical bits",
+                        )
+                    )
+            elif name in state:
+                state[name] = "used"
+
+
+# ---------------------------------------------------------------------- JX02 --
+
+
+@register
+class HostSyncInHotPath(Rule):
+    """Host transfers stall the accelerator pipeline.  Two modes:
+
+    *in-trace* — any host-materialising call inside a traced function is at
+    best a silent ``concrete value`` error factory and at worst a per-trace
+    constant burn; flagged unconditionally.
+
+    *hot-loop* (``algos/`` files only) — a value returned by a jitted train
+    step is device-resident; ``float()``/``.item()`` on it inside the
+    per-update loop is one blocking transfer per scalar.  Fetch once with
+    ``np.asarray``/``jax.device_get`` and index the host copy.
+    """
+
+    code = "JX02"
+    title = "host sync in hot path"
+
+    SYNC_CALLS = {"asarray", "array", "device_get", "block_until_ready"}
+    SYNC_PREFIXES = {"np", "numpy", "onp", "jax"}
+    CASTS = {"float", "int", "bool"}
+    SYNC_METHODS = {"item", "tolist"}
+
+    def run(self, info: ModuleInfo) -> Iterator[Finding]:
+        for scope, qual in info.functions:
+            if isinstance(scope, ast.Module):
+                continue
+            if info.is_traced(scope):
+                yield from self._in_trace(info, scope, qual)
+        if "/algos/" in info.path or info.path.startswith("algos/"):
+            for scope, qual in info.functions:
+                if isinstance(scope, ast.Module) or info.is_traced(scope):
+                    continue
+                yield from self._hot_loop(info, scope, qual)
+
+    # -- mode A: host-materialising a *tracer* inside traced code -------------
+    #
+    # taint = the traced function's own parameters plus anything assigned from
+    # them; ``int(closure_constant)`` (e.g. a ``lax.scan`` length from config)
+    # is legal and common, so un-tainted casts never fire.
+
+    def _in_trace(self, info: ModuleInfo, scope: FuncNode, qual: str) -> Iterator[Finding]:
+        tainted = set(_param_names(scope))
+        for stmt in info.own_statements(scope):
+            for node in walk_exprs(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = self._sync_call_msg(node, tainted)
+                if msg:
+                    yield self.finding(info, qual, node, msg + " inside traced code — traced "
+                                       "values have no concrete data; this either raises a "
+                                       "TracerError or silently constant-folds per trace")
+            if isinstance(stmt, ast.Assign) and _uses_any(stmt.value, tainted):
+                for name in _assign_target_names(stmt):
+                    tainted.add(name)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)) and _uses_any(stmt.iter, tainted):
+                for name in _assign_target_names(stmt):
+                    tainted.add(name)
+
+    def _sync_call_msg(self, call: ast.Call, tainted: Set[str]) -> Optional[str]:
+        name = dotted_name(call.func)
+        if isinstance(call.func, ast.Attribute) and call.func.attr in self.SYNC_METHODS:
+            if _uses_any(call.func.value, tainted):
+                return f".{call.func.attr}() host sync"
+        if name:
+            parts = name.split(".")
+            if (
+                parts[-1] in self.SYNC_CALLS
+                and (len(parts) == 1 or parts[0] in self.SYNC_PREFIXES)
+                and any(_uses_any(a, tainted) for a in call.args)
+            ):
+                return f"{name}() host materialisation"
+            if len(parts) == 1 and parts[0] in self.CASTS and call.args:
+                if isinstance(call.args[0], (ast.Name, ast.Subscript)) and _uses_any(call.args[0], tainted):
+                    return f"{parts[0]}() cast (host sync)"
+        return None
+
+    # -- mode B: device-origin taint in algos per-update loops ----------------
+
+    def _hot_loop(self, info: ModuleInfo, scope: FuncNode, qual: str) -> Iterator[Finding]:
+        jit_names = self._jit_callables(info, scope)
+        if not jit_names:
+            return
+        tainted: Set[str] = set()
+        for stmt in info.own_statements(scope):
+            # sinks first: the RHS is evaluated before the target is rebound
+            if info.in_loop(stmt):
+                for node in walk_exprs(stmt):
+                    if isinstance(node, ast.Call):
+                        hit = self._sink(node, tainted)
+                        if hit:
+                            yield self.finding(
+                                info, qual, node,
+                                f"{hit} forces a device→host transfer per loop iteration; "
+                                f"fetch the metrics once with np.asarray/jax.device_get and "
+                                f"index the host copy",
+                            )
+            self._propagate(stmt, jit_names, tainted)
+
+    def _jit_callables(self, info: ModuleInfo, scope: FuncNode) -> Set[str]:
+        names: Set[str] = set()
+        for stmt in info.own_statements(scope):
+            if not isinstance(stmt, ast.Assign) or not isinstance(stmt.value, ast.Call):
+                continue
+            call = stmt.value
+            callee = last_part(dotted_name(call.func))
+            if is_jit_call(call) or callee in SHARD_MAP_SUFFIXES or callee in info.factories:
+                names.update(_assign_target_names(stmt))
+        return names
+
+    def _propagate(self, stmt: ast.stmt, jit_names: Set[str], tainted: Set[str]) -> None:
+        if not isinstance(stmt, ast.Assign):
+            return
+        value = stmt.value
+        targets = _assign_target_names(stmt)
+        if not targets:
+            return
+        taints = False
+        if isinstance(value, ast.Call):
+            callee = dotted_name(value.func)
+            tail = last_part(callee)
+            if tail and tail in jit_names or (callee and callee in jit_names):
+                taints = True
+            elif tail == "block_until_ready" and any(
+                isinstance(a, ast.Name) and a.id in tainted for a in value.args
+            ):
+                taints = True
+        elif isinstance(value, ast.Name) and value.id in tainted:
+            taints = True
+        for name in targets:
+            if taints:
+                tainted.add(name)
+            else:
+                tainted.discard(name)
+
+    def _sink(self, call: ast.Call, tainted: Set[str]) -> Optional[str]:
+        def is_tainted_value(node: ast.AST) -> bool:
+            if isinstance(node, ast.Name):
+                return node.id in tainted
+            if isinstance(node, ast.Subscript):
+                return is_tainted_value(node.value)
+            return False
+
+        if isinstance(call.func, ast.Attribute) and call.func.attr in self.SYNC_METHODS:
+            if is_tainted_value(call.func.value):
+                return f".{call.func.attr}() on a device-resident value"
+        name = dotted_name(call.func)
+        if name in self.CASTS and call.args and is_tainted_value(call.args[0]):
+            return f"{name}() on a device-resident value"
+        return None
+
+
+# ---------------------------------------------------------------------- JX03 --
+
+
+@register
+class UseAfterDonate(Rule):
+    """Args passed at a donated position are dead buffers afterwards — reading
+    one raises ``RuntimeError: Invalid buffer`` (or silently reads garbage on
+    some backends).  Rebind the result over the donated name."""
+
+    code = "JX03"
+    title = "use after donate"
+
+    def run(self, info: ModuleInfo) -> Iterator[Finding]:
+        for scope, qual in info.functions:
+            yield from self._scan_scope(info, scope, qual)
+
+    def _donating_callables(self, info: ModuleInfo, scope: FuncNode) -> Dict[str, DonationSpec]:
+        out: Dict[str, DonationSpec] = {}
+        for stmt in info.own_statements(scope):
+            if not isinstance(stmt, ast.Assign) or not isinstance(stmt.value, ast.Call):
+                continue
+            call = stmt.value
+            spec: Optional[DonationSpec] = None
+            if is_jit_call(call):
+                spec = jit_donation(call)
+            else:
+                callee = last_part(dotted_name(call.func))
+                if callee in info.factories:
+                    spec = info.factories[callee]
+            if spec:
+                for name in _assign_target_names(stmt):
+                    out[name] = spec
+        return out
+
+    def _scan_scope(self, info: ModuleInfo, scope: FuncNode, qual: str) -> Iterator[Finding]:
+        donating = self._donating_callables(info, scope)
+        if not donating:
+            return
+        stmts = list(info.own_statements(scope))
+        for i, stmt in enumerate(stmts):
+            for call in walk_exprs(stmt):
+                if not isinstance(call, ast.Call):
+                    continue
+                callee = dotted_name(call.func)
+                if callee not in donating:
+                    continue
+                spec = donating[callee]
+                donated: Set[str] = set()
+                for idx in spec.argnums:
+                    if idx < len(call.args) and isinstance(call.args[idx], ast.Name):
+                        donated.add(call.args[idx].id)
+                for kw in call.keywords:
+                    if kw.arg in spec.argnames and isinstance(kw.value, ast.Name):
+                        donated.add(kw.value.id)
+                donated -= set(_assign_target_names(stmt))
+                if not donated:
+                    continue
+                yield from self._uses_after(info, qual, stmts[i + 1 :], donated, callee)
+
+    def _uses_after(
+        self,
+        info: ModuleInfo,
+        qual: str,
+        rest: List[ast.stmt],
+        donated: Set[str],
+        callee: str,
+    ) -> Iterator[Finding]:
+        pending = set(donated)
+        for stmt in rest:
+            if not pending:
+                return
+            # loads first (RHS evaluates before targets bind)
+            for node in self._loads(stmt):
+                if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) and node.id in pending:
+                    yield self.finding(
+                        info, qual, node,
+                        f"'{node.id}' was donated to {callee}() and read afterwards — the "
+                        f"buffer is dead; rebind the call result over the donated name",
+                    )
+                    pending.discard(node.id)
+            pending -= set(_assign_target_names(stmt))
+
+    def _loads(self, stmt: ast.stmt) -> Iterator[ast.AST]:
+        """Load-context names of one statement's own expressions, skipping
+        lambda bodies (closures see the *rebound* name at call time, not the
+        dead buffer)."""
+        yield from walk_exprs(stmt, include_lambda=False)
+
+
+# ---------------------------------------------------------------------- JX04 --
+
+
+@register
+class TracerBranch(Rule):
+    """``if``/``while`` on a tracer inside traced code raises
+    ``TracerBoolConversionError`` at trace time — or, with weak-typed inputs,
+    silently bakes one branch in.  Use ``lax.cond``/``lax.select``/``jnp.where``."""
+
+    code = "JX04"
+    title = "python branch on tracer"
+
+    STATIC_CALLS = {"isinstance", "len", "hasattr", "getattr", "type", "callable", "issubclass"}
+    STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "keys", "items", "values", "get"}
+
+    def run(self, info: ModuleInfo) -> Iterator[Finding]:
+        for scope, qual in info.functions:
+            if isinstance(scope, ast.Module) or not info.is_traced(scope):
+                continue
+            tainted = set(_param_names(scope))
+            for stmt in info.own_statements(scope):
+                if isinstance(stmt, (ast.If, ast.While)) and self._dynamic(stmt.test, tainted):
+                    kind = "if" if isinstance(stmt, ast.If) else "while"
+                    yield self.finding(
+                        info, qual, stmt,
+                        f"python '{kind}' branches on a tracer-derived value inside traced "
+                        f"code — use lax.cond/lax.select/jnp.where",
+                    )
+                if isinstance(stmt, ast.Assign) and self._dynamic_name_used(stmt.value, tainted):
+                    tainted.update(_assign_target_names(stmt))
+
+    def _dynamic_name_used(self, expr: ast.AST, tainted: Set[str]) -> bool:
+        return any(
+            isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) and n.id in tainted
+            for n in ast.walk(expr)
+        )
+
+    def _dynamic(self, node: ast.AST, tainted: Set[str]) -> bool:
+        """True when the expression's truthiness depends on traced *data* (not
+        static structure like shapes, lengths, or ``is None`` checks)."""
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.Call):
+            if last_part(dotted_name(node.func)) in self.STATIC_CALLS:
+                return False
+            return any(self._dynamic(a, tainted) for a in node.args) or any(
+                self._dynamic(kw.value, tainted) for kw in node.keywords
+            )
+        if isinstance(node, ast.Attribute):
+            if node.attr in self.STATIC_ATTRS:
+                return False
+            return self._dynamic(node.value, tainted)
+        if isinstance(node, ast.Compare):
+            # identity and membership tests are structural, not traced data
+            # (`x in cfg_dict` branches on keys; `x in tracer` raises anyway)
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn)) for op in node.ops):
+                return False
+            return self._dynamic(node.left, tainted) or any(
+                self._dynamic(c, tainted) for c in node.comparators
+            )
+        if isinstance(node, ast.BoolOp):
+            return any(self._dynamic(v, tainted) for v in node.values)
+        if isinstance(node, ast.UnaryOp):
+            return self._dynamic(node.operand, tainted)
+        if isinstance(node, ast.BinOp):
+            return self._dynamic(node.left, tainted) or self._dynamic(node.right, tainted)
+        if isinstance(node, ast.Subscript):
+            return self._dynamic(node.value, tainted)
+        return False
+
+
+# ---------------------------------------------------------------------- JX05 --
+
+
+@register
+class RetraceHazard(Rule):
+    """Every ``jax.jit`` call makes a *new* wrapper with an empty cache:
+    inside a loop body that is one retrace per iteration, and
+    ``jax.jit(f)(x)`` retraces on every single invocation.  Hoist the wrapper
+    out of the loop (or allowlist deliberate AOT ladders in the baseline)."""
+
+    code = "JX05"
+    title = "retrace hazard"
+
+    def run(self, info: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if is_jit_call(node):
+                scope = info.enclosing_function(node)
+                qual = info.qualname_of(scope)
+                if info.in_loop(node):
+                    yield self.finding(
+                        info, qual, node,
+                        "jax.jit() called inside a loop body creates a fresh wrapper (and a "
+                        "fresh trace) every iteration — hoist it out of the loop",
+                    )
+                parent = info.parents.get(node)
+                if isinstance(parent, ast.Call) and parent.func is node:
+                    yield self.finding(
+                        info, qual, parent,
+                        "jax.jit(f)(...) builds and discards the wrapper per call, so nothing "
+                        "is ever cached — bind `g = jax.jit(f)` once and call g",
+                    )
+
+
+def run_rules(info: ModuleInfo, disabled: Optional[Set[str]] = None) -> List[Finding]:
+    disabled = disabled or set()
+    findings: List[Finding] = []
+    for code in sorted(RULES):
+        if code in disabled:
+            continue
+        findings.extend(RULES[code].run(info))
+    return findings

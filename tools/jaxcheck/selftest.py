@@ -1,0 +1,174 @@
+"""Built-in fixtures proving each rule fires on its hazard and stays quiet on
+the fixed version.  ``python -m tools.jaxcheck --self-test`` runs them all and
+exits nonzero on any mismatch — the pytest-visible smoke for the analyzer
+itself (mirrors ``tools/regress.py --self-test``)."""
+
+from __future__ import annotations
+
+import sys
+import textwrap
+from typing import Dict, Tuple
+
+from . import analyze_source
+
+# rule -> (positive fixture that must fire, negative fixture that must not)
+FIXTURES: Dict[str, Tuple[str, str]] = {
+    "JX01": (
+        """
+        import jax
+
+        def sample(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.uniform(key, (2,))
+            return a + b
+        """,
+        """
+        import jax
+
+        def sample(key):
+            k1, k2 = jax.random.split(key)
+            a = jax.random.normal(k1, (2,))
+            b = jax.random.uniform(k2, (2,))
+            return a + b
+        """,
+    ),
+    "JX02": (
+        """
+        import jax
+
+        @jax.jit
+        def loss(x):
+            return float(x[0])
+        """,
+        """
+        import jax
+
+        @jax.jit
+        def loss(x):
+            return x[0] * 2.0
+        """,
+    ),
+    "JX03": (
+        """
+        import jax
+
+        def step(params, grads):
+            return params
+
+        def main(params, grads):
+            train = jax.jit(step, donate_argnums=(0,))
+            out = train(params, grads)
+            return params
+        """,
+        """
+        import jax
+
+        def step(params, grads):
+            return params
+
+        def main(params, grads):
+            train = jax.jit(step, donate_argnums=(0,))
+            params = train(params, grads)
+            return params
+        """,
+    ),
+    "JX04": (
+        """
+        import jax
+
+        @jax.jit
+        def act(x):
+            if x > 0:
+                return x
+            return -x
+        """,
+        """
+        import jax
+
+        @jax.jit
+        def act(x):
+            if x.shape[0] > 1:
+                return x[0]
+            return x
+        """,
+    ),
+    "JX05": (
+        """
+        import jax
+
+        def run(fns, x):
+            outs = []
+            for f in fns:
+                outs.append(jax.jit(f)(x))
+            return outs
+        """,
+        """
+        import jax
+
+        def run(f, xs):
+            g = jax.jit(f)
+            return [g(x) for x in xs]
+        """,
+    ),
+}
+
+# the JX02 hot-loop mode only applies under algos/, so fixtures are analyzed
+# as if they lived there
+FIXTURE_PATH = "sheeprl_tpu/algos/fixture/fixture.py"
+
+# a second JX02 pair exercising the hot-loop taint mode explicitly
+HOT_LOOP_POSITIVE = """
+import jax
+import numpy as np
+
+def make_train_fn(step):
+    return jax.jit(step, donate_argnums=(0,))
+
+def main(step, params, batches):
+    train_fn = make_train_fn(step)
+    for batch in batches:
+        params, metrics = train_fn(params, batch)
+        print(float(metrics[0]))
+"""
+
+HOT_LOOP_NEGATIVE = """
+import jax
+import numpy as np
+
+def make_train_fn(step):
+    return jax.jit(step, donate_argnums=(0,))
+
+def main(step, params, batches):
+    train_fn = make_train_fn(step)
+    for batch in batches:
+        params, metrics = train_fn(params, batch)
+        metrics = np.asarray(metrics)
+        print(float(metrics[0]))
+"""
+
+
+def _codes(source: str) -> set:
+    findings = analyze_source(textwrap.dedent(source), FIXTURE_PATH)
+    return {f.rule for f in findings}
+
+
+def self_test() -> int:
+    failures = []
+    for code, (positive, negative) in sorted(FIXTURES.items()):
+        if code not in _codes(positive):
+            failures.append(f"{code}: positive fixture did not fire")
+        if code in _codes(negative):
+            failures.append(f"{code}: negative (fixed) fixture fired")
+        # the registry must honour --disable
+        disabled = analyze_source(textwrap.dedent(positive), FIXTURE_PATH, disabled={code})
+        if any(f.rule == code for f in disabled):
+            failures.append(f"{code}: finding survived --disable {code}")
+    if "JX02" not in _codes(HOT_LOOP_POSITIVE):
+        failures.append("JX02: hot-loop positive fixture did not fire")
+    if "JX02" in _codes(HOT_LOOP_NEGATIVE):
+        failures.append("JX02: hot-loop negative fixture fired after np.asarray fetch")
+    if failures:
+        print("jaxcheck self-test FAILED:\n  " + "\n  ".join(failures), file=sys.stderr)
+        return 1
+    print(f"jaxcheck self-test: ok ({len(FIXTURES)} rules × positive/negative/disable fixtures verified)")
+    return 0

@@ -236,7 +236,21 @@ def evaluate(
 # ---------------------------------------------------------------- output ----
 
 
+# keys owned by tools/jaxcheck (static config-matrix verdicts folded into the
+# same grid file) — a regression-gate rewrite must carry them forward
+PRESERVED_KEYS = ("config_cells", "config_summary", "static_findings")
+
+
 def write_scenarios(doc: Dict[str, Any], path: str) -> None:
+    try:
+        with open(path) as f:
+            prev = json.load(f)
+    except (OSError, ValueError):
+        prev = {}
+    if isinstance(prev, dict):
+        for key in PRESERVED_KEYS:
+            if key in prev and key not in doc:
+                doc[key] = prev[key]
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=1, default=str)
