@@ -227,6 +227,7 @@ def telemetry_summary(events_or_path) -> dict:
     env_time = sum(e.get("window_env_time", 0.0) for e in heartbeats)
     train_steps = sum(e.get("window_train_steps", 0) for e in heartbeats)
     train_time = sum(e.get("window_train_time", 0.0) for e in heartbeats)
+    train_wait = sum(e.get("window_train_wait_time", 0.0) for e in heartbeats)
     summary["heartbeats"] = len(heartbeats)
     if env_time > 0:
         summary["sps_env"] = env_steps / env_time
@@ -234,6 +235,17 @@ def telemetry_summary(events_or_path) -> dict:
         summary["sps_train"] = train_steps / train_time
     if env_time + train_time > 0:
         summary["duty_cycle_train"] = train_time / (env_time + train_time)
+    loop_time = env_time + train_time + train_wait
+    if loop_time > 0 and env_steps > 0:
+        summary["sps_end_to_end"] = env_steps / loop_time
+    if any("window_train_wait_time" in e for e in heartbeats):
+        # overlapped collection (algo.overlap_collection): train_time is the
+        # non-blocking dispatch span, train_wait the later block on its
+        # result — collection ran in between, so env/(env+wait) is the hidden
+        # fraction of each update cycle (1.0 = train fully overlapped)
+        summary["train_wait_time"] = train_wait
+        if env_time + train_wait > 0:
+            summary["overlap_fraction"] = env_time / (env_time + train_wait)
     # train_time-weighted averages: a long window's MFU should count more
     weighted = [
         (e["window_train_time"], e[k])
@@ -609,6 +621,84 @@ def bench_ppo() -> dict:
     return rec
 
 
+def bench_ppo_fused() -> dict:
+    """The fused-rollout PPO workload (algo.fused_rollout=True, howto/
+    fused_training.md "On-policy collection"): the whole update — device
+    rollout + GAE + train — is ONE dispatch. Same steps/shape as bench_ppo,
+    so the two records quantify the host-loop gap directly. The CLI run
+    registers itself in RUNS.jsonl with variant=fused_rollout, which is the
+    regress cell the acceptance gate watches."""
+    import tempfile
+
+    from sheeprl_tpu.cli import run
+
+    with tempfile.TemporaryDirectory() as d:
+        probe = os.path.join(d, "ppo_fused_bench.json")
+        os.environ["SHEEPRL_TPU_BENCH_JSON"] = probe
+        try:
+            run(_ppo_args(PPO_STEPS) + ["algo.fused_rollout=True"])
+        finally:
+            os.environ.pop("SHEEPRL_TPU_BENCH_JSON", None)
+        rec = _read_probe(probe, "ppo_fused")
+    return rec
+
+
+def bench_ppo_floor() -> dict:
+    """The benchmarks/ppo_floor.py stage ladder as a bench workload: bare
+    vector env -> noop policy -> jitted player -> player+bookkeeping. The
+    parent folds each stage into the run registry (kind=floor, variant=stage)
+    so the floor itself is regression-gated alongside the training cells."""
+    import benchmarks.ppo_floor as floor
+
+    steps = int(os.environ.get("SHEEPRL_TPU_FLOOR_STEPS", "16384"))
+    n_envs = int(os.environ.get("SHEEPRL_TPU_FLOOR_ENVS", "64"))
+    envs = floor.make_envs(n_envs)
+    rec: dict = {"workload": "ppo_floor", "envs": n_envs, "steps": steps, "stages": {}}
+    try:
+        rec["stages"]["random"] = round(floor.stage_random(envs, steps), 1)
+        rec["stages"]["noop_policy"] = round(floor.stage_noop_policy(envs, steps), 1)
+        rec["stages"]["player"] = round(floor.stage_player(envs, steps), 1)
+        rec["stages"]["bookkeeping"] = round(floor.stage_bookkeeping(envs, steps), 1)
+    finally:
+        envs.close()
+    return rec
+
+
+def append_floor_runs(rec: dict, runs_path: str) -> int:
+    """Fold a ppo_floor workload record into the run registry: one JSONL
+    line per stage, keyed so tools/regress.py gates each stage as its own
+    ``floor:ppo:CartPole-v1:hostx1p1:<stage>`` cell. Stdlib-only — runs in
+    the jax-free bench parent."""
+    stages = rec.get("stages") or {}
+    written = 0
+    with open(runs_path, "a") as f:
+        for stage, sps in sorted(stages.items()):
+            if not isinstance(sps, (int, float)):
+                continue
+            f.write(
+                json.dumps(
+                    {
+                        "schema": 1,
+                        "t": time.time(),
+                        "kind": "floor",
+                        "algo": "ppo",
+                        "env": "CartPole-v1",
+                        "backend": "host",
+                        "local_device_count": 1,
+                        "process_count": 1,
+                        "outcome": "completed",
+                        "variant": stage,
+                        "sps_env": float(sps),
+                        "envs": rec.get("envs"),
+                        "steps": rec.get("steps"),
+                    }
+                )
+                + "\n"
+            )
+            written += 1
+    return written
+
+
 def wait_for_backend(max_wait_s: float) -> bool:
     """Return True once the accelerator backend initializes (probed in a
     SUBPROCESS so a failed attempt cannot poison any process's backend
@@ -680,6 +770,8 @@ def _checkpoint(cache: dict, key: str, value, provenance: str) -> None:
 _WORKLOADS = {
     "dv3": bench_dv3,
     "ppo": bench_ppo,
+    "ppo_fused": bench_ppo_fused,
+    "ppo_floor": bench_ppo_floor,
     "probe": lambda: link_probe(os.environ.get("SHEEPRL_TPU_BENCH_PROBE_TAG", "probe")),
 }
 
@@ -952,6 +1044,14 @@ if __name__ == "__main__":
         "--bench-glob", default="BENCH_r*.json", help="driver bench records folded into --regress ('' disables)"
     )
     parser.add_argument(
+        "--floor",
+        action="store_true",
+        help="run the benchmarks/ppo_floor.py stage ladder (bare env / noop "
+        "policy / jitted player / player+bookkeeping) in a subprocess, fold "
+        "each stage into the run registry (kind=floor, variant=stage) for "
+        "--regress gating, print the stage JSON",
+    )
+    parser.add_argument(
         "--static",
         action="store_true",
         help="static gate: run the jaxcheck rule scan + config-matrix "
@@ -990,6 +1090,15 @@ if __name__ == "__main__":
         for line in report["new"]:
             print(f"  NEW {line}")
         sys.exit(proc.returncode)
+    if args.floor:
+        # the stages run in a child (they import jax); the parent stays
+        # jax-free and does the stdlib-only registry fold
+        rec = _spawn_workload("ppo_floor", 1200)
+        if rec is None:
+            sys.exit(1)
+        written = append_floor_runs(rec, args.runs)
+        print(json.dumps({**rec, "registry_records": written, "runs_path": args.runs}))
+        sys.exit(0)
     if args.regress:
         # the gate is stdlib-only; load it by file path so this parent
         # process stays jax-free (same reason main() shells out workloads)

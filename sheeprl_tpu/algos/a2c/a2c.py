@@ -40,6 +40,7 @@ from sheeprl_tpu.ops.math import gae
 from sheeprl_tpu.resilience import RunResilience
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.prealloc import RolloutStore
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import save_configs
@@ -204,6 +205,9 @@ def main(fabric, cfg: Dict[str, Any]):
         state_fn=lambda: ckpt_state_fn(update - 1),
     )
     preempted = False
+    # rollout arrays preallocated once and written in place — no per-step
+    # list appends, no end-of-window np.stack copy
+    store = RolloutStore(rollout_steps)
     for update in range(start_update, num_updates + 1):
         telemetry_advance(policy_step)
         if resil.preempt_requested():
@@ -214,9 +218,9 @@ def main(fabric, cfg: Dict[str, Any]):
         if update == start_update + 1:
             # no bench probe in this loop — warm the recompile watchdog here
             telemetry_mark_warm()
-        rollout = {k: [] for k in (*obs_keys, "dones", "values", "actions", "logprobs", "rewards")}
+        buf = store.begin(update)
         with timer("Time/env_interaction_time"):
-            for _ in range(rollout_steps):
+            for t in range(rollout_steps):
                 policy_step += num_envs * num_processes
                 player_key, action_key = jax.random.split(player_key)
                 actions, logprobs, values = player.get_actions(next_obs, action_key)
@@ -236,13 +240,13 @@ def main(fabric, cfg: Dict[str, Any]):
                 )
                 rewards = np.asarray(rewards, np.float32).reshape(num_envs, 1)
                 dones = np.logical_or(terminated, truncated).reshape(num_envs, 1).astype(np.float32)
-                for k in obs_keys:
-                    rollout[k].append(next_obs[k])
-                rollout["dones"].append(dones)
-                rollout["values"].append(values_np)
-                rollout["actions"].append(actions_np)
-                rollout["logprobs"].append(logprobs_np)
-                rollout["rewards"].append(rewards)
+                step_values = {k: next_obs[k] for k in obs_keys}
+                step_values["dones"] = dones
+                step_values["values"] = values_np
+                step_values["actions"] = actions_np
+                step_values["logprobs"] = logprobs_np
+                step_values["rewards"] = rewards
+                buf.put(t, step_values)
                 next_obs = prepare_obs(obs, num_envs=num_envs)
 
                 if cfg.metric.log_level > 0 and "final_info" in info:
@@ -253,7 +257,7 @@ def main(fabric, cfg: Dict[str, Any]):
                             aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
                             print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep['r'][i]}")
 
-        local_data = {k: np.stack(v, axis=0) for k, v in rollout.items()}
+        local_data = buf.arrays()
         next_values = np.asarray(player.get_values(next_obs))
         # GAE on the player's device (host when the chip is remote-attached):
         # rollout arrays are already host-side, so the advantage pass never
@@ -272,11 +276,11 @@ def main(fabric, cfg: Dict[str, Any]):
 
         with timer("Time/train_time"):
             params, opt_state, metrics = train_fn(params, opt_state, flat)
-            metrics = jax.block_until_ready(metrics)
-        # one host fetch serves the NaN sentinel and the aggregator scalars
-        # below — float(metrics[i]) on the device array would be a blocking
-        # transfer per scalar per update
-        metrics = np.asarray(metrics)
+            # one host fetch serves the sync point, the NaN sentinel and the
+            # aggregator scalars below — block_until_ready + a second asarray
+            # (or float(metrics[i]) per scalar) would each be an extra
+            # blocking transfer per update
+            metrics = np.asarray(metrics)
         if not resil.check_finite(metrics, update):
             # restore the newest committed checkpoint and fork the action key
             # away from the stream that diverged; the loop keeps advancing

@@ -48,6 +48,7 @@ from sheeprl_tpu.ops.math import gae
 from sheeprl_tpu.parallel.shard_map import shard_map
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.prealloc import RolloutStore
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
@@ -313,26 +314,17 @@ def main(fabric, cfg: Dict[str, Any]):
     cx = np.zeros((num_envs, agent.lstm_hidden_size), np.float32)
     prev_actions = np.zeros((num_envs, n_actions), np.float32)
 
+    # rollout arrays preallocated once and written in place — no per-step
+    # list appends (or the defensive hx/cx/prev_actions .copy()s: the indexed
+    # write is itself the copy), no end-of-window np.stack
+    store = RolloutStore(rollout_steps)
     for update in range(start_update, num_updates + 1):
-        rollout = {
-            k: []
-            for k in (
-                *obs_keys,
-                "dones",
-                "values",
-                "actions",
-                "logprobs",
-                "rewards",
-                "prev_hx",
-                "prev_cx",
-                "prev_actions",
-            )
-        }
+        buf = store.begin(update)
         with timer("Time/env_interaction_time"):
             # fused rollout step: key folding, sampling and the real-action
             # conversion in one jitted dispatch + one fetch per env step
             update_key = player_key
-            for _ in range(rollout_steps):
+            for t in range(rollout_steps):
                 policy_step += num_envs * fabric.num_processes
                 obs_t = {k: v[None] for k, v in next_obs.items()}
                 actions, real_actions, logprobs, values, new_hx, new_cx = player.rollout_actions(
@@ -373,16 +365,16 @@ def main(fabric, cfg: Dict[str, Any]):
                     rewards[truncated_envs, 0] += float(cfg.algo.gamma) * vals
 
                 dones = np.logical_or(terminated, truncated).reshape(num_envs, 1).astype(np.float32)
-                for k in obs_keys:
-                    rollout[k].append(next_obs[k])
-                rollout["dones"].append(dones)
-                rollout["values"].append(values_np)
-                rollout["actions"].append(actions_np)
-                rollout["logprobs"].append(logprobs_np)
-                rollout["rewards"].append(rewards)
-                rollout["prev_hx"].append(hx.copy())
-                rollout["prev_cx"].append(cx.copy())
-                rollout["prev_actions"].append(prev_actions.copy())
+                step_values = {k: next_obs[k] for k in obs_keys}
+                step_values["dones"] = dones
+                step_values["values"] = values_np
+                step_values["actions"] = actions_np
+                step_values["logprobs"] = logprobs_np
+                step_values["rewards"] = rewards
+                step_values["prev_hx"] = hx
+                step_values["prev_cx"] = cx
+                step_values["prev_actions"] = prev_actions
+                buf.put(t, step_values)
 
                 prev_actions = (1 - dones) * actions_np
                 if reset_on_done:
@@ -400,7 +392,7 @@ def main(fabric, cfg: Dict[str, Any]):
                             aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
                             print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep['r'][i]}")
 
-        local_data = {k: np.stack(v, axis=0) for k, v in rollout.items()}  # [T, E, ...]
+        local_data = buf.arrays()  # [T, E, ...]
 
         # GAE on device (reference :386-398)
         next_values = np.asarray(
@@ -455,10 +447,10 @@ def main(fabric, cfg: Dict[str, Any]):
                 np.float32(clip_coef),
                 np.float32(ent_coef),
             )
-            metrics = jax.block_until_ready(metrics)
-        # one host fetch for the three aggregator scalars below instead of a
-        # blocking device transfer per float()
-        metrics = np.asarray(metrics)
+            # one host fetch serves the sync point and the three aggregator
+            # scalars below — block_until_ready plus a second asarray (or a
+            # blocking transfer per float()) would each be an extra round trip
+            metrics = np.asarray(metrics)
         player.params = params
         train_step += world_size
 

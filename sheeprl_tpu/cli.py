@@ -213,7 +213,18 @@ def run_algorithm(cfg: dotdict) -> None:
         # (run_summary) is still alive to fold in
         from sheeprl_tpu.obs.registry import register_run
 
-        register_run(cfg, kind="train", outcome=outcome, error=error)
+        # loop variants land in their own regress cell (tools/regress.py
+        # appends :variant to the cell key): a 3x fused run must never become
+        # the host loop's baseline, nor be gated against it
+        variant = None
+        algo_cfg = cfg.get("algo") if hasattr(cfg, "get") else None
+        if algo_cfg is not None:
+            if algo_cfg.get("fused_rollout"):
+                variant = "fused_rollout"
+            elif algo_cfg.get("overlap_collection"):
+                variant = "overlap_collection"
+        extra = {"variant": variant} if variant else {}
+        register_run(cfg, kind="train", outcome=outcome, error=error, **extra)
         shutdown_telemetry()
 
 

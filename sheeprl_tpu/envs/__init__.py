@@ -6,6 +6,7 @@ framework uses everywhere — where the reference is NCHW (utils/env.py:193).
 """
 
 from sheeprl_tpu.envs.factory import build_vector_env, get_dummy_env, make_env, resolve_env_backend
+from sheeprl_tpu.envs.jittable import JaxCartPole, JaxPendulum, JittableEnvSpec, StepOut, get_jittable_env
 from sheeprl_tpu.envs.wrappers import (
     ActionRepeat,
     FrameStack,
@@ -18,6 +19,11 @@ from sheeprl_tpu.envs.wrappers import (
 __all__ = [
     "ActionRepeat",
     "FrameStack",
+    "JaxCartPole",
+    "JaxPendulum",
+    "JittableEnvSpec",
+    "StepOut",
+    "get_jittable_env",
     "build_vector_env",
     "resolve_env_backend",
     "GrayscaleRenderWrapper",

@@ -1,0 +1,197 @@
+"""Jittable (pure-functional) environments for device-resident rollouts.
+
+The host-side gymnasium stack steps envs one Python call at a time; every call
+is a host<->device round trip when the policy lives on a chip.  For the classic
+control dynamics that dominate CPU-valid benchmarking, the transition function
+is a handful of FLOPs — the round trip *is* the cost.  This module rewrites
+those dynamics as jax-pure functions over an explicit state pytree so a whole
+T-step rollout can run inside one ``lax.scan`` (``ops/rollout_scan.py``).
+
+API contract (single env; batch with ``jax.vmap``):
+
+- ``spec.init(key) -> state``: reset to a fresh episode.  ``state`` is a
+  pytree of arrays — here ``{"y": f32[state_dim], "t": i32[]}`` where ``t``
+  counts elapsed steps for the time-limit truncation.
+- ``spec.step(state, action, key) -> (next_state, StepOut)``: one transition.
+  ``StepOut.obs`` is the observation of ``next_state`` *before* any autoreset
+  (the gymnasium ``final_obs``); autoreset is the rollout scan's job so the
+  bootstrap value of the terminal observation stays available in-graph.
+- ``spec.observation(state) -> obs``: observation of a state (used for the
+  step-0 observation after ``init``).
+
+Dynamics are transcribed from gymnasium's classic-control sources (CartPole's
+Euler integrator, Pendulum's clipped torque) and parity-tested per-transition
+against the gymnasium envs in ``tests/test_envs/test_jittable.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+class StepOut(NamedTuple):
+    """One transition's outputs, pre-autoreset (gymnasium step tuple)."""
+
+    obs: jax.Array  # f32[obs_dim] — observation of the raw next state
+    reward: jax.Array  # f32[]
+    terminated: jax.Array  # bool[]
+    truncated: jax.Array  # bool[]
+
+
+class JittableEnvSpec(NamedTuple):
+    """A pure-functional env: metadata + ``init``/``step``/``observation``."""
+
+    env_id: str
+    obs_dim: int
+    is_continuous: bool
+    # discrete: number of actions; continuous: action vector dimension
+    action_dim: int
+    max_episode_steps: int
+    init: Callable[[jax.Array], Pytree]
+    step: Callable[[Pytree, jax.Array, jax.Array], Tuple[Pytree, StepOut]]
+    observation: Callable[[Pytree], jax.Array]
+
+
+# ---------------------------------------------------------------------------
+# CartPole-v1 (gymnasium/envs/classic_control/cartpole.py)
+# ---------------------------------------------------------------------------
+
+_CP_GRAVITY = 9.8
+_CP_MASSCART = 1.0
+_CP_MASSPOLE = 0.1
+_CP_TOTAL_MASS = _CP_MASSPOLE + _CP_MASSCART
+_CP_LENGTH = 0.5  # half the pole's length
+_CP_POLEMASS_LENGTH = _CP_MASSPOLE * _CP_LENGTH
+_CP_FORCE_MAG = 10.0
+_CP_TAU = 0.02
+_CP_THETA_THRESHOLD = 12 * 2 * jnp.pi / 360
+_CP_X_THRESHOLD = 2.4
+_CP_MAX_STEPS = 500
+
+
+def _cartpole_init(key: jax.Array) -> Pytree:
+    y = jax.random.uniform(key, (4,), jnp.float32, minval=-0.05, maxval=0.05)
+    return {"y": y, "t": jnp.int32(0)}
+
+
+def _cartpole_obs(state: Pytree) -> jax.Array:
+    return state["y"]
+
+
+def _cartpole_step(state: Pytree, action: jax.Array, key: jax.Array) -> Tuple[Pytree, StepOut]:
+    del key  # deterministic dynamics; the key slot is for stochastic envs
+    x, x_dot, theta, theta_dot = state["y"]
+    force = jnp.where(action == 1, _CP_FORCE_MAG, -_CP_FORCE_MAG).astype(jnp.float32)
+    costheta = jnp.cos(theta)
+    sintheta = jnp.sin(theta)
+    temp = (force + _CP_POLEMASS_LENGTH * theta_dot**2 * sintheta) / _CP_TOTAL_MASS
+    thetaacc = (_CP_GRAVITY * sintheta - costheta * temp) / (
+        _CP_LENGTH * (4.0 / 3.0 - _CP_MASSPOLE * costheta**2 / _CP_TOTAL_MASS)
+    )
+    xacc = temp - _CP_POLEMASS_LENGTH * thetaacc * costheta / _CP_TOTAL_MASS
+    # Euler integration, gymnasium's kinematics_integrator="euler" order
+    x = x + _CP_TAU * x_dot
+    x_dot = x_dot + _CP_TAU * xacc
+    theta = theta + _CP_TAU * theta_dot
+    theta_dot = theta_dot + _CP_TAU * thetaacc
+    y = jnp.stack([x, x_dot, theta, theta_dot]).astype(jnp.float32)
+    t = state["t"] + 1
+    terminated = (
+        (x < -_CP_X_THRESHOLD)
+        | (x > _CP_X_THRESHOLD)
+        | (theta < -_CP_THETA_THRESHOLD)
+        | (theta > _CP_THETA_THRESHOLD)
+    )
+    truncated = t >= _CP_MAX_STEPS
+    out = StepOut(obs=y, reward=jnp.float32(1.0), terminated=terminated, truncated=truncated)
+    return {"y": y, "t": t}, out
+
+
+JaxCartPole = JittableEnvSpec(
+    env_id="CartPole-v1",
+    obs_dim=4,
+    is_continuous=False,
+    action_dim=2,
+    max_episode_steps=_CP_MAX_STEPS,
+    init=_cartpole_init,
+    step=_cartpole_step,
+    observation=_cartpole_obs,
+)
+
+
+# ---------------------------------------------------------------------------
+# Pendulum-v1 (gymnasium/envs/classic_control/pendulum.py)
+# ---------------------------------------------------------------------------
+
+_PD_MAX_SPEED = 8.0
+_PD_MAX_TORQUE = 2.0
+_PD_DT = 0.05
+_PD_G = 10.0
+_PD_M = 1.0
+_PD_L = 1.0
+_PD_MAX_STEPS = 200
+
+
+def _angle_normalize(x: jax.Array) -> jax.Array:
+    return ((x + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+
+
+def _pendulum_init(key: jax.Array) -> Pytree:
+    k_th, k_thdot = jax.random.split(key)
+    th = jax.random.uniform(k_th, (), jnp.float32, minval=-jnp.pi, maxval=jnp.pi)
+    thdot = jax.random.uniform(k_thdot, (), jnp.float32, minval=-1.0, maxval=1.0)
+    return {"y": jnp.stack([th, thdot]), "t": jnp.int32(0)}
+
+
+def _pendulum_obs(state: Pytree) -> jax.Array:
+    th, thdot = state["y"]
+    return jnp.stack([jnp.cos(th), jnp.sin(th), thdot]).astype(jnp.float32)
+
+
+def _pendulum_step(state: Pytree, action: jax.Array, key: jax.Array) -> Tuple[Pytree, StepOut]:
+    del key
+    th, thdot = state["y"]
+    u = jnp.clip(jnp.reshape(action, (-1,))[0], -_PD_MAX_TORQUE, _PD_MAX_TORQUE)
+    costs = _angle_normalize(th) ** 2 + 0.1 * thdot**2 + 0.001 * u**2
+    newthdot = thdot + (3 * _PD_G / (2 * _PD_L) * jnp.sin(th) + 3.0 / (_PD_M * _PD_L**2) * u) * _PD_DT
+    newthdot = jnp.clip(newthdot, -_PD_MAX_SPEED, _PD_MAX_SPEED)
+    newth = th + newthdot * _PD_DT
+    y = jnp.stack([newth, newthdot]).astype(jnp.float32)
+    t = state["t"] + 1
+    next_state = {"y": y, "t": t}
+    out = StepOut(
+        obs=_pendulum_obs(next_state),
+        reward=-costs.astype(jnp.float32),
+        terminated=jnp.bool_(False),
+        truncated=t >= _PD_MAX_STEPS,
+    )
+    return next_state, out
+
+
+JaxPendulum = JittableEnvSpec(
+    env_id="Pendulum-v1",
+    obs_dim=3,
+    is_continuous=True,
+    action_dim=1,
+    max_episode_steps=_PD_MAX_STEPS,
+    init=_pendulum_init,
+    step=_pendulum_step,
+    observation=_pendulum_obs,
+)
+
+
+_REGISTRY = {
+    "CartPole-v1": JaxCartPole,
+    "Pendulum-v1": JaxPendulum,
+}
+
+
+def get_jittable_env(env_id: str) -> Optional[JittableEnvSpec]:
+    """The jittable twin of a gymnasium env id, or ``None`` when no pure
+    reimplementation exists (the caller falls back to the host loop)."""
+    return _REGISTRY.get(env_id)

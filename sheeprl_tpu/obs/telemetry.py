@@ -207,6 +207,10 @@ class RunTelemetry:
         self._cum_env_time = 0.0
         self._cum_train_steps = 0.0
         self._cum_train_time = 0.0
+        # overlapped collection: time spent *blocked* on the previous async
+        # train dispatch (Time/train_wait_time) — the overlap win is the gap
+        # between this and window_train_time
+        self._cum_train_wait_time = 0.0
         self._last_mfu: Optional[float] = None
         self._last_train_flops_per_sec: Optional[float] = None
         self._final_metrics: Dict[str, float] = {}
@@ -491,12 +495,14 @@ class RunTelemetry:
         peak, recompile count — one JSONL event + ``Telemetry/*`` scalars."""
         env_t = float(timer_window.get("Time/env_interaction_time") or 0.0)
         train_t = float(timer_window.get("Time/train_time") or 0.0)
+        train_wait_t = float(timer_window.get("Time/train_wait_time") or 0.0)
         # run-registry rollup: the window sums reset every heartbeat, these
         # cumulative mirrors survive to run_summary()
         self._cum_env_steps += float(env_steps or 0.0)
         self._cum_env_time += env_t
         self._cum_train_steps += float(train_steps or 0.0)
         self._cum_train_time += train_t
+        self._cum_train_wait_time += train_wait_t
         fields: Dict[str, Any] = {
             "window_env_steps": env_steps,
             "window_train_steps": train_steps,
@@ -567,6 +573,16 @@ class RunTelemetry:
         if env_t + train_t > 0:
             fields["duty_cycle_train"] = train_t / (env_t + train_t)
             scalars["Telemetry/duty_cycle_train"] = fields["duty_cycle_train"]
+        if "Time/train_wait_time" in timer_window:
+            # overlapped collection: train_time is the (non-blocking) dispatch
+            # span, train_wait_time the later block on its results — the env
+            # loop ran in between, so the hidden fraction of the update cycle
+            # is env / (env + wait).  1.0 = train fully hidden.
+            fields["window_train_wait_time"] = train_wait_t
+            scalars["Telemetry/train_wait_time"] = train_wait_t
+            if env_t + train_wait_t > 0:
+                fields["overlap_fraction"] = env_t / (env_t + train_wait_t)
+                scalars["Telemetry/overlap_fraction"] = fields["overlap_fraction"]
         if self._hbm_peak_bytes:
             scalars["Telemetry/hbm_peak_bytes"] = float(self._hbm_peak_bytes)
         flops = self._resolve_flops()
@@ -630,6 +646,18 @@ class RunTelemetry:
             summary["sps_train"] = self._cum_train_steps / self._cum_train_time
         if self._cum_env_time + self._cum_train_time > 0:
             summary["duty_cycle_train"] = self._cum_train_time / (self._cum_env_time + self._cum_train_time)
+        # env steps over the whole timed loop (collect + train + any train
+        # wait): the number fused/overlap runs actually move, and the regress
+        # gate cell for them
+        loop_t = self._cum_env_time + self._cum_train_time + self._cum_train_wait_time
+        if loop_t > 0 and self._cum_env_steps > 0:
+            summary["sps_end_to_end"] = self._cum_env_steps / loop_t
+        if self._cum_train_wait_time > 0:
+            summary["train_wait_time"] = self._cum_train_wait_time
+            if self._cum_env_time + self._cum_train_wait_time > 0:
+                summary["overlap_fraction"] = self._cum_env_time / (
+                    self._cum_env_time + self._cum_train_wait_time
+                )
         if self._flops_per_train_step is not None:
             summary["flops_per_train_step"] = self._flops_per_train_step
         if self._last_train_flops_per_sec is not None:

@@ -1,4 +1,5 @@
-from sheeprl_tpu.ops import distributions, math, superstep  # noqa: F401
+from sheeprl_tpu.ops import distributions, math, rollout_scan, superstep  # noqa: F401
+from sheeprl_tpu.ops.rollout_scan import init_env_carry, make_onpolicy_superstep_fn  # noqa: F401
 from sheeprl_tpu.ops.superstep import (  # noqa: F401
     fold_sample_key,
     make_superstep_fn,

@@ -1,0 +1,214 @@
+"""Fused on-policy collection: the whole rollout+GAE+update as ONE dispatch.
+
+The coupled PPO host loop pays one jitted dispatch plus one device->host fetch
+per env step, then a GAE dispatch, then the fused update — ``benchmarks/
+ppo_floor.py`` measures that bookkeeping at ~3x the jitted-player ceiling.
+This module closes the gap for envs with a jittable twin
+(:mod:`sheeprl_tpu.envs.jittable`): the T-step rollout (agent forward, env
+transition, truncation bootstrap, autoreset, per-step bookkeeping) runs as a
+``lax.scan``, GAE as the existing reverse scan (:func:`sheeprl_tpu.ops.math.
+gae`), and the result feeds the fused epochs x minibatches update — all inside
+one donated jit, zero host round trips per update.
+
+Host-loop parity contract (the numerical-equivalence test pins all of it):
+
+- the action key for step ``t`` is ``fold_in(update_key, policy_step_t)`` with
+  ``policy_step_t`` incremented *before* sampling — exactly
+  ``PPOPlayer.rollout_actions``'s schedule;
+- rewards of truncated envs are bootstrapped with ``gamma * V(final_obs)``
+  for ANY truncated env (terminated-and-truncated included), matching the
+  host loop's ``info["final_obs"]`` block;
+- the train key is ``key, k_train = jax.random.split(key)`` once per update
+  and the evolved ``key`` is returned, so chunked supersteps continue the
+  same stream the host loop would have produced.
+
+Env randomness is a parallel stream: per-step, per-env keys are derived from
+``update_key`` via a salted ``fold_in`` chain (never from the action/train
+streams), so the policy's sample stream is untouched by autoreset timing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from sheeprl_tpu.envs.jittable import JittableEnvSpec
+from sheeprl_tpu.ops.math import gae
+from sheeprl_tpu.parallel.shard_map import shard_map
+
+# salt separating the env reset/transition stream from the action stream that
+# shares the same ``update_key`` root (superstep.py's 0x5EED discipline)
+ENV_STREAM_SALT = 0x0E5E
+
+Pytree = Any
+
+
+def init_env_carry(spec: JittableEnvSpec, num_envs: int, key: jax.Array) -> Dict[str, Pytree]:
+    """Reset ``num_envs`` jittable envs and build the cross-update carry:
+    batched env state plus running episode-return/length accumulators
+    (episodes span update boundaries, so these ride the carry).  The current
+    observation is deliberately NOT carried — it is a pure function of the
+    state, and for identity-observation envs (CartPole) a carried copy would
+    alias the state buffer and break the superstep's carry donation."""
+    env_ids = jnp.arange(num_envs, dtype=jnp.uint32)
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, env_ids)
+    state = jax.vmap(spec.init)(keys)
+    return {
+        "state": state,
+        "ep_ret": jnp.zeros((num_envs,), jnp.float32),
+        "ep_len": jnp.zeros((num_envs,), jnp.int32),
+    }
+
+
+def make_onpolicy_superstep_fn(
+    spec: JittableEnvSpec,
+    *,
+    policy_fn: Callable,
+    value_fn: Callable,
+    local_train: Callable,
+    obs_key: str,
+    rollout_steps: int,
+    step_increment: int,
+    gamma: float,
+    gae_lambda: float,
+    mesh=None,
+    data_axis: Optional[str] = None,
+) -> Callable:
+    """Build the fused on-policy superstep.
+
+    ``policy_fn(params, obs_dict, key) -> (actions, real_actions, logprobs,
+    values)`` is the agent's rollout head (``agent.rollout_step`` partial);
+    ``value_fn(params, obs_dict) -> [E, 1]`` the critic head;
+    ``local_train`` the UNJITTED fused update body from
+    ``make_train_fn``/``make_local_train`` — embedding it here is what makes
+    the whole update one dispatch.  ``step_increment`` is the global
+    policy-step bump per scanned step (``num_envs * num_processes``), so the
+    in-graph action-key schedule equals the host loop's counter bookkeeping.
+
+    With ``mesh``/``data_axis`` the superstep is ``shard_map``ped: the env
+    carry (and hence the envs themselves) shards over the data axis, each
+    device collects its own slice, and ``local_train``'s gradient ``pmean``
+    is the DDP all-reduce — params/opt state stay replicated.
+
+    Returns a jit with ``donate_argnums=(1,)``: the opt state is consumed
+    each call.  Params are NOT donated because the host-pinned player aliases
+    them between updates (same contract as the host train fn).  The env carry
+    is NOT donated either — it is a few KB, and XLA CSE can legally emit its
+    numerically-identical leaves (CartPole's step counter, episode length and
+    unit-reward episode return are the same stream) as ONE buffer, which a
+    donating call would then try to donate twice.
+    """
+    if rollout_steps <= 0:
+        raise ValueError(f"rollout_steps must be positive, got {rollout_steps}")
+    if step_increment <= 0:
+        raise ValueError(f"step_increment must be positive, got {step_increment}")
+    gamma = float(gamma)
+    gae_lambda = float(gae_lambda)
+    use_mesh = mesh is not None
+
+    def superstep(params, opt_state, env_carry, update_key, key, policy_step, clip_coef, ent_coef):
+        # shard-local env count under shard_map; the global count on one host
+        num_envs = env_carry["ep_ret"].shape[0]
+        env_ids = jnp.arange(num_envs, dtype=jnp.uint32)
+        env_root = jax.random.fold_in(update_key, ENV_STREAM_SALT)
+        if use_mesh:
+            # distinct reset/transition streams per device shard
+            env_root = jax.random.fold_in(env_root, lax.axis_index(data_axis))
+
+        def step_fn(carry, _):
+            state, ep_ret, ep_len, step_counter = carry
+            obs = jax.vmap(spec.observation)(state)
+            # counter bumps BEFORE sampling — rollout_actions' fold schedule
+            step_counter = step_counter + step_increment
+            k_act = jax.random.fold_in(update_key, step_counter)
+            if use_mesh:
+                k_act = jax.random.fold_in(k_act, lax.axis_index(data_axis))
+            actions, real_actions, logprobs, values = policy_fn(params, {obs_key: obs}, k_act)
+            if spec.is_continuous:
+                act = real_actions
+            else:
+                act = real_actions[..., 0].astype(jnp.int32)
+
+            env_base = jax.random.fold_in(env_root, step_counter)
+            per_env = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(env_base, env_ids)
+            pair = jax.vmap(jax.random.split)(per_env)  # [E, 2, key]
+            next_state, out = jax.vmap(spec.step)(state, act, pair[:, 0])
+
+            raw_reward = out.reward.astype(jnp.float32)
+            truncated_f = out.truncated.astype(jnp.float32)
+            # truncation bootstrap on the PRE-autoreset observation: the host
+            # loop's info["final_obs"] value pass, now a fused critic call
+            v_final = value_fn(params, {obs_key: out.obs})
+            reward = raw_reward + gamma * v_final[:, 0] * truncated_f
+            done = jnp.logical_or(out.terminated, out.truncated)
+
+            ep_ret = ep_ret + raw_reward
+            ep_len = ep_len + 1
+            ys = {
+                obs_key: obs,
+                "dones": done[:, None].astype(jnp.float32),
+                "values": values,
+                "actions": actions,
+                "logprobs": logprobs,
+                "rewards": reward[:, None],
+                "ep_done": done,
+                "ep_ret": ep_ret,
+                "ep_len": ep_len,
+            }
+
+            # SAME_STEP autoreset: done envs restart immediately; the stored
+            # transition keeps the terminal reward/done, the next step's obs
+            # comes from the fresh episode
+            reset_state = jax.vmap(spec.init)(pair[:, 1])
+
+            def _select(reset_leaf, next_leaf):
+                d = done.reshape(done.shape + (1,) * (next_leaf.ndim - 1))
+                return jnp.where(d, reset_leaf, next_leaf)
+
+            state = jax.tree.map(_select, reset_state, next_state)
+            ep_ret = jnp.where(done, 0.0, ep_ret)
+            ep_len = jnp.where(done, 0, ep_len)
+            return (state, ep_ret, ep_len, step_counter), ys
+
+        carry0 = (
+            env_carry["state"],
+            env_carry["ep_ret"],
+            env_carry["ep_len"],
+            policy_step,
+        )
+        (state, ep_ret, ep_len, _), ys = lax.scan(step_fn, carry0, None, length=rollout_steps)
+
+        ep_stats = {
+            "done": ys.pop("ep_done"),  # [T, E] bool
+            "ret": ys.pop("ep_ret"),  # [T, E] return-so-far at each step
+            "len": ys.pop("ep_len"),  # [T, E]
+        }
+        next_values = value_fn(params, {obs_key: jax.vmap(spec.observation)(state)})  # [E, 1]
+        returns, advantages = gae(
+            ys["rewards"], ys["values"], ys["dones"], next_values, gamma=gamma, gae_lambda=gae_lambda
+        )
+        data = dict(ys)
+        data["returns"] = returns
+        data["advantages"] = advantages
+        flat = jax.tree.map(lambda x: x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:]), data)
+
+        key, k_train = jax.random.split(key)
+        params, opt_state, metrics = local_train(params, opt_state, flat, k_train, clip_coef, ent_coef)
+        new_carry = {"state": state, "ep_ret": ep_ret, "ep_len": ep_len}
+        return params, opt_state, new_carry, key, metrics, ep_stats
+
+    if not use_mesh:
+        return jax.jit(superstep, donate_argnums=(1,))
+    carry_spec = P(data_axis)  # env-major leaves: shard axis 0 over devices
+    stats_spec = P(None, data_axis)  # [T, E] leaves: shard the env axis
+    wrapped = shard_map(
+        superstep,
+        mesh=mesh,
+        in_specs=(P(), P(), carry_spec, P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), carry_spec, P(), P(), stats_spec),
+    )
+    return jax.jit(wrapped, donate_argnums=(1,))

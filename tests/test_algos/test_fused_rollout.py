@@ -1,0 +1,321 @@
+"""Fused on-policy collection tests (`algo.fused_rollout` / `algo.overlap_collection`).
+
+Three layers:
+
+1. numerical: the ONE-dispatch superstep (`ops/rollout_scan.py`) must equal an
+   eager Python re-implementation of its contract (host-loop key schedule,
+   truncation bootstrap, SAME_STEP autoreset, GAE, fused update) on fp32 CPU;
+2. key schedule: the in-scan action stream is exactly the host
+   ``PPOPlayer.rollout_actions`` stream;
+3. integration: the CLI run really issues one train dispatch per update
+   (telemetry counters), and the overlap path really attributes train-wait
+   time (heartbeat + run-registry fields).
+
+The compile-heavy cases (eager-reference equivalence and the fused CLI runs)
+are marked ``slow``; tier-1 keeps the key-schedule, overlap-heartbeat, and
+jittable-env parity coverage.
+"""
+
+import json
+import os
+from functools import partial
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from sheeprl_tpu.algos.ppo.agent import PPOPlayer, build_agent, rollout_step
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.config.compose import compose, instantiate
+from sheeprl_tpu.envs.jittable import JaxCartPole
+from sheeprl_tpu.ops.math import gae
+from sheeprl_tpu.ops.rollout_scan import ENV_STREAM_SALT, init_env_carry, make_onpolicy_superstep_fn
+from sheeprl_tpu.utils.utils import dotdict
+
+T = 8
+NUM_ENVS = 4
+GAMMA = 0.99
+LAM = 0.95
+
+
+def _tiny_setup(tmp_path):
+    cfg = dotdict(
+        compose(
+            "config",
+            [
+                "exp=ppo",
+                "dry_run=True",
+                "fabric.devices=1",
+                "fabric.precision=fp32",
+                f"algo.rollout_steps={T}",
+                "algo.per_rank_batch_size=8",
+                "algo.update_epochs=2",
+                "algo.dense_units=8",
+                "algo.mlp_layers=1",
+                "algo.encoder.mlp_features_dim=8",
+                "algo.encoder.cnn_features_dim=16",
+                f"env.num_envs={NUM_ENVS}",
+                f"log_base_dir={tmp_path}/logs",
+            ],
+        )
+    )
+    fabric_cfg = dict(cfg.fabric.to_dict())
+    fabric_cfg.pop("callbacks", None)
+    fabric = instantiate({**fabric_cfg, "callbacks": []})
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-np.inf, np.inf, (4,), np.float32)})
+    agent, params = build_agent(fabric, (2,), False, cfg, obs_space, None)
+    tx = optax.adam(1e-3)
+    return cfg, fabric, agent, params, tx
+
+
+def _eager_update(agent, ref_train, params, opt_state, carry, update_key, key, step0):
+    """Plain-Python transliteration of the superstep contract: same
+    primitives in the same order, but one eager op at a time instead of one
+    scanned jit — an independent oracle for the fused program."""
+    spec = JaxCartPole
+    env_ids = jnp.arange(NUM_ENVS, dtype=jnp.uint32)
+    env_root = jax.random.fold_in(update_key, ENV_STREAM_SALT)
+    state, ep_ret, ep_len = carry["state"], carry["ep_ret"], carry["ep_len"]
+    counter = jnp.uint32(step0)
+    ys = []
+    for _ in range(T):
+        obs = jax.vmap(spec.observation)(state)
+        counter = counter + NUM_ENVS
+        k_act = jax.random.fold_in(update_key, counter)
+        actions, real_actions, logprobs, values = rollout_step(agent, params, {"state": obs}, k_act)
+        act = real_actions[..., 0].astype(jnp.int32)
+        env_base = jax.random.fold_in(env_root, counter)
+        per_env = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(env_base, env_ids)
+        pair = jax.vmap(jax.random.split)(per_env)
+        next_state, out = jax.vmap(spec.step)(state, act, pair[:, 0])
+        raw_reward = out.reward.astype(jnp.float32)
+        v_final = agent.apply(params, {"state": out.obs})[1]
+        reward = raw_reward + GAMMA * v_final[:, 0] * out.truncated.astype(jnp.float32)
+        done = jnp.logical_or(out.terminated, out.truncated)
+        ep_ret = ep_ret + raw_reward
+        ep_len = ep_len + 1
+        ys.append(
+            {
+                "state": obs,
+                "dones": done[:, None].astype(jnp.float32),
+                "values": values,
+                "actions": actions,
+                "logprobs": logprobs,
+                "rewards": reward[:, None],
+            }
+        )
+        reset_state = jax.vmap(spec.init)(pair[:, 1])
+        state = jax.tree.map(
+            lambda r, n: jnp.where(done.reshape(done.shape + (1,) * (n.ndim - 1)), r, n),
+            reset_state,
+            next_state,
+        )
+        ep_ret = jnp.where(done, 0.0, ep_ret)
+        ep_len = jnp.where(done, 0, ep_len)
+
+    data = {k: jnp.stack([y[k] for y in ys]) for k in ys[0]}
+    next_values = agent.apply(params, {"state": jax.vmap(spec.observation)(state)})[1]
+    returns, advantages = gae(
+        data["rewards"], data["values"], data["dones"], next_values, gamma=GAMMA, gae_lambda=LAM
+    )
+    data["returns"] = returns
+    data["advantages"] = advantages
+    flat = jax.tree.map(lambda x: x.reshape((T * NUM_ENVS,) + x.shape[2:]), data)
+    key, k_train = jax.random.split(key)
+    params, opt_state, metrics = ref_train(params, opt_state, flat, k_train, np.float32(0.2), np.float32(0.0))
+    return params, opt_state, {"state": state, "ep_ret": ep_ret, "ep_len": ep_len}, key, metrics
+
+
+@pytest.mark.slow
+def test_superstep_matches_eager_reference(tmp_path):
+    """Two full updates: fused superstep == eager oracle on params, opt
+    state, loss metrics, env carry and the evolved train key (fp32 CPU)."""
+    from sheeprl_tpu.algos.ppo.ppo import make_local_train
+
+    cfg, fabric, agent, params, tx = _tiny_setup(tmp_path)
+    cfg.algo.gamma = GAMMA
+    cfg.algo.gae_lambda = LAM
+    n_local = T * NUM_ENVS
+    local_train = make_local_train(fabric, agent, tx, cfg, ["state"], n_local, use_mesh=False)
+    superstep = make_onpolicy_superstep_fn(
+        JaxCartPole,
+        policy_fn=partial(rollout_step, agent),
+        value_fn=lambda p, o: agent.apply(p, o)[1],
+        local_train=local_train,
+        obs_key="state",
+        rollout_steps=T,
+        step_increment=NUM_ENVS,
+        gamma=GAMMA,
+        gae_lambda=LAM,
+    )
+    ref_train = jax.jit(local_train)
+
+    carry0 = init_env_carry(
+        JaxCartPole, NUM_ENVS, jax.random.fold_in(jax.random.PRNGKey(5), ENV_STREAM_SALT)
+    )
+    player_key = jax.random.fold_in(jax.random.PRNGKey(3), 1)
+
+    params_f = params_r = params
+    opt_f = tx.init(params)
+    opt_r = tx.init(params)
+    carry_f = carry_r = carry0
+    key_f = key_r = jax.random.PRNGKey(3)
+    step = 0
+    for update in (1, 2):
+        update_key = jax.random.fold_in(player_key, update)
+        params_f, opt_f, carry_f, key_f, metrics_f, ep_stats = superstep(
+            params_f, opt_f, carry_f, update_key, key_f, np.uint32(step), np.float32(0.2), np.float32(0.0)
+        )
+        params_r, opt_r, carry_r, key_r, metrics_r = _eager_update(
+            agent, ref_train, params_r, opt_r, carry_r, update_key, key_r, step
+        )
+        step += T * NUM_ENVS
+
+        assert np.array_equal(np.asarray(key_f), np.asarray(key_r)), "train key stream diverged"
+        np.testing.assert_allclose(np.asarray(metrics_f), np.asarray(metrics_r), rtol=1e-5, atol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5),
+            params_f,
+            params_r,
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+            ),
+            carry_f,
+            carry_r,
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5),
+            opt_f,
+            opt_r,
+        )
+        assert np.asarray(ep_stats["done"]).shape == (T, NUM_ENVS)
+
+
+def test_superstep_key_schedule_matches_player(tmp_path):
+    """The fused action stream is the host player's stream: for any step
+    counter, ``rollout_actions(obs, update_key, counter)`` ==
+    ``rollout_step(..., fold_in(update_key, counter))`` — the identity the
+    in-scan schedule is built on."""
+    _cfg, _fabric, agent, params, _tx = _tiny_setup(tmp_path)
+    player = PPOPlayer(agent, params)
+    rng = np.random.default_rng(0)
+    obs = {"state": rng.normal(size=(NUM_ENVS, 4)).astype(np.float32)}
+    update_key = jax.random.fold_in(jax.random.PRNGKey(3), 17)
+    for counter in (np.uint32(4), np.uint32(64), np.uint32(4096)):
+        from_player = player.rollout_actions(obs, update_key, counter)
+        from_schedule = rollout_step(agent, params, obs, jax.random.fold_in(update_key, counter))
+        for a, b in zip(from_player, from_schedule):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6)
+
+
+def _telemetry_events(tmp_path):
+    jsonls = []
+    for root, _, files in os.walk(tmp_path):
+        jsonls += [os.path.join(root, f) for f in files if f == "telemetry.jsonl"]
+    assert len(jsonls) == 1, f"expected exactly one telemetry.jsonl, found {jsonls}"
+    return [json.loads(line) for line in open(jsonls[0]) if line.strip()]
+
+
+def _fused_args(tmp_path):
+    return [
+        "exp=ppo",
+        "dry_run=True",
+        "fabric.devices=1",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "algo.rollout_steps=32",
+        "algo.per_rank_batch_size=8",
+        "algo.update_epochs=2",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.encoder.cnn_features_dim=16",
+        "algo.encoder.mlp_features_dim=8",
+        "env.num_envs=2",
+        "checkpoint.save_last=True",
+        "metric.log_level=1",
+        "metric.telemetry.enabled=True",
+        "metric.telemetry.poll_interval=0.0",
+        f"metric.telemetry.runs_jsonl={tmp_path}/RUNS.jsonl",
+        f"log_base_dir={tmp_path}/logs",
+    ]
+
+
+def _registry_records(tmp_path):
+    path = os.path.join(tmp_path, "RUNS.jsonl")
+    assert os.path.exists(path)
+    return [json.loads(line) for line in open(path) if line.strip()]
+
+
+@pytest.mark.slow
+def test_fused_cli_single_dispatch(tmp_path, monkeypatch):
+    """`algo.fused_rollout=True` end-to-end: the whole update is ONE device
+    program — telemetry must count train_dispatches == train_windows ==
+    num_updates with no fused_fallback, and the run must still checkpoint
+    and register with variant=fused_rollout (the regress-gate cell key)."""
+    monkeypatch.chdir(tmp_path)
+    run(_fused_args(tmp_path) + ["algo.fused_rollout=True"])
+
+    events = _telemetry_events(tmp_path)
+    assert "fused_fallback" not in {e["event"] for e in events}
+    (run_end,) = [e for e in events if e["event"] == "run_end"]
+    assert run_end["train_windows"] == 1  # dry_run: one update
+    assert run_end["train_dispatches"] == 1  # ...and ONE dispatch for it
+    assert run_end["fused_fallbacks"] == {}
+
+    ckpts = []
+    for root, _, files in os.walk(tmp_path):
+        ckpts += [f for f in files if f.endswith(".ckpt")]
+    assert ckpts
+
+    (rec,) = [r for r in _registry_records(tmp_path) if r.get("kind") == "train"]
+    assert rec.get("variant") == "fused_rollout"
+    assert rec["train_dispatches"] == 1
+
+
+@pytest.mark.slow
+def test_fused_cli_falls_back_without_jittable_twin(tmp_path, monkeypatch):
+    """An env with no jittable twin must warn-fallback to the host loop (and
+    say why), not crash: Acrobot-v1 has no twin, so the run completes with a
+    `jittable_env` fused_fallback breadcrumb and per-step host dispatches."""
+    monkeypatch.chdir(tmp_path)
+    run(_fused_args(tmp_path) + ["algo.fused_rollout=True", "env.id=Acrobot-v1"])
+    events = _telemetry_events(tmp_path)
+    fallbacks = [e for e in events if e["event"] == "fused_fallback"]
+    assert fallbacks and fallbacks[0]["reason"] == "jittable_env"
+    (run_end,) = [e for e in events if e["event"] == "run_end"]
+    assert run_end["train_dispatches"] > 1  # the host loop's per-step programs
+
+
+def test_overlap_cli_heartbeat_attribution(tmp_path, monkeypatch):
+    """`algo.overlap_collection=True`: from update 2 on, the blocking metrics
+    wait is attributed to Time/train_wait_time, so some heartbeat must carry
+    window_train_wait_time + overlap_fraction and the registry record the
+    cumulative train_wait_time / sps_end_to_end rollup."""
+    monkeypatch.chdir(tmp_path)
+    # 3 updates (64 policy-steps each) so at least one post-update-2 window
+    # records the wait; log_every=1 puts a heartbeat after every update
+    run(
+        _fused_args(tmp_path)
+        + [
+            "algo.overlap_collection=True",
+            "dry_run=False",
+            "algo.total_steps=192",
+            "metric.log_every=1",
+        ]
+    )
+    events = _telemetry_events(tmp_path)
+    waits = [e for e in events if e["event"] == "heartbeat" and "window_train_wait_time" in e]
+    assert waits, "no heartbeat recorded the overlap train-wait window"
+    assert all(0.0 <= hb["overlap_fraction"] <= 1.0 for hb in waits if "overlap_fraction" in hb)
+    assert any("overlap_fraction" in hb for hb in waits)
+
+    (rec,) = [r for r in _registry_records(tmp_path) if r.get("kind") == "train"]
+    assert rec.get("variant") == "overlap_collection"
+    assert rec["train_wait_time"] > 0
+    assert rec["sps_end_to_end"] > 0
+    assert 0.0 <= rec["overlap_fraction"] <= 1.0

@@ -1,0 +1,107 @@
+"""Jittable-env parity tests (ISSUE PR 10 tentpole).
+
+The fused on-policy superstep (``algo.fused_rollout``) replaces gymnasium's
+CartPole/Pendulum with the pure-functional twins in
+``sheeprl_tpu/envs/jittable.py`` — these tests pin the twins to the
+reference physics transition-by-transition, so a drift in constants or
+integration order fails here, not as a silent learning regression.
+"""
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs.jittable import JaxCartPole, JaxPendulum, get_jittable_env
+
+
+def test_registry_lookup():
+    assert get_jittable_env("CartPole-v1") is JaxCartPole
+    assert get_jittable_env("Pendulum-v1") is JaxPendulum
+    assert get_jittable_env("Acrobot-v1") is None
+
+
+def test_cartpole_transition_parity():
+    """Same state + action => same next obs / reward / terminated as
+    gymnasium, across random interior and near-threshold states."""
+    env = gym.make("CartPole-v1")
+    env.reset(seed=0)
+    step = jax.jit(JaxCartPole.step)
+    rng = np.random.default_rng(0)
+    states = list(rng.uniform(-0.05, 0.05, size=(100, 4)))
+    # near the termination thresholds: x = +-2.4, theta = +-12 degrees
+    states += [
+        np.array([2.39, 1.0, 0.0, 0.0]),
+        np.array([-2.39, -1.0, 0.0, 0.0]),
+        np.array([0.0, 0.0, 0.2094, 1.0]),
+        np.array([0.0, 0.0, -0.2094, -1.0]),
+    ]
+    for i, s in enumerate(states):
+        a = int(rng.integers(0, 2))
+        env.reset(seed=i)
+        env.unwrapped.state = np.asarray(s, np.float64)
+        obs_ref, reward_ref, term_ref, _trunc, _ = env.step(a)
+        state = {"y": jnp.asarray(s, jnp.float32), "t": jnp.int32(0)}
+        _next_state, out = step(state, jnp.int32(a), jax.random.PRNGKey(0))
+        np.testing.assert_allclose(np.asarray(out.obs), obs_ref, atol=1e-5)
+        assert bool(out.terminated) == bool(term_ref)
+        assert float(out.reward) == float(reward_ref)
+    env.close()
+
+
+def test_cartpole_truncation_at_500():
+    state = {"y": jnp.zeros((4,), jnp.float32), "t": jnp.int32(499)}
+    _, out = JaxCartPole.step(state, jnp.int32(0), jax.random.PRNGKey(0))
+    assert bool(out.truncated)
+    state = {"y": jnp.zeros((4,), jnp.float32), "t": jnp.int32(42)}
+    _, out = JaxCartPole.step(state, jnp.int32(0), jax.random.PRNGKey(0))
+    assert not bool(out.truncated)
+
+
+def test_cartpole_init_matches_gym_bounds():
+    keys = jax.random.split(jax.random.PRNGKey(0), 256)
+    states = jax.vmap(JaxCartPole.init)(keys)
+    y = np.asarray(states["y"])
+    assert y.shape == (256, 4)
+    assert np.all(np.abs(y) <= 0.05)
+    assert np.all(np.asarray(states["t"]) == 0)
+    # the reset stream actually varies
+    assert np.std(y) > 1e-3
+
+
+def test_pendulum_transition_parity():
+    env = gym.make("Pendulum-v1")
+    env.reset(seed=0)
+    step = jax.jit(JaxPendulum.step)
+    rng = np.random.default_rng(1)
+    for i in range(100):
+        th = rng.uniform(-np.pi, np.pi)
+        thdot = rng.uniform(-8.0, 8.0)
+        u = rng.uniform(-3.0, 3.0, size=1)  # out-of-range torque exercises the clip
+        env.reset(seed=i)
+        env.unwrapped.state = np.array([th, thdot])
+        obs_ref, reward_ref, _term, _trunc, _ = env.step(u.astype(np.float32))
+        state = {"y": jnp.asarray([th, thdot], jnp.float32), "t": jnp.int32(0)}
+        _ns, out = step(state, jnp.asarray(u, jnp.float32), jax.random.PRNGKey(0))
+        np.testing.assert_allclose(np.asarray(out.obs), obs_ref, atol=1e-4)
+        assert float(out.reward) == pytest.approx(float(reward_ref), abs=1e-3)
+        assert not bool(out.terminated)
+    env.close()
+
+
+def test_pendulum_truncation_at_200():
+    state = {"y": jnp.zeros((2,), jnp.float32), "t": jnp.int32(199)}
+    _, out = JaxPendulum.step(state, jnp.zeros((1,), jnp.float32), jax.random.PRNGKey(0))
+    assert bool(out.truncated)
+
+
+def test_spec_metadata():
+    assert JaxCartPole.obs_dim == 4 and JaxCartPole.action_dim == 2
+    assert not JaxCartPole.is_continuous
+    assert JaxPendulum.obs_dim == 3 and JaxPendulum.action_dim == 1
+    assert JaxPendulum.is_continuous
+    obs = JaxCartPole.observation(JaxCartPole.init(jax.random.PRNGKey(0)))
+    assert obs.shape == (4,)
+    obs = JaxPendulum.observation(JaxPendulum.init(jax.random.PRNGKey(0)))
+    assert obs.shape == (3,)

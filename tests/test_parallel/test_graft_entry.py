@@ -59,3 +59,6 @@ def test_dryrun_parent_never_imports_jax():
     assert "fused train step OK" in proc.stdout
     # the K=2 fused superstep window over the sharded ring compiled and ran
     assert "fused superstep OK" in proc.stdout
+    # the fused on-policy PPO superstep (scanned JaxCartPole rollout + GAE +
+    # fused update, envs sharded over the mesh) compiled and ran too
+    assert "fused on-policy PPO superstep OK" in proc.stdout

@@ -19,6 +19,7 @@ metric              better  source
 ==================  ======  =====================================
 sps_env             higher  heartbeat rollup (run-average)
 sps_train           higher  heartbeat rollup (run-average)
+sps_end_to_end      higher  heartbeat rollup (env steps / whole timed loop)
 mfu                 higher  last heartbeat MFU
 serve_qps           higher  serve run_end stats (``serve.stats.qps``)
 serve_p95_ms        lower   serve run_end stats (``serve.stats.p95_ms``)
@@ -60,6 +61,7 @@ DEFAULT_MIN_HISTORY = 2
 METRICS: Dict[str, Tuple[bool, float]] = {
     "sps_env": (True, 0.0),
     "sps_train": (True, 0.0),
+    "sps_end_to_end": (True, 0.0),
     "mfu": (True, 0.0),
     "serve_qps": (True, 0.0),
     "serve_p95_ms": (False, 0.0),
@@ -137,13 +139,28 @@ def cell_key(rec: Dict[str, Any]) -> str:
     devices = rec.get("local_device_count")
     procs = rec.get("process_count")
     topo = f"{backend}x{devices or '?'}p{procs or '?'}"
-    return f"{rec.get('kind', 'train')}:{rec.get('algo') or '?'}:{rec.get('env') or '?'}:{topo}"
+    key = f"{rec.get('kind', 'train')}:{rec.get('algo') or '?'}:{rec.get('env') or '?'}:{topo}"
+    # loop variants (fused_rollout, overlap_collection, floor stages) have
+    # their own throughput regime — gate them against their own history
+    variant = rec.get("variant")
+    if variant:
+        key += f":{variant}"
+    return key
 
 
 def record_metrics(rec: Dict[str, Any]) -> Dict[str, float]:
     """Extract the gated metrics present in one registry record."""
     out: Dict[str, float] = {}
-    for key in ("sps_env", "sps_train", "mfu", "worker_restarts", "masked_slots", "nan_rollbacks", "recompiles"):
+    for key in (
+        "sps_env",
+        "sps_train",
+        "sps_end_to_end",
+        "mfu",
+        "worker_restarts",
+        "masked_slots",
+        "nan_rollbacks",
+        "recompiles",
+    ):
         value = rec.get(key)
         if isinstance(value, (int, float)):
             out[key] = float(value)
@@ -317,14 +334,27 @@ def self_test() -> int:
         rec(5, "sac", 1.0, outcome="crashed"),
         # insufficient history: a single record
         rec(1, "dreamer_v3", 50.0),
+        # variant runs (fused_rollout etc.) gate against their OWN history,
+        # never against the base cell's — 3x the base SPS must not regress it
+        rec(1, "ppo", 320.0, variant="fused_rollout"),
+        rec(2, "ppo", 310.0, variant="fused_rollout"),
+        rec(3, "ppo", 315.0, variant="fused_rollout"),
     ]
     doc = evaluate(records)
-    got = {key.split(":")[1]: cell["verdict"] for key, cell in doc["cells"].items()}
+    got = {}
+    for key, cell in doc["cells"].items():
+        parts = key.split(":")
+        got[parts[1] if len(parts) == 4 else f"{parts[1]}:{parts[4]}"] = cell["verdict"]
     want = {"ppo": "pass", "sac": "regress", "dreamer_v3": "insufficient_history"}
     failures = [f"{k}: want {want[k]}, got {got.get(k)}" for k in want if got.get(k) != want[k]]
     sac = doc["cells"]["train:sac:CartPole-v1:cpux1p1"]
     if sac["newest_outcome"] != "completed":
         failures.append("crashed record selected as newest")
+    fused = doc["cells"].get("train:ppo:CartPole-v1:cpux1p1:fused_rollout")
+    if fused is None or fused["verdict"] != "pass" or fused["runs"] != 3:
+        failures.append(f"variant cell: want separate 3-run pass cell, got {fused}")
+    if doc["cells"]["train:ppo:CartPole-v1:cpux1p1"]["runs"] != 4:
+        failures.append("variant records leaked into the base cell history")
     if exit_code(doc) != 1:
         failures.append(f"exit code: want 1, got {exit_code(doc)}")
     if exit_code(evaluate([r for r in records if r["algo"] != "sac"])) != 0:
