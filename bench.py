@@ -306,12 +306,17 @@ def dispatch_stats(events_or_path) -> dict:
     )
     windows = dispatches = gradient_steps = 0
     fallbacks: dict = {}
+    slabs_admitted = dropped_stale = torn_slabs = 0
+    duty_cycle = None
     for e in events:
         if e.get("event") == "run_end":
             windows = int(e.get("train_windows", 0) or 0)
             dispatches = int(e.get("train_dispatches", 0) or 0)
             gradient_steps = int(e.get("train_gradient_steps", 0) or 0)
             fallbacks = dict(e.get("fused_fallbacks", {}) or {})
+            slabs_admitted = int(e.get("slabs_admitted", 0) or 0)
+            dropped_stale = int(e.get("dropped_stale_slabs", 0) or 0)
+            torn_slabs = int(e.get("torn_slabs", 0) or 0)
             break
     else:
         for e in events:
@@ -319,9 +324,18 @@ def dispatch_stats(events_or_path) -> dict:
                 windows += int(e.get("window_train_windows", 0) or 0)
                 dispatches += int(e.get("window_train_dispatches", 0) or 0)
                 gradient_steps += int(e.get("window_train_gradient_steps", 0) or 0)
+                slabs_admitted += int(e.get("window_slabs_admitted", 0) or 0)
+                dropped_stale += int(e.get("window_dropped_stale_slabs", 0) or 0)
+                torn_slabs = int(e.get("torn_slabs_total", torn_slabs) or 0)
             elif e.get("event") == "fused_fallback":
                 reason = str(e.get("reason", "<unknown>"))
                 fallbacks[reason] = fallbacks.get(reason, 0) + 1
+    # actor-learner learner duty cycle is a heartbeat-only field; the last
+    # heartbeat's value is the steady-state one either way
+    for e in reversed(events):
+        if e.get("event") == "heartbeat" and "learner_duty_cycle" in e:
+            duty_cycle = float(e["learner_duty_cycle"])
+            break
     out = {
         "train_windows": windows,
         "train_dispatches": dispatches,
@@ -335,6 +349,15 @@ def dispatch_stats(events_or_path) -> dict:
         # WHY a run dispatched per-step instead of fusing (ops/superstep.py
         # fused_fallback): reason -> count, e.g. {"host_buffer": 1}
         out["fused_fallbacks"] = fallbacks
+    if slabs_admitted or dropped_stale or torn_slabs:
+        # disaggregated actor-learner runs (howto/actor_learner.md): slab
+        # admission/drop/torn totals plus the learner's train-vs-starved
+        # duty cycle
+        out["slabs_admitted"] = slabs_admitted
+        out["dropped_stale_slabs"] = dropped_stale
+        out["torn_slabs"] = torn_slabs
+        if duty_cycle is not None:
+            out["learner_duty_cycle"] = round(duty_cycle, 4)
     return out
 
 
@@ -643,6 +666,44 @@ def bench_ppo_fused() -> dict:
     return rec
 
 
+def bench_ppo_actor_learner() -> dict:
+    """The disaggregated actor–learner PPO workload (exp=ppo_decoupled on a
+    single process, howto/actor_learner.md): supervised CPU actor processes
+    stream trajectory slabs through the shared-memory ring while the learner
+    trains continuously and broadcasts versioned params back. Same env count
+    and step budget as bench_ppo, so the three records (host loop, fused,
+    actor-learner) quantify the dispatch strategies directly. The CLI run
+    registers itself in RUNS.jsonl with variant=actor_learner — the regress
+    cell the acceptance gate watches (sps + overlap_fraction)."""
+    import tempfile
+
+    from sheeprl_tpu.cli import run
+
+    args = ["exp=ppo_decoupled" if a == "exp=ppo" else a for a in _ppo_args(PPO_STEPS)]
+    with tempfile.TemporaryDirectory() as d:
+        probe = os.path.join(d, "ppo_actor_learner_bench.json")
+        os.environ["SHEEPRL_TPU_BENCH_JSON"] = probe
+        try:
+            run(
+                args
+                + [
+                    "algo.per_rank_batch_size=512",
+                    # two actors overprovision collection, so slabs queue:
+                    # one slot each bounds the queue by backpressure instead
+                    # of staleness drops, and the admission bound covers the
+                    # full in-flight depth (one queued + one collecting per
+                    # actor) — see howto/actor_learner.md "Staleness"
+                    "algo.actor_learner.num_actors=2",
+                    "algo.actor_learner.slots_per_actor=1",
+                    "algo.actor_learner.max_staleness=3",
+                ]
+            )
+        finally:
+            os.environ.pop("SHEEPRL_TPU_BENCH_JSON", None)
+        rec = _read_probe(probe, "ppo_actor_learner")
+    return rec
+
+
 def bench_ppo_floor() -> dict:
     """The benchmarks/ppo_floor.py stage ladder as a bench workload: bare
     vector env -> noop policy -> jitted player -> player+bookkeeping. The
@@ -771,6 +832,7 @@ _WORKLOADS = {
     "dv3": bench_dv3,
     "ppo": bench_ppo,
     "ppo_fused": bench_ppo_fused,
+    "ppo_actor_learner": bench_ppo_actor_learner,
     "ppo_floor": bench_ppo_floor,
     "probe": lambda: link_probe(os.environ.get("SHEEPRL_TPU_BENCH_PROBE_TAG", "probe")),
 }

@@ -1,0 +1,53 @@
+"""Disaggregated actor–learner topology (single-host, multi-process).
+
+M supervised **actor** processes (each pinned to the CPU jax backend, owning
+an env slice + a jitted player) stream fixed-size trajectory slabs into a
+torn-write-safe shared-memory ring; the **learner** (this process, owning the
+accelerators) runs the donated fused PPO update continuously over
+staleness-admitted slabs and broadcasts versioned params back over a packed
+seqlock lane. See ``howto/actor_learner.md``.
+
+Module map:
+
+- :mod:`~sheeprl_tpu.actor_learner.ring` — the slab ring: per-slot seqlock
+  commit protocol (state word written last, checksum over the meta words), so
+  a writer death at ANY point is detected and skipped, never admitted.
+- :mod:`~sheeprl_tpu.actor_learner.param_lane` — single-writer versioned
+  param broadcast (classic seqlock: odd/even sequence around the payload).
+- :mod:`~sheeprl_tpu.actor_learner.actor` — the actor child process.
+- :mod:`~sheeprl_tpu.actor_learner.supervisor` — heartbeat supervision with
+  budgeted restarts + ring-slot reclaim (reuses ``rollout.supervisor``).
+- :mod:`~sheeprl_tpu.actor_learner.learner` — the admission/update loop.
+- :mod:`~sheeprl_tpu.actor_learner.config` — the ``algo.actor_learner`` node.
+- :mod:`~sheeprl_tpu.actor_learner.fault_injection` — deterministic chaos
+  drills (actor_crash_mid_write, actor_hang, learner_kill, param_lane_stall).
+"""
+
+from sheeprl_tpu.actor_learner.config import ActorLearnerConfig, actor_learner_config_from_cfg, admit
+from sheeprl_tpu.actor_learner.fault_injection import (
+    ALFaultSpec,
+    LearnerFaultSchedule,
+    actor_faults_for,
+    parse_al_fault_config,
+)
+from sheeprl_tpu.actor_learner.param_lane import LaneSpec, ParamLane
+from sheeprl_tpu.actor_learner.ring import RingSpec, SlabLayout, SlabMeta, TrajectoryRing
+from sheeprl_tpu.actor_learner.supervisor import ActorBudgetExhausted, ActorSupervisor
+
+__all__ = [
+    "ALFaultSpec",
+    "ActorBudgetExhausted",
+    "ActorLearnerConfig",
+    "ActorSupervisor",
+    "LaneSpec",
+    "LearnerFaultSchedule",
+    "ParamLane",
+    "RingSpec",
+    "SlabLayout",
+    "SlabMeta",
+    "TrajectoryRing",
+    "actor_faults_for",
+    "actor_learner_config_from_cfg",
+    "admit",
+    "parse_al_fault_config",
+]

@@ -1,0 +1,309 @@
+"""Actor child process: own env slice + jitted CPU player → trajectory slabs.
+
+Each actor is a fully self-contained collection loop: it builds its env slice
+(``envs_per_actor`` envs of the global vector arrangement, same per-env seed
+arithmetic as ``envs.factory.build_vector_env``), deterministically
+initializes the SAME agent as the learner (``build_agent`` inits from
+``cfg.seed``, so the ``_ParamStreamer`` wire format agrees by construction),
+and then loops: poll the param lane → collect ``rollout_steps`` env steps →
+GAE → flatten → write one slab into an owned ring slot → commit. The slab is
+a *complete training batch* — the learner's fused update consumes it without
+further shaping.
+
+TPU hygiene is inherited from the env-worker pool: the parent spawns under
+``rollout.supervisor._spawn_environ`` and ``actor_main`` re-applies
+``sanitize_worker_environ`` first thing, so the actor's jax is pinned to the
+CPU backend and can never initialize the TPU runtime or join the learner's
+process group.
+
+Protocol (pickled tuples over a duplex ``multiprocessing.Pipe``)::
+
+    parent -> actor                     actor -> parent
+    ----------------------------------------------------------------
+                                        ("ready",)
+    ("close",)                          ("bye",)
+
+Everything else — slabs out, params in — rides shared memory. Heartbeats go
+through the supervisor's lock-free double array after every env step, so the
+parent distinguishes a slow rollout from a wedged one exactly like the env
+pool does.
+
+Fault drills (see ``fault_injection``): ``actor_crash_mid_write`` dies via
+``os._exit`` after payload+meta but BEFORE the commit marker — the canonical
+torn write; ``actor_hang`` stops heartbeating before collecting a slab.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import traceback
+from typing import Any, Dict, List
+
+# step-counter salt between the actor action streams and the learner's train
+# key chain (same role as ops/rollout_scan.ENV_STREAM_SALT)
+ACTOR_KEY_SALT = 1009
+
+
+def actor_main(conn, hb, actor_index: int, blob: bytes) -> None:
+    """Child-process entrypoint (module-level: spawn pickles it by name)."""
+    from sheeprl_tpu.rollout.worker import sanitize_worker_environ
+
+    sanitize_worker_environ()
+    envs = None
+    ring = None
+    lane = None
+    try:
+        import cloudpickle
+
+        spec: Dict[str, Any] = cloudpickle.loads(blob)
+        cfg = spec["cfg"]
+        generation = int(spec["generation"])
+        slots: List[int] = list(spec["slots"])
+        envs_per_actor = int(spec["envs_per_actor"])
+        rollout_steps = int(spec["rollout_steps"])
+        faults = list(spec["faults"])  # wire dicts; empty after a restart
+
+        import gymnasium as gym
+        import jax
+        import numpy as np
+
+        from functools import partial
+
+        from sheeprl_tpu.actor_learner.param_lane import ParamLane
+        from sheeprl_tpu.actor_learner.ring import SlabLayout, TrajectoryRing
+        from sheeprl_tpu.algos.ppo.agent import PPOPlayer, build_agent
+        from sheeprl_tpu.algos.ppo.utils import prepare_obs
+        from sheeprl_tpu.envs.factory import make_env
+        from sheeprl_tpu.ops.math import gae
+        from sheeprl_tpu.parallel.fabric import Precision, _ParamStreamer
+
+        cpu = jax.local_devices(backend="cpu")[0]
+
+        class _CpuFabric:
+            precision = Precision(str(spec["precision"]))
+
+            @staticmethod
+            def replicate(tree):
+                return jax.device_put(tree, cpu)
+
+        # env slice: global vector indices [offset, offset+E); seed arithmetic
+        # identical to build_vector_env at rank 0, shifted by the restart
+        # generation so a respawned actor replays a deterministic (but fresh)
+        # seed stream — the rollout pool's _restart_seed discipline.
+        offset = actor_index * envs_per_actor
+        seed_shift = 7919 * generation
+        thunks = [
+            make_env(cfg, int(cfg.seed) + seed_shift + offset + i, 0, None, "train", vector_env_idx=offset + i)
+            for i in range(envs_per_actor)
+        ]
+        envs = gym.vector.SyncVectorEnv(thunks, autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
+
+        cnn_keys = list(cfg.algo.cnn_keys.encoder)
+        mlp_keys = list(cfg.algo.mlp_keys.encoder)
+        obs_keys = cnn_keys + mlp_keys
+        is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+        is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+        actions_dim = tuple(
+            envs.single_action_space.shape
+            if is_continuous
+            else (
+                envs.single_action_space.nvec.tolist()
+                if is_multidiscrete
+                else [envs.single_action_space.n]
+            )
+        )
+
+        # deterministic init from cfg.seed — bit-identical tree structure to
+        # the learner's, which is what makes the packed lane bytes decodable
+        agent, params = build_agent(_CpuFabric(), actions_dim, is_continuous, cfg, envs.single_observation_space)
+        player = PPOPlayer(agent, params, device=cpu)
+        streamer = _ParamStreamer(params, cpu)
+        gae_fn = jax.jit(partial(gae, gamma=float(cfg.algo.gamma), gae_lambda=float(cfg.algo.gae_lambda)))
+
+        ring = TrajectoryRing.attach(spec["ring"])
+        lane = ParamLane.attach(spec["lane"])
+        layout = SlabLayout.from_wire(spec["layout"])
+
+        hb[actor_index] = time.time()
+        conn.send(("ready",))
+
+        # wait for the first publish so every slab carries a real version
+        param_version = -1
+        while param_version < 0:
+            got = lane.poll()
+            if got is not None:
+                param_version, flat = got
+                player.update_params(streamer.finish(flat))
+            else:
+                hb[actor_index] = time.time()
+                time.sleep(0.01)
+            if conn.poll(0):
+                if conn.recv()[0] == "close":
+                    conn.send(("bye",))
+                    return
+
+        reset_seeds = [int(cfg.seed) + seed_shift + offset + i for i in range(envs_per_actor)]
+        next_obs, _ = envs.reset(seed=reset_seeds)
+        next_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=envs_per_actor)
+
+        player_key = jax.device_put(
+            jax.random.fold_in(jax.random.PRNGKey(int(cfg.seed)), ACTOR_KEY_SALT + actor_index), cpu
+        )
+        T, E = rollout_steps, envs_per_actor
+        # warm the player/GAE jits on the reset obs + zero buffers (results
+        # discarded, all purely functional) so the first slab's COLLECT_US
+        # stamps collection, not compile — compile is actor boot, like the
+        # spawn itself
+        jax.block_until_ready(player.rollout_actions(next_obs, player_key, 0))
+        nv = np.asarray(player.get_values(next_obs))
+        z = np.zeros((T, E, 1), np.float32)
+        jax.block_until_ready(gae_fn(z, z, z, nv))
+        hb[actor_index] = time.time()
+        store = {
+            k: np.zeros((T, E, *v.shape[1:]), dtype=v.dtype) for k, v in next_obs.items() if k in obs_keys
+        }
+        slab_seq = int(spec["start_seq"])
+        local_slab = 0  # within-generation counter; faults key off it
+        slot_cursor = 0
+        step_counter = 0
+
+        while True:
+            if conn.poll(0):
+                if conn.recv()[0] == "close":
+                    conn.send(("bye",))
+                    return
+
+            # refresh params between rollouts (never mid-rollout: a slab is
+            # collected against exactly one version)
+            if lane.version() > param_version:
+                got = lane.poll()
+                if got is not None and got[0] > param_version:
+                    param_version, flat = got
+                    player.update_params(streamer.finish(flat))
+
+            for fault in [f for f in faults if f["kind"] == "actor_hang" and f["at_slab"] == local_slab]:
+                # stop heartbeating: the supervisor's deadline must fire
+                deadline = time.time() + (float(fault.get("duration_s") or 0.0) or 3600.0)
+                while time.time() < deadline:
+                    time.sleep(0.05)
+
+            t0 = time.perf_counter()
+            update_key = jax.random.fold_in(player_key, slab_seq)
+            values_buf = np.zeros((T, E, 1), np.float32)
+            actions_buf = None
+            logprobs_buf = np.zeros((T, E, 1), np.float32)
+            rewards_buf = np.zeros((T, E, 1), np.float32)
+            dones_buf = np.zeros((T, E, 1), np.float32)
+            ep_ret_sum = ep_len_sum = ep_count = 0.0
+            for t in range(T):
+                step_counter += 1
+                actions, real_actions, logprobs, values = player.rollout_actions(
+                    next_obs, update_key, step_counter
+                )
+                actions_np, real_actions, logprobs_np, values_np = jax.device_get(
+                    (actions, real_actions, logprobs, values)
+                )
+                if not is_continuous and real_actions.shape[-1] == 1 and not is_multidiscrete:
+                    real_actions = real_actions[..., 0]
+                obs, rewards, terminated, truncated, info = envs.step(
+                    real_actions.reshape(envs.action_space.shape)
+                )
+                rewards = np.asarray(rewards, dtype=np.float32).reshape(E, 1)
+
+                truncated_envs = np.nonzero(truncated)[0]
+                if len(truncated_envs) > 0 and "final_obs" in info:
+                    final_obs = {
+                        k: np.stack([np.asarray(info["final_obs"][e][k]) for e in truncated_envs])
+                        for k in obs_keys
+                    }
+                    final_obs = prepare_obs(final_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
+                    vals = np.asarray(player.get_values(final_obs)).reshape(len(truncated_envs))
+                    rewards[truncated_envs, 0] += float(cfg.algo.gamma) * vals
+
+                for k in obs_keys:
+                    store[k][t] = next_obs[k]
+                dones_buf[t] = np.logical_or(terminated, truncated).reshape(E, 1).astype(np.float32)
+                values_buf[t] = values_np
+                logprobs_buf[t] = logprobs_np
+                rewards_buf[t] = rewards
+                if actions_buf is None:
+                    actions_buf = np.zeros((T, E, actions_np.shape[-1]), actions_np.dtype)
+                actions_buf[t] = actions_np
+
+                next_obs = prepare_obs(obs, cnn_keys=cnn_keys, num_envs=E)
+                if "final_info" in info:
+                    ep = info["final_info"].get("episode")
+                    if ep is not None:
+                        for i in np.nonzero(ep.get("_r", []))[0]:
+                            ep_ret_sum += float(ep["r"][i])
+                            ep_len_sum += float(ep["l"][i])
+                            ep_count += 1.0
+                hb[actor_index] = time.time()
+
+            next_values = np.asarray(player.get_values(next_obs))
+            returns, advantages = gae_fn(rewards_buf, values_buf, dones_buf, next_values)
+            flat = {k: store[k].reshape(T * E, *store[k].shape[2:]) for k in obs_keys}
+            flat["actions"] = actions_buf.reshape(T * E, -1)
+            flat["logprobs"] = logprobs_buf.reshape(T * E, 1)
+            flat["values"] = values_buf.reshape(T * E, 1)
+            flat["returns"] = np.asarray(returns).reshape(T * E, 1)
+            flat["advantages"] = np.asarray(advantages).reshape(T * E, 1)
+            flat["ep_stats"] = np.asarray([ep_ret_sum, ep_len_sum, ep_count], np.float32)
+            collect_us = int((time.perf_counter() - t0) * 1e6)
+
+            # acquire an owned slot (spin with heartbeats while the learner
+            # drains a full ring — backpressure, not an error)
+            slot = None
+            while slot is None:
+                for k in range(len(slots)):
+                    cand = slots[(slot_cursor + k) % len(slots)]
+                    if ring.try_begin_write(cand):
+                        slot = cand
+                        slot_cursor = (slot_cursor + k + 1) % len(slots)
+                        break
+                if slot is None:
+                    hb[actor_index] = time.time()
+                    if conn.poll(0.005):
+                        if conn.recv()[0] == "close":
+                            conn.send(("bye",))
+                            return
+
+            layout.pack_into(ring.payload_view(slot), flat)
+            ring.write_meta(
+                slot,
+                seq=slab_seq,
+                param_version=param_version,
+                actor_id=actor_index,
+                n_rows=T * E,
+                collect_us=collect_us,
+                env_steps=T * E,
+            )
+            if any(f["kind"] == "actor_crash_mid_write" and f["at_slab"] == local_slab for f in faults):
+                # the torn write: payload + meta are in place, the commit
+                # marker is NOT — and never will be. Skip atexit/finalizers;
+                # a SIGKILL-like death is what the reader must survive.
+                os._exit(13)
+            ring.commit(slot)
+            slab_seq += 1
+            local_slab += 1
+            hb[actor_index] = time.time()
+    except (EOFError, KeyboardInterrupt):
+        pass
+    except Exception:
+        try:
+            conn.send(("error", traceback.format_exc()))
+        except Exception:
+            pass
+        os._exit(1)
+    finally:
+        for closer in (ring, lane, envs):
+            if closer is not None:
+                try:
+                    closer.close()
+                except Exception:
+                    pass
+        try:
+            conn.close()
+        except Exception:
+            pass

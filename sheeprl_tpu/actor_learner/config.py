@@ -1,0 +1,100 @@
+"""Actor–learner topology knobs, parsed once from ``algo.actor_learner``.
+
+The node lives under ``algo`` (not top-level) because the topology is a
+property of the training algorithm — the decoupled PPO entrypoint reads it;
+CLI overrides read ``algo.actor_learner.max_staleness=0``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Mapping, Optional
+
+from sheeprl_tpu.actor_learner.fault_injection import ALFaultSpec, parse_al_fault_config
+from sheeprl_tpu.rollout.config import _get
+
+
+@dataclass
+class ActorLearnerConfig:
+    """Sizing, staleness and supervision parameters for the disaggregated
+    topology. Supervision attribute names deliberately match
+    :class:`~sheeprl_tpu.rollout.config.PoolConfig` so the actor supervisor
+    reuses ``rollout.supervisor`` machinery unchanged."""
+
+    num_actors: int = 2
+    slots_per_actor: int = 2
+    max_staleness: int = 1
+    poll_interval_s: float = 0.002
+    step_timeout_s: float = 120.0
+    spawn_timeout_s: float = 300.0
+    heartbeat_grace_s: Optional[float] = None  # default: step_timeout_s
+    max_restarts: int = 3
+    restart_refund_s: Optional[float] = 600.0
+    backoff_base_s: float = 0.5
+    backoff_max_s: float = 10.0
+    quiesce_timeout_s: float = 5.0
+    start_method: str = "spawn"
+    faults: List[ALFaultSpec] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if self.num_actors < 1:
+            raise ValueError(f"actor_learner.num_actors must be >= 1, got {self.num_actors}")
+        if self.slots_per_actor < 1:
+            raise ValueError(f"actor_learner.slots_per_actor must be >= 1, got {self.slots_per_actor}")
+        if self.max_staleness < 0:
+            raise ValueError(f"actor_learner.max_staleness must be >= 0, got {self.max_staleness}")
+
+    @property
+    def heartbeat_grace(self) -> float:
+        return self.step_timeout_s if self.heartbeat_grace_s is None else float(self.heartbeat_grace_s)
+
+    def envs_per_actor(self, num_envs: int) -> int:
+        if num_envs % self.num_actors != 0:
+            raise ValueError(
+                f"env.num_envs ({num_envs}) must be divisible by actor_learner.num_actors ({self.num_actors})"
+            )
+        return num_envs // self.num_actors
+
+    def actor_slots(self, actor: int) -> List[int]:
+        """The ring slot indices owned (single-writer) by ``actor``."""
+        base = int(actor) * self.slots_per_actor
+        return list(range(base, base + self.slots_per_actor))
+
+
+def actor_learner_config_from_cfg(cfg: Mapping[str, Any]) -> ActorLearnerConfig:
+    """Build from the composed run config's ``algo.actor_learner`` node
+    (absent node → all defaults, faults disabled)."""
+    algo = _get(cfg, "algo") or {}
+    node = _get(algo, "actor_learner") or {}
+    fault_node = _get(node, "fault_injection") or {}
+    faults: List[ALFaultSpec] = []
+    if bool(_get(fault_node, "enabled", False)):
+        faults = parse_al_fault_config(_get(fault_node, "faults") or [])
+    refund = _get(node, "restart_refund_s", 600.0)
+    return ActorLearnerConfig(
+        num_actors=int(_get(node, "num_actors", 2)),
+        slots_per_actor=int(_get(node, "slots_per_actor", 2)),
+        max_staleness=int(_get(node, "max_staleness", 1)),
+        poll_interval_s=float(_get(node, "poll_interval_s", 0.002)),
+        step_timeout_s=float(_get(node, "step_timeout_s", 120.0)),
+        spawn_timeout_s=float(_get(node, "spawn_timeout_s", 300.0)),
+        heartbeat_grace_s=_get(node, "heartbeat_grace_s", None),
+        max_restarts=int(_get(node, "max_restarts", 3)),
+        restart_refund_s=float(refund) if refund is not None else None,
+        backoff_base_s=float(_get(node, "backoff_base_s", 0.5)),
+        backoff_max_s=float(_get(node, "backoff_max_s", 10.0)),
+        quiesce_timeout_s=float(_get(node, "quiesce_timeout_s", 5.0)),
+        start_method=str(_get(node, "start_method", "spawn")),
+        faults=faults,
+    )
+
+
+def admit(slab_param_version: int, param_version: int, max_staleness: int) -> bool:
+    """The staleness-bounded admission predicate (the tentpole's contract):
+    a slab collected against params ``slab_param_version`` is trainable under
+    current ``param_version`` iff the gap is within ``max_staleness`` updates.
+    ``max_staleness=0`` admits only on-policy slabs; version -1 (an actor that
+    never saw a publish) is never admissible."""
+    if slab_param_version < 0:
+        return False
+    return (int(param_version) - int(slab_param_version)) <= int(max_staleness)

@@ -1,0 +1,115 @@
+"""Deterministic fault injection for the actor–learner topology.
+
+Actor faults are addressed by actor index and fire at that actor's *n*-th
+produced slab (0-based, lifetime counter across restarts): the spec rides the
+spawn blob, the actor checks its slab counter, and the parent never re-ships
+a fault that already fired (it strips delivered faults before a respawn), so
+every drill fires exactly once regardless of restarts. Learner faults fire at
+the learner's *n*-th admitted slab.
+
+Config shape (``algo.actor_learner.fault_injection``)::
+
+    algo:
+      actor_learner:
+        fault_injection:
+          enabled: true
+          faults:
+            - {kind: actor_crash_mid_write, actor: 0, at_slab: 2}
+            - {kind: actor_hang,            actor: 1, at_slab: 3, duration_s: 30}
+            - {kind: learner_kill,          at_slab: 4}
+            - {kind: param_lane_stall,      at_slab: 2, duration_s: 1.0}
+
+``kind``:
+- ``actor_crash_mid_write`` — the actor writes the slab payload + meta but
+  dies (``os._exit(13)``) *before* the commit marker: the canonical torn
+  write. The learner must skip the slot; the supervisor reclaims it on
+  restart and charges the budget.
+- ``actor_hang`` — the actor stops heartbeating and sleeps before producing
+  the slab; the supervisor's heartbeat deadline fires → kill + restart.
+- ``learner_kill`` — the learner SIGTERMs itself after admitting the slab:
+  exercises the resilience drain (emergency checkpoint, quiesce, distinct
+  exit code).
+- ``param_lane_stall`` — the learner skips publishing params for
+  ``duration_s`` seconds: actors keep sampling stale versions and the
+  staleness-admission path (count, drop, refill) is exercised end to end.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Mapping, Sequence
+
+ACTOR_KINDS = ("actor_crash_mid_write", "actor_hang")
+LEARNER_KINDS = ("learner_kill", "param_lane_stall")
+_KINDS = ACTOR_KINDS + LEARNER_KINDS
+
+
+@dataclass
+class ALFaultSpec:
+    kind: str
+    at_slab: int
+    actor: int = -1  # required for actor kinds, ignored for learner kinds
+    duration_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        self.kind = str(self.kind).lower()
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown actor_learner fault kind {self.kind!r}; expected one of {_KINDS}")
+        self.at_slab = int(self.at_slab)
+        self.actor = int(self.actor)
+        self.duration_s = float(self.duration_s)
+        if self.at_slab < 0:
+            raise ValueError(f"fault at_slab must be >= 0, got {self.at_slab}")
+        if self.kind in ACTOR_KINDS and self.actor < 0:
+            raise ValueError(f"fault kind {self.kind!r} needs an actor index >= 0")
+
+    @property
+    def is_actor_fault(self) -> bool:
+        return self.kind in ACTOR_KINDS
+
+    def to_wire(self) -> Dict[str, Any]:
+        """Plain-dict form shipped inside the actor spawn blob."""
+        return {"kind": self.kind, "at_slab": self.at_slab, "duration_s": self.duration_s}
+
+
+def parse_al_fault_config(node: Sequence[Mapping[str, Any]]) -> List[ALFaultSpec]:
+    faults = []
+    for i, entry in enumerate(node):
+        if not hasattr(entry, "get"):
+            raise ValueError(
+                f"actor_learner.fault_injection.faults[{i}] must be a mapping, got {entry!r}"
+            )
+        if "kind" not in entry or "at_slab" not in entry:
+            raise ValueError(
+                f"actor_learner.fault_injection.faults[{i}] needs kind/at_slab, got {dict(entry)!r}"
+            )
+        faults.append(
+            ALFaultSpec(
+                kind=entry["kind"],
+                at_slab=entry["at_slab"],
+                actor=int(entry.get("actor", -1)),
+                duration_s=float(entry.get("duration_s", 0.0) or 0.0),
+            )
+        )
+    return faults
+
+
+class LearnerFaultSchedule:
+    """Learner-side half of the drill script; popped per admitted slab."""
+
+    def __init__(self, faults: Sequence[ALFaultSpec]) -> None:
+        self._pending = sorted((f for f in faults if not f.is_actor_fault), key=lambda f: f.at_slab)
+
+    def __bool__(self) -> bool:
+        return bool(self._pending)
+
+    def pop_due(self, admitted: int) -> List[ALFaultSpec]:
+        """Faults due at (or before — nothing is silently dropped) the
+        ``admitted``-th admitted slab, marked fired."""
+        due = [f for f in self._pending if f.at_slab <= admitted]
+        self._pending = [f for f in self._pending if f.at_slab > admitted]
+        return due
+
+
+def actor_faults_for(faults: Sequence[ALFaultSpec], actor: int) -> List[ALFaultSpec]:
+    return [f for f in faults if f.is_actor_fault and f.actor == int(actor)]

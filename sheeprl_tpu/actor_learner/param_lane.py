@@ -1,0 +1,112 @@
+"""Versioned param broadcast lane (learner → actors), classic seqlock.
+
+One ``SharedMemory`` block: ``[seq, version, nbytes]`` int64 header + the
+``_ParamStreamer``-packed flat param bytes. The learner is the only writer;
+every actor reads. Seqlock protocol:
+
+writer: seq += 1 (odd) → payload + version → seq += 1 (even)
+reader: s1 = seq; even? → copy payload + version → s2 = seq; accept iff s1 == s2
+
+A reader that races a publish sees an odd ``seq`` or ``s1 != s2`` and simply
+keeps its current params — staleness is bounded by the *ring* admission check
+on the learner side, so a missed broadcast costs one dropped slab at worst,
+never a torn param read.
+
+The wire format is exactly ``parallel.fabric._ParamStreamer``'s packed byte
+vector. Both ends build their streamer from the same deterministically
+initialized agent (``build_agent`` inits from ``cfg.seed``), so treedef,
+shapes, dtypes and offsets agree without ever shipping a treedef across.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.rollout.shm import attach_untracked, create_untracked, unregister_owned_segment
+
+_SEQ, _VERSION, _NBYTES = 0, 1, 2
+_HEADER_WORDS = 4  # one word reserved
+_HEADER_BYTES = _HEADER_WORDS * 8
+
+
+@dataclass
+class LaneSpec:
+    name: str
+    nbytes: int
+
+
+class ParamLane:
+    def __init__(self, nbytes: int, *, spec: Optional[LaneSpec] = None) -> None:
+        self.nbytes = int(nbytes)
+        if spec is None:
+            self._block = create_untracked(_HEADER_BYTES + self.nbytes)
+            self._owner = True
+        else:
+            self._block = attach_untracked(spec.name)
+            self._owner = False
+        self._hdr = np.ndarray((_HEADER_WORDS,), dtype=np.int64, buffer=self._block.buf)
+        self._payload = np.ndarray(
+            (self.nbytes,), dtype=np.uint8, buffer=self._block.buf, offset=_HEADER_BYTES
+        )
+        if self._owner:
+            self._hdr[...] = 0
+            self._hdr[_VERSION] = -1  # nothing published yet
+            self._hdr[_NBYTES] = self.nbytes
+
+    def spec(self) -> LaneSpec:
+        return LaneSpec(name=self._block.name, nbytes=self.nbytes)
+
+    @classmethod
+    def attach(cls, spec: LaneSpec) -> "ParamLane":
+        return cls(spec.nbytes, spec=spec)
+
+    # ---------------------------------------------------------------- writer
+    def publish(self, flat: np.ndarray, version: int) -> None:
+        flat = np.asarray(flat, dtype=np.uint8).reshape(-1)
+        if flat.shape[0] != self.nbytes:
+            raise ValueError(f"param lane expects {self.nbytes} bytes, got {flat.shape[0]}")
+        self._hdr[_SEQ] += 1  # odd: write in progress
+        self._payload[...] = flat
+        self._hdr[_VERSION] = int(version)
+        self._hdr[_SEQ] += 1  # even: stable
+
+    # ---------------------------------------------------------------- reader
+    def version(self) -> int:
+        """Cheap peek at the published version (-1 before the first publish).
+        May be momentarily stale during a publish — callers poll."""
+        return int(self._hdr[_VERSION])
+
+    def poll(self) -> Optional[Tuple[int, np.ndarray]]:
+        """One seqlock read attempt: ``(version, bytes copy)`` or None when a
+        publish is in flight (retry next poll)."""
+        s1 = int(self._hdr[_SEQ])
+        if s1 % 2 == 1:
+            return None
+        version = int(self._hdr[_VERSION])
+        if version < 0:
+            return None
+        data = self._payload.copy()
+        if int(self._hdr[_SEQ]) != s1:
+            return None
+        return version, data
+
+    # -------------------------------------------------------------- teardown
+    def close(self) -> None:
+        self._hdr = None
+        self._payload = None
+        if self._block is None:
+            return
+        block, self._block = self._block, None
+        try:
+            block.close()
+        except Exception:
+            pass
+        if self._owner:
+            unregister_owned_segment(block.name)
+            try:
+                block.unlink()
+            except FileNotFoundError:
+                pass

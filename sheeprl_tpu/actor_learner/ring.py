@@ -1,0 +1,258 @@
+"""Torn-write-safe shared-memory trajectory ring (actor → learner transport).
+
+One ``SharedMemory`` block: a per-slot int64 header table followed by
+fixed-size payload slabs. Slots are **partitioned per actor** — each actor
+round-robins over its own slots, so every slot has exactly one writer and the
+learner is the only reader. That single-writer/single-reader discipline is
+what lets a seqlock-style commit protocol stand in for locks:
+
+writer (actor)                          reader (learner)
+--------------------------------------------------------------------------
+state = WRITING                         state != COMMITTED  -> skip
+payload[...] = slab bytes               state == COMMITTED:
+meta words (seq, version, rows, ...)        checksum over header words
+checksum over the meta words                mismatch -> torn, reclaim+count
+state = COMMITTED   <- written LAST         match    -> copy payload, FREE
+
+A crashed actor can die at any point of the left column. Death before the
+final ``state = COMMITTED`` store leaves the slot ``WRITING`` forever — the
+reader never admits it, and the supervisor reclaims it on restart
+(:meth:`TrajectoryRing.reclaim_actor_slots`, the "in-flight slab abandoned"
+path). The checksum is belt and braces for the one remaining hazard: a
+commit marker that lands over stale meta (e.g. a slot recycled across an
+actor generation), which surfaces as ``COMMITTED`` + checksum mismatch and
+is counted as torn rather than admitted.
+
+Aligned int64 stores are atomic on every platform jax runs on, so header
+words are never themselves torn; the protocol only has to order them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.rollout.shm import attach_untracked, create_untracked, unregister_owned_segment
+
+# header word indices
+STATE, SEQ, PARAM_VERSION, ACTOR_ID, N_ROWS, COLLECT_US, ENV_STEPS, CHECKSUM = range(8)
+HEADER_WORDS = 8
+_HEADER_BYTES = HEADER_WORDS * 8
+
+# slot states
+FREE, WRITING, COMMITTED = 0, 1, 2
+
+_MASK = (1 << 63) - 1
+_SALT = 0x9E3779B97F4A7C15 & _MASK
+
+
+def _checksum(words: Sequence[int]) -> int:
+    """Order-sensitive mix of the meta words (SEQ..ENV_STEPS)."""
+    acc = _SALT
+    for w in words:
+        acc = ((acc * 31) ^ (int(w) & _MASK)) & _MASK
+    return acc
+
+
+@dataclass
+class SlabMeta:
+    """Header snapshot of one committed slab."""
+
+    slot: int
+    seq: int
+    param_version: int
+    actor_id: int
+    n_rows: int
+    collect_us: int
+    env_steps: int
+
+
+@dataclass
+class RingSpec:
+    """Wire-format handle (std-picklable) an actor uses to attach."""
+
+    name: str
+    num_slots: int
+    payload_bytes: int
+
+
+class SlabLayout:
+    """Fixed dict-of-arrays ⇄ flat-bytes codec for one slab payload.
+
+    The same role ``_ParamStreamer`` plays for params, but host-side numpy:
+    both ends agree on ``(key, shape, dtype)`` per field, so a slab is one
+    contiguous byte write/read with zero per-field protocol."""
+
+    def __init__(self, fields: Dict[str, Tuple[Tuple[int, ...], str]]) -> None:
+        self.fields: Dict[str, Tuple[Tuple[int, ...], np.dtype]] = {
+            k: (tuple(int(d) for d in shape), np.dtype(dtype)) for k, (shape, dtype) in fields.items()
+        }
+        self.offsets: Dict[str, Tuple[int, int]] = {}
+        off = 0
+        for k, (shape, dtype) in self.fields.items():
+            nbytes = int(np.prod(shape)) * dtype.itemsize
+            self.offsets[k] = (off, off + nbytes)
+            off += nbytes
+        self.nbytes = off
+
+    def to_wire(self) -> List[Tuple[str, Tuple[int, ...], str]]:
+        return [(k, shape, dtype.str) for k, (shape, dtype) in self.fields.items()]
+
+    @classmethod
+    def from_wire(cls, wire: Sequence[Tuple[str, Tuple[int, ...], str]]) -> "SlabLayout":
+        return cls({k: (tuple(shape), dtype) for k, shape, dtype in wire})
+
+    def pack_into(self, buf: np.ndarray, data: Dict[str, np.ndarray]) -> None:
+        for k, (shape, dtype) in self.fields.items():
+            o0, o1 = self.offsets[k]
+            arr = np.ascontiguousarray(data[k], dtype=dtype)
+            if arr.shape != shape:
+                raise ValueError(f"slab field {k!r}: expected shape {shape}, got {arr.shape}")
+            buf[o0:o1] = arr.view(np.uint8).reshape(-1)
+
+    def unpack(self, buf: np.ndarray) -> Dict[str, np.ndarray]:
+        out = {}
+        for k, (shape, dtype) in self.fields.items():
+            o0, o1 = self.offsets[k]
+            out[k] = np.frombuffer(bytes(buf[o0:o1]), dtype=dtype).reshape(shape)
+        return out
+
+
+class TrajectoryRing:
+    """The shared slab ring. Learner constructs (owner), actors attach."""
+
+    def __init__(
+        self,
+        num_slots: int,
+        payload_bytes: int,
+        *,
+        spec: Optional[RingSpec] = None,
+    ) -> None:
+        self.num_slots = int(num_slots)
+        self.payload_bytes = int(payload_bytes)
+        total = self.num_slots * (_HEADER_BYTES + self.payload_bytes)
+        if spec is None:
+            self._block = create_untracked(total)
+            self._owner = True
+        else:
+            self._block = attach_untracked(spec.name)
+            self._owner = False
+        self._hdr = np.ndarray((self.num_slots, HEADER_WORDS), dtype=np.int64, buffer=self._block.buf)
+        self._payload = np.ndarray(
+            (self.num_slots, self.payload_bytes),
+            dtype=np.uint8,
+            buffer=self._block.buf,
+            offset=self.num_slots * _HEADER_BYTES,
+        )
+        if self._owner:
+            self._hdr[...] = 0  # all slots FREE
+        self.torn_detected = 0  # reader-side: COMMITTED with a bad checksum
+
+    # ------------------------------------------------------------------ wire
+    def spec(self) -> RingSpec:
+        return RingSpec(name=self._block.name, num_slots=self.num_slots, payload_bytes=self.payload_bytes)
+
+    @classmethod
+    def attach(cls, spec: RingSpec) -> "TrajectoryRing":
+        return cls(spec.num_slots, spec.payload_bytes, spec=spec)
+
+    # ---------------------------------------------------------------- writer
+    def try_begin_write(self, slot: int) -> bool:
+        """Claim ``slot`` for writing; False while the reader still owns it."""
+        if int(self._hdr[slot, STATE]) != FREE:
+            return False
+        self._hdr[slot, STATE] = WRITING
+        return True
+
+    def payload_view(self, slot: int) -> np.ndarray:
+        return self._payload[slot]
+
+    def write_meta(
+        self,
+        slot: int,
+        *,
+        seq: int,
+        param_version: int,
+        actor_id: int,
+        n_rows: int,
+        collect_us: int,
+        env_steps: int,
+    ) -> None:
+        """Meta + checksum; the slot is still ``WRITING`` after this — a death
+        here is exactly the torn write the reader must skip."""
+        hdr = self._hdr[slot]
+        hdr[SEQ] = seq
+        hdr[PARAM_VERSION] = param_version
+        hdr[ACTOR_ID] = actor_id
+        hdr[N_ROWS] = n_rows
+        hdr[COLLECT_US] = collect_us
+        hdr[ENV_STEPS] = env_steps
+        hdr[CHECKSUM] = _checksum(hdr[SEQ:CHECKSUM])
+
+    def commit(self, slot: int) -> None:
+        """The seqlock publish: the state word flips to COMMITTED strictly
+        after payload, meta and checksum are in place."""
+        self._hdr[slot, STATE] = COMMITTED
+
+    # ---------------------------------------------------------------- reader
+    def poll(self, slot: int) -> Optional[SlabMeta]:
+        """Admit-or-skip one slot. Returns the meta of a cleanly committed
+        slab (payload still in place — read it, then :meth:`release`), or
+        None for FREE/WRITING/torn slots. A torn COMMITTED slot (checksum
+        mismatch) is reclaimed to FREE and counted, never surfaced."""
+        hdr = self._hdr[slot]
+        if int(hdr[STATE]) != COMMITTED:
+            return None
+        if int(hdr[CHECKSUM]) != _checksum(hdr[SEQ:CHECKSUM]):
+            self.torn_detected += 1
+            hdr[STATE] = FREE
+            return None
+        return SlabMeta(
+            slot=slot,
+            seq=int(hdr[SEQ]),
+            param_version=int(hdr[PARAM_VERSION]),
+            actor_id=int(hdr[ACTOR_ID]),
+            n_rows=int(hdr[N_ROWS]),
+            collect_us=int(hdr[COLLECT_US]),
+            env_steps=int(hdr[ENV_STEPS]),
+        )
+
+    def release(self, slot: int) -> None:
+        self._hdr[slot, STATE] = FREE
+
+    def reclaim_actor_slots(self, slots: Sequence[int]) -> int:
+        """Free every non-COMMITTED slot of a dead actor (its in-flight slab
+        is abandoned by definition). Returns how many WRITING slots — i.e.
+        torn writes — were reclaimed. Committed slabs survive: they were
+        published before the crash and are still valid."""
+        torn = 0
+        for slot in slots:
+            state = int(self._hdr[slot, STATE])
+            if state == WRITING:
+                torn += 1
+                self._hdr[slot, STATE] = FREE
+        return torn
+
+    def occupancy(self) -> float:
+        """Fraction of slots holding a committed, unconsumed slab."""
+        return float(np.count_nonzero(self._hdr[:, STATE] == COMMITTED)) / max(1, self.num_slots)
+
+    # -------------------------------------------------------------- teardown
+    def close(self) -> None:
+        self._hdr = None
+        self._payload = None
+        if self._block is None:
+            return
+        block, self._block = self._block, None
+        try:
+            block.close()
+        except Exception:
+            pass
+        if self._owner:
+            unregister_owned_segment(block.name)
+            try:
+                block.unlink()
+            except FileNotFoundError:
+                pass

@@ -1,0 +1,152 @@
+"""Actor lifecycle: spawn, heartbeat-aware health checks, budgeted restarts,
+quiesce. The parent-side half of the topology's fault tolerance — a direct
+reuse of the env-pool supervision machinery (``rollout.supervisor``): the
+same ``RestartBudget`` healthy-window refund, the same heartbeat-extended
+deadlines, the same sanitized-environ spawn window.
+
+Differences from the env-pool supervisor:
+
+- actors are *push* producers (slabs ride the ring, not the pipe), so health
+  is checked by polling liveness+heartbeats (:meth:`check_health`) instead of
+  around a request/reply;
+- a restart first **reclaims the dead actor's ring slots** (the torn-write
+  check frees any slot stuck ``WRITING``) before respawning with a bumped
+  generation — the in-flight slab is abandoned by design and the fresh env
+  seeds are replayed deterministically from the generation counter;
+- budget exhaustion raises :class:`ActorBudgetExhausted` (the run aborts with
+  a distinct outcome) instead of masking: a masked env slot can serve zeros,
+  a masked actor would silently shrink the training batch distribution.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+from sheeprl_tpu.actor_learner.config import ActorLearnerConfig
+from sheeprl_tpu.actor_learner.ring import TrajectoryRing
+from sheeprl_tpu.rollout.supervisor import (
+    RestartBudget,
+    Supervisor,
+    WorkerDied,
+    WorkerHandle,
+    WorkerTimeout,
+    _spawn_environ,
+)
+
+
+class ActorBudgetExhausted(RuntimeError):
+    """An actor burnt through its restart budget — the topology cannot hold
+    its env-slice distribution, so the run aborts (outcome: actor_exhausted)."""
+
+    def __init__(self, actor: int, restarts: int) -> None:
+        super().__init__(f"actor {actor} exhausted its restart budget after {restarts} restarts")
+        self.actor = actor
+        self.restarts = restarts
+
+
+class ActorSupervisor(Supervisor):
+    """``rollout.supervisor.Supervisor`` with the actor spawn target and the
+    ring-reclaim restart path. Inherits ``wait_reply`` (heartbeat-extended
+    deadline), ``kill``, ``shutdown`` (graceful ("close",)→("bye",) then
+    kill), and ``backoff_s`` unchanged."""
+
+    def __init__(
+        self,
+        config: ActorLearnerConfig,
+        ring: TrajectoryRing,
+        make_blob: Callable[[int, int], bytes],
+        on_restart: Optional[Callable[[int, str, int], None]] = None,
+    ) -> None:
+        super().__init__(config, config.num_actors, on_restart=on_restart, on_mask=None)
+        self.ring = ring
+        self.make_blob = make_blob
+        self.generations: List[int] = [0] * config.num_actors
+        self.handles: List[WorkerHandle] = [
+            WorkerHandle(i, config.actor_slots(i), b"") for i in range(config.num_actors)
+        ]
+        self.torn_reclaimed = 0
+
+    # ------------------------------------------------------------- lifecycle
+    def launch(self, handle: WorkerHandle) -> None:
+        from sheeprl_tpu.actor_learner.actor import actor_main
+
+        if handle.budget is None:
+            handle.budget = RestartBudget(self.config.max_restarts, self.config.restart_refund_s)
+        handle.thunk_blob = self.make_blob(handle.index, self.generations[handle.index])
+        parent_conn, child_conn = self._ctx.Pipe(duplex=True)
+        proc = self._ctx.Process(
+            target=actor_main,
+            args=(child_conn, self.heartbeats, handle.index, handle.thunk_blob),
+            name=f"al-actor-{handle.index}",
+            daemon=True,
+        )
+        with _spawn_environ():
+            proc.start()
+        child_conn.close()
+        handle.proc = proc
+        handle.conn = parent_conn
+        self.heartbeats[handle.index] = time.time()
+
+    def handshake(self, handle: WorkerHandle) -> None:  # type: ignore[override]
+        reply = self.wait_reply(handle, timeout=self.config.spawn_timeout_s)
+        if reply[0] != "ready":
+            raise WorkerDied(handle.index, f"bad handshake: {reply[0]!r}")
+
+    def spawn_all(self) -> None:
+        # overlap the (jax-importing, slow) boots: start every actor before
+        # waiting on any handshake
+        for handle in self.handles:
+            self.launch(handle)
+        for handle in self.handles:
+            self.handshake(handle)
+
+    # ---------------------------------------------------------------- health
+    def check_health(self) -> None:
+        """One supervision pass: detect dead/wedged actors, restart within
+        budget. Called from the learner's admission loop — cheap when healthy
+        (a liveness flag and a timestamp compare per actor)."""
+        now = time.time()
+        for handle in self.handles:
+            if not handle.alive:
+                detail = f"exitcode={getattr(handle.proc, 'exitcode', None)}"
+                self._restart_or_raise(handle, WorkerDied(handle.index, detail))
+            elif now - self.heartbeats[handle.index] > self.config.heartbeat_grace:
+                self._restart_or_raise(
+                    handle, WorkerTimeout(handle.index, now - self.heartbeats[handle.index])
+                )
+
+    def _restart_or_raise(self, handle: WorkerHandle, reason: Exception) -> None:
+        if handle.budget is not None and handle.budget.exhausted:
+            self.kill(handle)
+            raise ActorBudgetExhausted(handle.index, handle.restarts)
+        self.restart_actor(handle, repr(reason))
+
+    # --------------------------------------------------------------- restart
+    def restart_actor(self, handle: WorkerHandle, reason: str) -> None:
+        """Kill + reclaim ring slots + backoff + respawn (bumped generation:
+        fresh deterministic env seeds, scripted faults NOT re-shipped)."""
+        self.kill(handle)
+        handle.restarts += 1
+        # the abandoned in-flight slab: any WRITING slot of this actor is by
+        # definition torn — free it so the ring never wedges on a dead writer
+        self.torn_reclaimed += self.ring.reclaim_actor_slots(handle.slots)
+        charge = handle.budget.charge() if handle.budget is not None else handle.restarts
+        if self.on_restart is not None:
+            self.on_restart(handle.index, reason, handle.restarts)
+        time.sleep(self.backoff_s(charge))
+        self.generations[handle.index] += 1
+        self.launch(handle)
+        self.handshake(handle)
+
+    # --------------------------------------------------------------- quiesce
+    def quiesce_all(self, timeout_s: Optional[float] = None) -> None:
+        """Explicit orderly stop for every actor: ("close",) → ("bye",) with
+        a deadline, then kill. Used by BOTH the normal teardown and the
+        learner's crash/SIGTERM drain — no orphaned actor processes."""
+        timeout = self.config.quiesce_timeout_s if timeout_s is None else float(timeout_s)
+        for handle in self.handles:
+            try:
+                self.shutdown(handle, timeout=timeout)
+            except Exception:
+                self.kill(handle)

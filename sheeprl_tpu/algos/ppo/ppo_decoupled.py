@@ -22,6 +22,13 @@ Both roles derive the number of updates and the checkpoint schedule from the
 same config, so no stop sentinel is needed (the reference scatters ``-1``,
 :463-484). Initial params are identical by construction — every process
 seeds the same ``PRNGKey`` — replacing the startup broadcast (:126-130).
+
+**Single-process dispatch:** without a ``jax.distributed`` process group the
+entrypoint decouples within the host instead — supervised actor
+subprocesses (CPU jax) stream trajectory slabs over a torn-write-safe
+shared-memory ring while this process trains continuously with
+staleness-bounded admission and a versioned param broadcast back
+(``sheeprl_tpu.actor_learner``, ``howto/actor_learner.md``).
 """
 
 from __future__ import annotations
@@ -80,14 +87,18 @@ def _ckpt_schedule(cfg, num_updates, policy_steps_per_update, start_update=1, la
 
 @register_algorithm(decoupled=True)
 def main(fabric, cfg: Dict[str, Any]):
-    if jax.process_count() < 2:
-        raise RuntimeError(
-            "ppo_decoupled requires at least 2 processes: one player and one or more trainers "
-            "(reference ppo_decoupled.py:627-631)"
-        )
     # every process reads the checkpoint itself (reference
     # ppo_decoupled.py:45-46,104-116: both roles restore from the same file)
     state = fabric.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+    if jax.process_count() < 2:
+        # no jax.distributed process group: decouple WITHIN the host instead —
+        # supervised actor subprocesses (CPU jax) stream trajectory slabs over
+        # a shared-memory ring while this process trains continuously
+        # (actor_learner package; lazy import keeps the multi-process roles
+        # free of the transport's dependencies)
+        from sheeprl_tpu.actor_learner.learner import run_actor_learner
+
+        return run_actor_learner(fabric, cfg, state)
     if jax.process_index() == 0:
         _player(fabric, cfg, state)
     else:

@@ -103,6 +103,24 @@ def _find_entry(algo_name: str) -> Optional[Dict[str, Any]]:
     return None
 
 
+def _is_actor_learner_run(cfg) -> bool:
+    """True when this process will take (or took) the in-host disaggregated
+    actor–learner path: a ppo *_decoupled entrypoint without a
+    jax.distributed process group (see ppo_decoupled.main's dispatch)."""
+    algo_cfg = cfg.get("algo") if hasattr(cfg, "get") else None
+    if algo_cfg is None:
+        return False
+    name = str(algo_cfg.get("name") or "")
+    if not (name.startswith("ppo") and name.endswith("_decoupled")):
+        return False
+    try:
+        import jax
+
+        return jax.process_count() < 2
+    except Exception:
+        return False
+
+
 def run_algorithm(cfg: dotdict) -> None:
     """Registry lookup → fabric build → entrypoint (reference cli.py:51-190)."""
     from sheeprl_tpu.utils.metric import MetricAggregator
@@ -197,7 +215,21 @@ def run_algorithm(cfg: dotdict) -> None:
         # resume_from=auto restarts from this boundary; the exception still
         # propagates. register_run reclassifies to rolled_back when the run
         # died after NaN rollbacks.
-        outcome, error = "crashed", repr(err)
+        # disaggregated-topology outcomes get their own registry classes: an
+        # actor that burnt its restart budget aborted the run without the
+        # learner itself failing, and any other crash in the actor_learner
+        # variant is the learner's
+        try:
+            from sheeprl_tpu.actor_learner.supervisor import ActorBudgetExhausted
+        except Exception:  # never mask the original crash
+            ActorBudgetExhausted = ()  # type: ignore[assignment]
+        if isinstance(err, ActorBudgetExhausted):
+            outcome = "actor_exhausted"
+        elif _is_actor_learner_run(cfg):
+            outcome = "learner_crashed"
+        else:
+            outcome = "crashed"
+        error = repr(err)
         if isinstance(err, Exception):
             from sheeprl_tpu.resilience import crash_drain
 
@@ -219,7 +251,9 @@ def run_algorithm(cfg: dotdict) -> None:
         variant = None
         algo_cfg = cfg.get("algo") if hasattr(cfg, "get") else None
         if algo_cfg is not None:
-            if algo_cfg.get("fused_rollout"):
+            if _is_actor_learner_run(cfg):
+                variant = "actor_learner"
+            elif algo_cfg.get("fused_rollout"):
                 variant = "fused_rollout"
             elif algo_cfg.get("overlap_collection"):
                 variant = "overlap_collection"

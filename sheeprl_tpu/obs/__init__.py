@@ -30,6 +30,7 @@ from sheeprl_tpu.obs.telemetry import (
     configure_telemetry,
     get_telemetry,
     shutdown_telemetry,
+    telemetry_actor_restart,
     telemetry_advance,
     telemetry_ckpt_commit,
     telemetry_ckpt_skipped,
@@ -47,6 +48,8 @@ from sheeprl_tpu.obs.telemetry import (
     telemetry_run_metrics,
     telemetry_serve_event,
     telemetry_serve_stats,
+    telemetry_slab,
+    telemetry_torn_slabs,
     telemetry_train_window,
     telemetry_worker_restart,
 )
@@ -64,6 +67,7 @@ __all__ = [
     "register_run",
     "shutdown_telemetry",
     "span",
+    "telemetry_actor_restart",
     "telemetry_advance",
     "telemetry_ckpt_commit",
     "telemetry_ckpt_skipped",
@@ -81,6 +85,8 @@ __all__ = [
     "telemetry_run_metrics",
     "telemetry_serve_event",
     "telemetry_serve_stats",
+    "telemetry_slab",
+    "telemetry_torn_slabs",
     "telemetry_train_window",
     "telemetry_worker_restart",
 ]

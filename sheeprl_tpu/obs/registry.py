@@ -6,7 +6,9 @@ subprocesses) appends ONE compact JSON record at run end: what ran (algo,
 env, config digest, git sha, topology), how it went (heartbeat rollup — SPS,
 MFU, duty cycle, HBM peak, recompiles, fused-dispatch and fallback counts,
 rollout restarts/masks, serve stats — plus final losses/returns) and how it
-ended (``completed | preempted | crashed | rolled_back``). The registry is
+ended (``completed | preempted | crashed | rolled_back`` — plus the
+disaggregated actor–learner outcomes ``actor_exhausted`` / ``learner_crashed``,
+see ``howto/actor_learner.md``). The registry is
 the memory the per-run ``telemetry.jsonl`` lacks: it survives the run
 directory and feeds the regression gates (``tools/regress.py``,
 ``bench.py --regress`` → ``SCENARIOS.json``).
@@ -39,7 +41,7 @@ from typing import Any, Dict, List, Mapping, Optional
 SCHEMA_VERSION = 1
 _ENV_VAR = "SHEEPRL_TPU_RUNS_JSONL"
 
-OUTCOMES = ("completed", "preempted", "crashed", "rolled_back")
+OUTCOMES = ("completed", "preempted", "crashed", "rolled_back", "actor_exhausted", "learner_crashed")
 
 
 # ------------------------------------------------------------------ paths ----

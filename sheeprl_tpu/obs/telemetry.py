@@ -186,6 +186,19 @@ class RunTelemetry:
         self._total_masked_slots = 0
         # why fused supersteps fell back to per-step dispatch (reason -> count)
         self._fused_fallbacks: Dict[str, int] = {}
+        # actor-learner accounting (sheeprl_tpu.actor_learner): staleness-
+        # bounded slab admission (histogram keyed by staleness-in-updates),
+        # dropped-stale/torn counters, ring occupancy samples, per-actor
+        # restart totals — heartbeat windows + run_end totals
+        self._window_slabs_admitted = 0
+        self._window_dropped_stale = 0
+        self._window_staleness_hist: Dict[str, int] = {}
+        self._window_ring_occupancy: list = []
+        self._total_slabs_admitted = 0
+        self._total_dropped_stale = 0
+        self._total_torn_slabs = 0
+        self._total_staleness_hist: Dict[str, int] = {}
+        self._actor_restarts: Dict[str, int] = {}
         # resilience accounting (sheeprl_tpu.resilience): committed/skipped
         # checkpoint saves, NaN rollbacks, preemption requests, auto-resume
         # fallbacks — events at each occurrence + run_end totals
@@ -209,8 +222,11 @@ class RunTelemetry:
         self._cum_train_time = 0.0
         # overlapped collection: time spent *blocked* on the previous async
         # train dispatch (Time/train_wait_time) — the overlap win is the gap
-        # between this and window_train_time
+        # between this and window_train_time. The flag records that the loop
+        # *measures* wait at all: a fully-hidden run legitimately reports
+        # zero wait, which is overlap_fraction == 1.0, not "no overlap data".
         self._cum_train_wait_time = 0.0
+        self._saw_train_wait = False
         self._last_mfu: Optional[float] = None
         self._last_train_flops_per_sec: Optional[float] = None
         self._final_metrics: Dict[str, float] = {}
@@ -301,6 +317,44 @@ class RunTelemetry:
         nslots = len(slots) if isinstance(slots, (list, tuple)) else 1
         self._total_masked_slots += nslots
         self.emit("masked_slot", worker=worker, slots=slots, reason=reason, **fields)
+        self.writer.flush()
+
+    def record_slab(self, *, staleness: int, occupancy: float, admitted: bool) -> None:
+        """One trajectory slab reached the learner's admission check:
+        ``staleness`` is ``param_version - slab.param_version`` in updates,
+        ``occupancy`` the ring's committed-slot fraction at poll time.
+        Per-slab events would be hot-path noise — this only feeds the
+        heartbeat window aggregates and run_end totals."""
+        key = str(int(staleness))
+        self._window_staleness_hist[key] = self._window_staleness_hist.get(key, 0) + 1
+        self._total_staleness_hist[key] = self._total_staleness_hist.get(key, 0) + 1
+        self._window_ring_occupancy.append(float(occupancy))
+        if admitted:
+            self._window_slabs_admitted += 1
+            self._total_slabs_admitted += 1
+        else:
+            self._window_dropped_stale += 1
+            self._total_dropped_stale += 1
+
+    def record_torn_slabs(self, count: int, source: str = "", **fields: Any) -> None:
+        """``count`` torn writes were detected and reclaimed (reader checksum
+        or supervisor restart sweep): one ``torn_slab`` event + run_end
+        counter. Rare by construction — the event is worth its cost."""
+        if count <= 0:
+            return
+        self._total_torn_slabs += int(count)
+        self.emit("torn_slab", count=int(count), source=source, **fields)
+        self.writer.flush()
+
+    def record_actor_restart(self, actor: int, reason: str, restarts: int, **fields: Any) -> None:
+        """A trajectory actor was restarted (crash, torn write, or heartbeat
+        timeout): one ``actor_restart`` event, the per-actor total for
+        heartbeats/run_end, and the shared worker_restarts counters (the
+        regress gate's restart budget covers both worker kinds)."""
+        self._actor_restarts[str(int(actor))] = int(restarts)
+        self._window_worker_restarts += 1
+        self._total_worker_restarts += 1
+        self.emit("actor_restart", actor=int(actor), reason=reason, restarts=int(restarts), **fields)
         self.writer.flush()
 
     def record_fused_fallback(self, reason: str, detail: str = "", **fields: Any) -> None:
@@ -547,6 +601,33 @@ class RunTelemetry:
         if self._total_masked_slots:
             fields["masked_slots_total"] = self._total_masked_slots
             scalars["Counters/masked_slots"] = float(self._total_masked_slots)
+        # actor-learner window: slab admission/staleness/ring health — only
+        # present when the disaggregated topology actually moved slabs
+        if self._window_staleness_hist or self._window_ring_occupancy:
+            fields["window_slabs_admitted"] = self._window_slabs_admitted
+            fields["window_dropped_stale_slabs"] = self._window_dropped_stale
+            fields["window_staleness_hist"] = dict(self._window_staleness_hist)
+            if self._window_ring_occupancy:
+                occ = sum(self._window_ring_occupancy) / len(self._window_ring_occupancy)
+                fields["ring_occupancy"] = occ
+                scalars["Telemetry/ring_occupancy"] = occ
+            if train_t + train_wait_t > 0:
+                # the learner's duty cycle: fraction of its loop spent
+                # training vs starved waiting for an admissible slab
+                fields["learner_duty_cycle"] = train_t / (train_t + train_wait_t)
+                scalars["Telemetry/learner_duty_cycle"] = fields["learner_duty_cycle"]
+            self._window_slabs_admitted = 0
+            self._window_dropped_stale = 0
+            self._window_staleness_hist = {}
+            self._window_ring_occupancy = []
+        if self._total_dropped_stale:
+            fields["dropped_stale_slabs_total"] = self._total_dropped_stale
+            scalars["Counters/dropped_stale_slabs"] = float(self._total_dropped_stale)
+        if self._total_torn_slabs:
+            fields["torn_slabs_total"] = self._total_torn_slabs
+            scalars["Counters/torn_slabs"] = float(self._total_torn_slabs)
+        if self._actor_restarts:
+            fields["actor_restarts"] = dict(self._actor_restarts)
         # checkpoint duty-cycle: only the snapshot span blocks the train loop
         # (the write happens on the background thread), so the heartbeat
         # reports them separately
@@ -578,6 +659,7 @@ class RunTelemetry:
             # span, train_wait_time the later block on its results — the env
             # loop ran in between, so the hidden fraction of the update cycle
             # is env / (env + wait).  1.0 = train fully hidden.
+            self._saw_train_wait = True
             fields["window_train_wait_time"] = train_wait_t
             scalars["Telemetry/train_wait_time"] = train_wait_t
             if env_t + train_wait_t > 0:
@@ -652,12 +734,23 @@ class RunTelemetry:
         loop_t = self._cum_env_time + self._cum_train_time + self._cum_train_wait_time
         if loop_t > 0 and self._cum_env_steps > 0:
             summary["sps_end_to_end"] = self._cum_env_steps / loop_t
-        if self._cum_train_wait_time > 0:
+        if self._saw_train_wait:
             summary["train_wait_time"] = self._cum_train_wait_time
             if self._cum_env_time + self._cum_train_wait_time > 0:
                 summary["overlap_fraction"] = self._cum_env_time / (
                     self._cum_env_time + self._cum_train_wait_time
                 )
+        if self._total_slabs_admitted or self._total_dropped_stale or self._total_torn_slabs:
+            summary["slabs_admitted"] = self._total_slabs_admitted
+            summary["dropped_stale_slabs"] = self._total_dropped_stale
+            summary["torn_slabs"] = self._total_torn_slabs
+            summary["staleness_hist"] = dict(self._total_staleness_hist)
+            if self._cum_train_time + self._cum_train_wait_time > 0:
+                summary["learner_duty_cycle"] = self._cum_train_time / (
+                    self._cum_train_time + self._cum_train_wait_time
+                )
+        if self._actor_restarts:
+            summary["actor_restarts"] = dict(self._actor_restarts)
         if self._flops_per_train_step is not None:
             summary["flops_per_train_step"] = self._flops_per_train_step
         if self._last_train_flops_per_sec is not None:
@@ -713,6 +806,11 @@ class RunTelemetry:
             worker_restarts=self._total_worker_restarts,
             masked_slots=self._total_masked_slots,
             fused_fallbacks=dict(self._fused_fallbacks),
+            slabs_admitted=self._total_slabs_admitted,
+            dropped_stale_slabs=self._total_dropped_stale,
+            torn_slabs=self._total_torn_slabs,
+            staleness_hist=dict(self._total_staleness_hist),
+            actor_restarts=dict(self._actor_restarts),
             ckpt_commits=self._total_ckpt_commits,
             ckpt_skipped=self._total_ckpt_skipped,
             nan_rollbacks=self._total_nan_rollbacks,
@@ -862,6 +960,30 @@ def telemetry_worker_restart(worker: int, reason: str, restarts: int, **fields: 
     tel = _active_telemetry
     if tel is not None:
         tel.record_worker_restart(worker, reason, restarts, **fields)
+
+
+def telemetry_slab(*, staleness: int, occupancy: float, admitted: bool) -> None:
+    """Record one ring-slab admission decision (see
+    :meth:`RunTelemetry.record_slab`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_slab(staleness=staleness, occupancy=occupancy, admitted=admitted)
+
+
+def telemetry_torn_slabs(count: int, source: str = "", **fields: Any) -> None:
+    """Record detected/reclaimed torn slabs (see
+    :meth:`RunTelemetry.record_torn_slabs`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_torn_slabs(count, source, **fields)
+
+
+def telemetry_actor_restart(actor: int, reason: str, restarts: int, **fields: Any) -> None:
+    """Record an actor-process restart (see
+    :meth:`RunTelemetry.record_actor_restart`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_actor_restart(actor, reason, restarts, **fields)
 
 
 def telemetry_fused_fallback(reason: str, detail: str = "", **fields: Any) -> None:

@@ -16,12 +16,58 @@ serves (and spawn children share the parent's tracker process, so a worker
 
 from __future__ import annotations
 
+import atexit
+import threading
 from dataclasses import dataclass
 from multiprocessing import shared_memory
 from typing import Dict, List, Tuple
 
 import gymnasium as gym
 import numpy as np
+
+# ---------------------------------------------------------------------------
+# Leak guard: owners register their segments; an atexit sweep unlinks anything
+# still registered when the process dies. Without this, a parent that crashes
+# between creating the blocks and tearing the pool down leaves named segments
+# in /dev/shm for the next run to collide with (and workers that die while
+# attaching leave dangling fds). ``close()`` paths unregister first, so the
+# sweep only ever fires for segments that would otherwise leak.
+# ---------------------------------------------------------------------------
+
+_OWNED_LOCK = threading.Lock()
+_OWNED_SEGMENTS: Dict[str, shared_memory.SharedMemory] = {}
+
+
+def register_owned_segment(block: shared_memory.SharedMemory) -> None:
+    """Record ``block`` (created by THIS process) for the atexit leak sweep."""
+    with _OWNED_LOCK:
+        _OWNED_SEGMENTS[block.name] = block
+
+
+def unregister_owned_segment(name: str) -> None:
+    with _OWNED_LOCK:
+        _OWNED_SEGMENTS.pop(name, None)
+
+
+def sweep_owned_segments() -> int:
+    """Unlink every still-registered segment; returns how many were swept.
+    Registered atexit, but callable directly (tests, emergency teardown)."""
+    with _OWNED_LOCK:
+        leaked = list(_OWNED_SEGMENTS.values())
+        _OWNED_SEGMENTS.clear()
+    for block in leaked:
+        try:
+            block.close()
+        except Exception:
+            pass
+        try:
+            block.unlink()
+        except Exception:
+            pass
+    return len(leaked)
+
+
+atexit.register(sweep_owned_segments)
 
 
 @dataclass
@@ -61,13 +107,18 @@ class ShmObsBuffers:
         self._blocks: Dict[str, shared_memory.SharedMemory] = {}
         self.views: Dict[str, np.ndarray] = {}
         self.specs: Dict[str, ShmSpec] = {}
-        for key, (shape, dtype) in obs_layout(single_observation_space, num_envs).items():
-            nbytes = max(1, int(np.prod(shape)) * dtype.itemsize)
-            block = shared_memory.SharedMemory(create=True, size=nbytes)
-            self._blocks[key] = block
-            self.views[key] = np.ndarray(shape, dtype=dtype, buffer=block.buf)
-            self.views[key][...] = 0
-            self.specs[key] = ShmSpec(name=block.name, shape=tuple(shape), dtype=dtype.str)
+        try:
+            for key, (shape, dtype) in obs_layout(single_observation_space, num_envs).items():
+                nbytes = max(1, int(np.prod(shape)) * dtype.itemsize)
+                block = shared_memory.SharedMemory(create=True, size=nbytes)
+                self._blocks[key] = block
+                register_owned_segment(block)
+                self.views[key] = np.ndarray(shape, dtype=dtype, buffer=block.buf)
+                self.views[key][...] = 0
+                self.specs[key] = ShmSpec(name=block.name, shape=tuple(shape), dtype=dtype.str)
+        except Exception:
+            self.close()
+            raise
 
     def read(self, copy: bool) -> Dict[str, np.ndarray]:
         if copy:
@@ -83,6 +134,7 @@ class ShmObsBuffers:
         # keeps memoryview references alive and SharedMemory.close() raises
         self.views = {}
         for block in self._blocks.values():
+            unregister_owned_segment(block.name)
             try:
                 block.close()
                 block.unlink()
@@ -97,10 +149,16 @@ class ShmSlotViews:
     def __init__(self, specs: Dict[str, ShmSpec]) -> None:
         self._blocks: List[shared_memory.SharedMemory] = []
         self._full: Dict[str, np.ndarray] = {}
-        for key, spec in specs.items():
-            block = _attach_untracked(spec.name)
-            self._blocks.append(block)
-            self._full[key] = np.ndarray(spec.shape, dtype=np.dtype(spec.dtype), buffer=block.buf)
+        try:
+            for key, spec in specs.items():
+                block = attach_untracked(spec.name)
+                self._blocks.append(block)
+                self._full[key] = np.ndarray(spec.shape, dtype=np.dtype(spec.dtype), buffer=block.buf)
+        except Exception:
+            # A half-attached worker (parent died mid-handshake, segment
+            # already unlinked) must not leak the blocks it DID map.
+            self.close()
+            raise
 
     def write(self, slot: int, obs: Dict[str, np.ndarray]) -> None:
         for key, view in self._full.items():
@@ -116,7 +174,16 @@ class ShmSlotViews:
         self._blocks = []
 
 
-def _attach_untracked(name: str) -> shared_memory.SharedMemory:
+def create_untracked(size: int) -> shared_memory.SharedMemory:
+    """Create a segment, registered for the atexit leak sweep. Owners that
+    tear down cleanly call ``unregister_owned_segment`` + ``unlink``; owners
+    that crash get swept."""
+    block = shared_memory.SharedMemory(create=True, size=max(1, int(size)))
+    register_owned_segment(block)
+    return block
+
+
+def attach_untracked(name: str) -> shared_memory.SharedMemory:
     """Attach to an existing segment without registering it for cleanup.
 
     CPython < 3.13 registers *every* ``SharedMemory`` instance with the
@@ -137,3 +204,7 @@ def _attach_untracked(name: str) -> shared_memory.SharedMemory:
             resource_tracker.register = original  # type: ignore[assignment]
     except Exception:
         return shared_memory.SharedMemory(name=name)
+
+
+# Backwards-compatible alias (pre-PR-11 internal name).
+_attach_untracked = attach_untracked

@@ -1,0 +1,185 @@
+"""actor_learner config node, the staleness-admission predicate (boundary
+cases per the ISSUE: max_staleness 0 and N), fault-spec parsing, and the
+evidence-engine hooks (registry outcomes, regress metric). Tier-1."""
+
+import importlib.util
+import os
+
+import pytest
+
+from sheeprl_tpu.actor_learner.config import ActorLearnerConfig, actor_learner_config_from_cfg, admit
+from sheeprl_tpu.actor_learner.fault_injection import (
+    ALFaultSpec,
+    LearnerFaultSchedule,
+    actor_faults_for,
+    parse_al_fault_config,
+)
+
+pytestmark = pytest.mark.actor_learner
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ------------------------------------------------------------------ admission
+
+
+def test_admit_max_staleness_zero_is_on_policy_only():
+    assert admit(5, 5, 0)
+    assert not admit(4, 5, 0)
+    assert not admit(0, 1, 0)
+
+
+def test_admit_max_staleness_n_boundary():
+    n = 3
+    assert admit(2, 5, n)  # gap == N: admitted
+    assert not admit(1, 5, n)  # gap == N+1: dropped
+    assert admit(5, 5, n)  # fresh always admitted
+    assert admit(7, 5, n)  # ahead-of-version (restart race) admitted
+
+
+def test_admit_unversioned_slab_never_admissible():
+    # version -1 = the actor never saw a publish; no staleness bound can
+    # make that trainable
+    assert not admit(-1, 0, 0)
+    assert not admit(-1, 1000, 10**9)
+
+
+# --------------------------------------------------------------- config node
+
+
+def test_config_defaults_from_empty_cfg():
+    alcfg = actor_learner_config_from_cfg({})
+    assert alcfg.num_actors == 2
+    assert alcfg.slots_per_actor == 2
+    assert alcfg.max_staleness == 1
+    assert alcfg.faults == []
+    assert alcfg.heartbeat_grace == alcfg.step_timeout_s  # grace defaults to the step deadline
+
+
+def test_config_parses_node_and_faults():
+    cfg = {
+        "algo": {
+            "actor_learner": {
+                "num_actors": 4,
+                "slots_per_actor": 1,
+                "max_staleness": 0,
+                "heartbeat_grace_s": 2.5,
+                "restart_refund_s": None,
+                "fault_injection": {
+                    "enabled": True,
+                    "faults": [
+                        {"kind": "actor_crash_mid_write", "actor": 1, "at_slab": 2},
+                        {"kind": "learner_kill", "at_slab": 3},
+                    ],
+                },
+            }
+        }
+    }
+    alcfg = actor_learner_config_from_cfg(cfg)
+    assert alcfg.num_actors == 4 and alcfg.max_staleness == 0
+    assert alcfg.heartbeat_grace == 2.5
+    assert alcfg.restart_refund_s is None
+    assert [f.kind for f in alcfg.faults] == ["actor_crash_mid_write", "learner_kill"]
+
+
+def test_config_faults_disabled_by_default():
+    cfg = {
+        "algo": {
+            "actor_learner": {
+                "fault_injection": {"faults": [{"kind": "learner_kill", "at_slab": 0}]}
+            }
+        }
+    }
+    assert actor_learner_config_from_cfg(cfg).faults == []  # enabled=False gates
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="num_actors"):
+        ActorLearnerConfig(num_actors=0)
+    with pytest.raises(ValueError, match="max_staleness"):
+        ActorLearnerConfig(max_staleness=-1)
+    with pytest.raises(ValueError, match="divisible"):
+        ActorLearnerConfig(num_actors=3).envs_per_actor(8)
+    assert ActorLearnerConfig(num_actors=4).envs_per_actor(8) == 2
+
+
+def test_actor_slots_partition_is_disjoint_and_total():
+    alcfg = ActorLearnerConfig(num_actors=3, slots_per_actor=2)
+    slots = [alcfg.actor_slots(a) for a in range(3)]
+    flat = [s for per in slots for s in per]
+    assert sorted(flat) == list(range(6))  # exactly the ring, no overlap
+
+
+# -------------------------------------------------------------------- faults
+
+
+def test_fault_spec_validation():
+    with pytest.raises(ValueError, match="unknown actor_learner fault kind"):
+        ALFaultSpec(kind="nope", at_slab=0)
+    with pytest.raises(ValueError, match="needs an actor index"):
+        ALFaultSpec(kind="actor_hang", at_slab=0)
+    with pytest.raises(ValueError, match="at_slab"):
+        ALFaultSpec(kind="learner_kill", at_slab=-1)
+    with pytest.raises(ValueError, match="kind/at_slab"):
+        parse_al_fault_config([{"kind": "learner_kill"}])
+    with pytest.raises(ValueError, match="must be a mapping"):
+        parse_al_fault_config(["learner_kill"])
+
+
+def test_learner_fault_schedule_pop_due():
+    faults = parse_al_fault_config(
+        [
+            {"kind": "param_lane_stall", "at_slab": 2, "duration_s": 1.0},
+            {"kind": "learner_kill", "at_slab": 5},
+            {"kind": "actor_hang", "actor": 0, "at_slab": 1},  # actor fault: not the learner's
+        ]
+    )
+    sched = LearnerFaultSchedule(faults)
+    assert bool(sched)
+    assert sched.pop_due(0) == []
+    due = sched.pop_due(3)  # at-or-before: a skipped boundary still fires
+    assert [f.kind for f in due] == ["param_lane_stall"]
+    assert sched.pop_due(3) == []  # fired once, never again
+    assert [f.kind for f in sched.pop_due(5)] == ["learner_kill"]
+    assert not sched
+
+
+def test_actor_faults_for_filters_by_actor():
+    faults = parse_al_fault_config(
+        [
+            {"kind": "actor_crash_mid_write", "actor": 0, "at_slab": 0},
+            {"kind": "actor_hang", "actor": 1, "at_slab": 0},
+            {"kind": "learner_kill", "at_slab": 0},
+        ]
+    )
+    assert [f.kind for f in actor_faults_for(faults, 0)] == ["actor_crash_mid_write"]
+    assert [f.kind for f in actor_faults_for(faults, 1)] == ["actor_hang"]
+    assert actor_faults_for(faults, 2) == []
+    # the wire form an actor receives carries no actor index (it's implicit)
+    assert ALFaultSpec(kind="actor_hang", actor=1, at_slab=3, duration_s=2.0).to_wire() == {
+        "kind": "actor_hang",
+        "at_slab": 3,
+        "duration_s": 2.0,
+    }
+
+
+# ---------------------------------------------------------- evidence plumbing
+
+
+def test_registry_knows_actor_learner_outcomes():
+    from sheeprl_tpu.obs.registry import OUTCOMES, build_run_record
+
+    assert {"actor_exhausted", "learner_crashed"} <= set(OUTCOMES)
+    rec = build_run_record(None, kind="train", outcome="actor_exhausted")
+    assert rec["outcome"] == "actor_exhausted"  # not coerced to "crashed"
+
+
+def test_regress_gates_overlap_fraction():
+    spec = importlib.util.spec_from_file_location(
+        "_regress_for_al_test", os.path.join(REPO, "tools", "regress.py")
+    )
+    regress = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(regress)
+    assert "overlap_fraction" in regress.METRICS
+    higher_better, slack = regress.METRICS["overlap_fraction"]
+    assert higher_better and slack == 0.0

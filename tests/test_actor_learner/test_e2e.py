@@ -1,0 +1,99 @@
+"""Single-process disaggregated actor–learner end-to-end: `exp=ppo_decoupled`
+without a jax.distributed group dispatches to run_actor_learner, spawns a real
+CPU actor process, trains over ring-delivered slabs, checkpoints, and lands a
+variant=actor_learner record in the run registry. One spawned actor (a jax
+import + jit warmup) keeps this inside the tier-1 budget."""
+
+import json
+import os
+
+import pytest
+
+from sheeprl_tpu.cli import run
+
+pytestmark = pytest.mark.actor_learner
+
+
+def al_args(tmp_path):
+    return [
+        "exp=ppo_decoupled",
+        "dry_run=True",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "algo.rollout_steps=32",
+        "algo.per_rank_batch_size=8",
+        "algo.update_epochs=2",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.encoder.cnn_features_dim=16",
+        "algo.encoder.mlp_features_dim=8",
+        "env.num_envs=2",
+        "algo.run_test=False",
+        "checkpoint.save_last=True",
+        "metric.log_level=1",
+        "algo.actor_learner.num_actors=1",
+        "algo.actor_learner.slots_per_actor=2",
+        f"log_base_dir={tmp_path}/logs",
+    ]
+
+
+def find_checkpoints(tmp_path):
+    ckpts = []
+    for root, _, files in os.walk(tmp_path):
+        ckpts += [os.path.join(root, f) for f in files if f.endswith(".ckpt")]
+    return ckpts
+
+
+def read_runs(path):
+    return [json.loads(line) for line in open(path) if line.strip()]
+
+
+def test_actor_learner_e2e(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    runs = tmp_path / "RUNS.jsonl"
+    run(
+        al_args(tmp_path)
+        + [
+            "metric.telemetry.enabled=True",
+            "metric.telemetry.poll_interval=0.0",
+            f"metric.telemetry.runs_jsonl={runs}",
+        ]
+    )
+
+    # the run checkpointed at its final update
+    assert find_checkpoints(tmp_path)
+
+    # zero leaked shm segments: ring + lane unlinked on the clean exit
+    from sheeprl_tpu.rollout.shm import _OWNED_SEGMENTS
+
+    assert not _OWNED_SEGMENTS
+
+    # zero orphaned actor processes
+    import multiprocessing as mp
+
+    assert not [p for p in mp.active_children() if p.name.startswith("al-actor")]
+
+    # the registry record: its own regress cell (variant) + the rollup the
+    # acceptance gate reads (slabs admitted, overlap_fraction present)
+    (rec,) = read_runs(runs)
+    assert rec["outcome"] == "completed"
+    assert rec["variant"] == "actor_learner"
+    assert rec["algo"] == "ppo_decoupled"
+    assert rec.get("slabs_admitted", 0) >= 1
+    assert rec.get("torn_slabs", 0) == 0
+    assert rec.get("dropped_stale_slabs", 0) == 0
+    assert "overlap_fraction" in rec
+    assert rec.get("staleness_hist")  # every admitted slab recorded its gap
+
+    # telemetry stream carries the topology heartbeat fields
+    jsonls = []
+    for root, _, files in os.walk(tmp_path):
+        jsonls += [os.path.join(root, f) for f in files if f == "telemetry.jsonl"]
+    assert len(jsonls) == 1
+    events = [json.loads(line) for line in open(jsonls[0]) if line.strip()]
+    heartbeats = [e for e in events if e["event"] == "heartbeat"]
+    assert heartbeats
+    assert any("window_slabs_admitted" in e for e in heartbeats)
+    assert any("learner_duty_cycle" in e for e in heartbeats)
+    (run_end,) = [e for e in events if e["event"] == "run_end"]
+    assert run_end.get("slabs_admitted", 0) >= 1

@@ -1,0 +1,203 @@
+"""Trajectory-ring transport units (ISSUE satellite: wrap-around parity vs a
+list-backed reference, torn-write injection, slot reclaim). Pure host-side
+numpy — no jax, no subprocesses — so these stay tier-1."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.actor_learner.ring import (
+    COMMITTED,
+    FREE,
+    PARAM_VERSION,
+    STATE,
+    WRITING,
+    SlabLayout,
+    TrajectoryRing,
+)
+
+pytestmark = pytest.mark.actor_learner
+
+
+def small_layout():
+    return SlabLayout({"state": ((4, 3), "float32"), "actions": ((4, 2), "float32")})
+
+
+def write_slab(ring, layout, slot, seq, payload, param_version=0, actor_id=0):
+    assert ring.try_begin_write(slot)
+    layout.pack_into(ring.payload_view(slot), payload)
+    ring.write_meta(
+        slot,
+        seq=seq,
+        param_version=param_version,
+        actor_id=actor_id,
+        n_rows=4,
+        collect_us=1000 + seq,
+        env_steps=4,
+    )
+    ring.commit(slot)
+
+
+def test_slab_layout_roundtrip_and_wire():
+    layout = small_layout()
+    rng = np.random.default_rng(0)
+    data = {
+        "state": rng.normal(size=(4, 3)).astype(np.float32),
+        "actions": rng.normal(size=(4, 2)).astype(np.float32),
+    }
+    buf = np.zeros(layout.nbytes, np.uint8)
+    layout.pack_into(buf, data)
+    out = layout.unpack(buf)
+    for k in data:
+        np.testing.assert_array_equal(out[k], data[k])
+
+    # the wire form rebuilds an identical codec (actor-side from_wire)
+    clone = SlabLayout.from_wire(layout.to_wire())
+    assert clone.offsets == layout.offsets and clone.nbytes == layout.nbytes
+    for k in data:
+        np.testing.assert_array_equal(clone.unpack(buf)[k], data[k])
+
+    # unpack COPIES out of the buffer: releasing/overwriting the slot after
+    # unpack must not corrupt an already-returned batch
+    buf[:] = 0
+    np.testing.assert_array_equal(out["state"], data["state"])
+
+
+def test_slab_layout_shape_mismatch_raises():
+    layout = small_layout()
+    buf = np.zeros(layout.nbytes, np.uint8)
+    with pytest.raises(ValueError, match="expected shape"):
+        layout.pack_into(buf, {"state": np.zeros((5, 3), np.float32), "actions": np.zeros((4, 2), np.float32)})
+
+
+def test_ring_wraparound_parity_vs_list_reference():
+    """Many rounds through a 2-slot ring must deliver exactly the slabs a
+    plain list-backed FIFO would: same seqs, same payload bytes, in order."""
+    layout = small_layout()
+    ring = TrajectoryRing(2, layout.nbytes)
+    try:
+        rng = np.random.default_rng(7)
+        reference = []  # the list-backed FIFO the ring must match
+        consumed = []
+        seq = 0
+        for _ in range(25):  # 50 slabs through 2 slots: heavy wrap-around
+            for slot in (0, 1):
+                payload = {
+                    "state": rng.normal(size=(4, 3)).astype(np.float32),
+                    "actions": rng.normal(size=(4, 2)).astype(np.float32),
+                }
+                write_slab(ring, layout, slot, seq, payload, param_version=seq // 2)
+                reference.append((seq, payload))
+                seq += 1
+            for slot in (0, 1):
+                meta = ring.poll(slot)
+                assert meta is not None
+                flat = layout.unpack(ring.payload_view(meta.slot))
+                ring.release(meta.slot)
+                assert meta.n_rows == 4 and meta.env_steps == 4
+                assert meta.collect_us == 1000 + meta.seq
+                assert meta.param_version == meta.seq // 2
+                consumed.append((meta.seq, flat))
+        assert [s for s, _ in consumed] == [s for s, _ in reference]
+        for (_, got), (_, want) in zip(consumed, reference):
+            for k in want:
+                np.testing.assert_array_equal(got[k], want[k])
+        assert ring.torn_detected == 0
+        assert ring.occupancy() == 0.0
+    finally:
+        ring.close()
+
+
+def test_torn_write_never_surfaced_and_reclaimed():
+    """A writer death between write_meta and commit leaves the slot WRITING:
+    poll must never admit it, and reclaim_actor_slots counts it as torn."""
+    layout = small_layout()
+    ring = TrajectoryRing(2, layout.nbytes)
+    try:
+        payload = {"state": np.ones((4, 3), np.float32), "actions": np.zeros((4, 2), np.float32)}
+        assert ring.try_begin_write(0)
+        layout.pack_into(ring.payload_view(0), payload)
+        ring.write_meta(0, seq=0, param_version=0, actor_id=0, n_rows=4, collect_us=1, env_steps=4)
+        # no commit — the canonical torn write (actor_crash_mid_write)
+        assert ring.poll(0) is None and ring.poll(1) is None
+        assert int(ring._hdr[0, STATE]) == WRITING
+        assert not ring.try_begin_write(0)  # a dead writer's claim holds...
+
+        torn = ring.reclaim_actor_slots([0, 1])  # ...until the supervisor reclaims
+        assert torn == 1
+        assert int(ring._hdr[0, STATE]) == FREE
+        assert ring.torn_detected == 0  # reader never even saw it
+
+        # the reclaimed slot is immediately writable again
+        write_slab(ring, layout, 0, seq=1, payload=payload)
+        meta = ring.poll(0)
+        assert meta is not None and meta.seq == 1
+        ring.release(0)
+    finally:
+        ring.close()
+
+
+def test_commit_over_tampered_meta_counted_torn():
+    """COMMITTED + checksum mismatch (commit marker over stale/corrupt meta)
+    is counted torn and freed, never returned."""
+    layout = small_layout()
+    ring = TrajectoryRing(1, layout.nbytes)
+    try:
+        payload = {"state": np.ones((4, 3), np.float32), "actions": np.zeros((4, 2), np.float32)}
+        write_slab(ring, layout, 0, seq=3, payload=payload)
+        ring._hdr[0, PARAM_VERSION] += 1  # corrupt a meta word after the checksum
+        assert ring.poll(0) is None
+        assert ring.torn_detected == 1
+        assert int(ring._hdr[0, STATE]) == FREE  # reclaimed for the writer
+    finally:
+        ring.close()
+
+
+def test_reclaim_preserves_committed_slabs():
+    """Restarting a crashed actor must NOT discard slabs it committed before
+    dying — they were published cleanly and are still valid batches."""
+    layout = small_layout()
+    ring = TrajectoryRing(2, layout.nbytes)
+    try:
+        payload = {"state": np.ones((4, 3), np.float32), "actions": np.zeros((4, 2), np.float32)}
+        write_slab(ring, layout, 0, seq=5, payload=payload)  # committed pre-crash
+        assert ring.try_begin_write(1)  # in-flight at crash time
+        assert ring.reclaim_actor_slots([0, 1]) == 1
+        meta = ring.poll(0)
+        assert meta is not None and meta.seq == 5
+        assert int(ring._hdr[1, STATE]) == FREE
+    finally:
+        ring.close()
+
+
+def test_attach_shares_the_segment():
+    """Writer-side attach (RingSpec) sees the owner's slots and vice versa —
+    the cross-process contract, exercised in one process via two handles."""
+    layout = small_layout()
+    ring = TrajectoryRing(2, layout.nbytes)
+    writer = TrajectoryRing.attach(ring.spec())
+    try:
+        payload = {"state": np.full((4, 3), 2.0, np.float32), "actions": np.zeros((4, 2), np.float32)}
+        write_slab(writer, layout, 1, seq=9, payload=payload)
+        meta = ring.poll(1)
+        assert meta is not None and meta.seq == 9 and meta.slot == 1
+        got = layout.unpack(ring.payload_view(1))
+        np.testing.assert_array_equal(got["state"], payload["state"])
+        ring.release(1)
+        assert int(writer._hdr[1, STATE]) == FREE  # release is visible to the writer
+        assert writer.occupancy() == 0.0
+    finally:
+        writer.close()
+        ring.close()
+
+
+def test_occupancy_counts_committed_only():
+    layout = small_layout()
+    ring = TrajectoryRing(4, layout.nbytes)
+    try:
+        payload = {"state": np.zeros((4, 3), np.float32), "actions": np.zeros((4, 2), np.float32)}
+        write_slab(ring, layout, 0, seq=0, payload=payload)
+        assert ring.try_begin_write(1)  # WRITING doesn't count
+        assert ring.occupancy() == pytest.approx(0.25)
+        assert int(ring._hdr[0, STATE]) == COMMITTED
+    finally:
+        ring.close()

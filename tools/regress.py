@@ -20,6 +20,7 @@ metric              better  source
 sps_env             higher  heartbeat rollup (run-average)
 sps_train           higher  heartbeat rollup (run-average)
 sps_end_to_end      higher  heartbeat rollup (env steps / whole timed loop)
+overlap_fraction    higher  heartbeat rollup (env time hidden behind train)
 mfu                 higher  last heartbeat MFU
 serve_qps           higher  serve run_end stats (``serve.stats.qps``)
 serve_p95_ms        lower   serve run_end stats (``serve.stats.p95_ms``)
@@ -62,6 +63,7 @@ METRICS: Dict[str, Tuple[bool, float]] = {
     "sps_env": (True, 0.0),
     "sps_train": (True, 0.0),
     "sps_end_to_end": (True, 0.0),
+    "overlap_fraction": (True, 0.0),
     "mfu": (True, 0.0),
     "serve_qps": (True, 0.0),
     "serve_p95_ms": (False, 0.0),
@@ -155,6 +157,7 @@ def record_metrics(rec: Dict[str, Any]) -> Dict[str, float]:
         "sps_env",
         "sps_train",
         "sps_end_to_end",
+        "overlap_fraction",
         "mfu",
         "worker_restarts",
         "masked_slots",
