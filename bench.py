@@ -531,6 +531,126 @@ def resilience_stats(events_or_path) -> dict:
     return out
 
 
+def _slo_goodput(stats: dict):
+    """``qps@p95`` for one serve snapshot: completed QPS while p95 <= SLO,
+    else 0.0; a ramp report's ``max_good_qps`` already encodes the
+    conditioning. Mirrors ``tools/regress.py slo_goodput`` (kept local so
+    this parent stays importable without the tools package on sys.path)."""
+    report = stats.get("load_report")
+    if isinstance(report, dict):
+        if report.get("mode") == "ramp":
+            value = report.get("max_good_qps")
+            return float(value) if isinstance(value, (int, float)) else None
+        qps, p95, slo = report.get("qps"), report.get("p95_ms"), report.get("slo_ms")
+        if isinstance(qps, (int, float)):
+            met = isinstance(p95, (int, float)) and isinstance(slo, (int, float)) and p95 <= slo
+            return float(qps) if met else 0.0
+    qps, p95, slo = stats.get("qps"), stats.get("p95_ms"), stats.get("slo_ms")
+    if isinstance(qps, (int, float)) and isinstance(p95, (int, float)) and isinstance(slo, (int, float)):
+        return float(qps) if p95 <= slo else 0.0
+    return None
+
+
+def _record_serve_section(rec: dict) -> dict:
+    """A registry record's serve snapshot: the telemetry ``serve.stats``
+    section when the run had telemetry, else the raw ``serve_stats`` extra
+    ``cli_serve`` attaches (same fallback order as tools/regress.py)."""
+    serve = rec.get("serve")
+    if isinstance(serve, dict) and isinstance(serve.get("stats"), dict):
+        return serve["stats"]
+    if isinstance(rec.get("serve_stats"), dict):
+        return rec["serve_stats"]
+    return {}
+
+
+_REPLICA_ROW_KEYS = (
+    "index", "kind", "device", "active", "alive", "masked", "retiring",
+    "restarts", "health", "depth", "outstanding", "requests", "failures",
+)
+
+_ROUTER_COUNTER_KEYS = (
+    "routed", "shed", "hedged", "hedged_won", "rerouted_requests", "blackholed", "spilled",
+)
+
+
+def serve_registry_stats(records) -> dict:
+    """Aggregate EVERY ``kind=serve`` record in a RUNS.jsonl registry —
+    one row per serve run (QPS, p95 vs SLO, sheds, ``qps@p95`` goodput),
+    per-replica rows lifted from each fleet snapshot, and a fleet rollup
+    (scale events, summed router counters, best goodput). A fleet
+    acceptance sweep registers several serve runs back-to-back; digesting
+    only the newest record — the old behaviour — hid every earlier run."""
+    serve_recs = [r for r in records if r.get("kind") == "serve"]
+    if not serve_recs:
+        return {
+            "error": (
+                "no serve records in this registry (kind=serve). Serve sessions append "
+                "one on exit via register_run; run `python -m sheeprl_tpu serve ...` "
+                "first (see howto/serving.md)"
+            )
+        }
+    rows: list = []
+    replica_rows: list = []
+    fleet_sections: list = []
+    for idx, rec in enumerate(serve_recs):
+        stats = _record_serve_section(rec)
+        row: dict = {
+            "record": idx,
+            "t": rec.get("t"),
+            "algo": rec.get("algo"),
+            "env": rec.get("env"),
+            "variant": rec.get("variant"),
+            "outcome": rec.get("outcome"),
+        }
+        for k in ("qps", "p50_ms", "p95_ms", "slo_ms", "completed",
+                  "shed_overloaded", "shed_expired", "failed"):
+            if isinstance(stats.get(k), (int, float)):
+                row[k] = stats[k]
+        goodput = _slo_goodput(stats)
+        if goodput is not None:
+            row["qps@p95"] = goodput
+        report = stats.get("load_report")
+        if isinstance(report, dict) and report.get("mode") == "ramp":
+            row["knee_rate_hz"] = report.get("knee_rate_hz")
+            row["max_good_qps"] = report.get("max_good_qps")
+        fleet = stats.get("fleet")
+        if isinstance(fleet, dict):
+            fleet_sections.append((idx, fleet, goodput))
+            for rep in fleet.get("replicas") or []:
+                if isinstance(rep, dict):
+                    replica_rows.append(
+                        {"record": idx, **{k: rep[k] for k in _REPLICA_ROW_KEYS if k in rep}}
+                    )
+        rows.append(row)
+    out: dict = {"source": "runs_registry", "serve_records": len(serve_recs), "records": rows}
+    if fleet_sections:
+        newest = fleet_sections[-1][1]
+        router_totals = {k: 0 for k in _ROUTER_COUNTER_KEYS}
+        for _, fleet, _ in fleet_sections:
+            router = fleet.get("router") or {}
+            for k in _ROUTER_COUNTER_KEYS:
+                if isinstance(router.get(k), (int, float)):
+                    router_totals[k] += int(router[k])
+        goodputs = [g for _, _, g in fleet_sections if isinstance(g, (int, float))]
+        out["fleet"] = {
+            "rollup": {
+                "fleet_records": len(fleet_sections),
+                "active_device_replicas": newest.get("active_device_replicas"),
+                "cpu_spill_replicas": newest.get("cpu_spill_replicas"),
+                "scale_ups": sum(
+                    int(f.get("scale_ups", 0) or 0) for _, f, _ in fleet_sections
+                ),
+                "scale_downs": sum(
+                    int(f.get("scale_downs", 0) or 0) for _, f, _ in fleet_sections
+                ),
+                "router": router_totals,
+                **({"best_qps@p95": max(goodputs)} if goodputs else {}),
+            },
+            "replicas": replica_rows,
+        }
+    return out
+
+
 def serve_stats(events_or_path) -> dict:
     """Policy-serving health from a serve session's telemetry stream
     (sheeprl_tpu/serve, howto/serving.md): sustained QPS, p50/p95 end-to-end
@@ -538,14 +658,22 @@ def serve_stats(events_or_path) -> dict:
     deadline expiries), replica restarts/masks, swap promotions/rejections
     and the load-generator report when one ran. Totals prefer the run_end
     ``serve`` section, falling back to the last ``serve_stats`` event for a
-    still-running server. Degrades with a targeted ``error`` key — not a
-    traceback — when the stream has no serve telemetry at all."""
+    still-running server. Also accepts a RUNS.jsonl run registry (lines with
+    ``kind`` instead of ``event``) and then aggregates across ALL serve
+    records — see :func:`serve_registry_stats`. Degrades with a targeted
+    ``error`` key — not a traceback — when the stream has no serve telemetry
+    at all."""
     try:
         events = (
             read_telemetry(events_or_path) if isinstance(events_or_path, str) else list(events_or_path)
         )
     except OSError as e:
         return {"error": f"cannot read telemetry stream: {e}"}
+
+    # a run registry instead of a telemetry stream: registry records carry
+    # ``kind`` (train/eval/serve/...) and never ``event``
+    if events and not any("event" in e for e in events) and any("kind" in e for e in events):
+        return serve_registry_stats(events)
 
     snapshots = [e for e in events if e.get("event") == "serve_stats"]
     serve_events = [e for e in events if e.get("event") == "serve_event"]
@@ -1090,7 +1218,9 @@ if __name__ == "__main__":
         metavar="PATH",
         help="report policy-serving health from a serve session's telemetry.jsonl "
         "(QPS, p50/p95 vs SLO, queue depth, shed counts, replica restarts/masks, "
-        "swap promotions/rejections, load-generator report) and exit",
+        "swap promotions/rejections, load-generator report) and exit; also accepts "
+        "a RUNS.jsonl registry and then aggregates every serve record (per-run "
+        "rows, per-replica rows, fleet rollup)",
     )
     parser.add_argument(
         "--regress",

@@ -5,7 +5,9 @@ produced slab (0-based, lifetime counter across restarts): the spec rides the
 spawn blob, the actor checks its slab counter, and the parent never re-ships
 a fault that already fired (it strips delivered faults before a respawn), so
 every drill fires exactly once regardless of restarts. Learner faults fire at
-the learner's *n*-th admitted slab.
+the learner's *n*-th admitted slab. The parse/schedule machinery is the
+shared engine in :mod:`sheeprl_tpu.utils.faults`; the ``actor``/``at_slab``
+config keys are this domain's aliases into it.
 
 Config shape (``algo.actor_learner.fault_injection``)::
 
@@ -38,6 +40,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from typing import Any, Dict, List, Mapping, Sequence
+
+from sheeprl_tpu.utils.faults import DeterministicSchedule, parse_fault_entries
 
 ACTOR_KINDS = ("actor_crash_mid_write", "actor_hang")
 LEARNER_KINDS = ("learner_kill", "param_lane_stall")
@@ -73,42 +77,34 @@ class ALFaultSpec:
 
 
 def parse_al_fault_config(node: Sequence[Mapping[str, Any]]) -> List[ALFaultSpec]:
-    faults = []
-    for i, entry in enumerate(node):
-        if not hasattr(entry, "get"):
-            raise ValueError(
-                f"actor_learner.fault_injection.faults[{i}] must be a mapping, got {entry!r}"
-            )
-        if "kind" not in entry or "at_slab" not in entry:
-            raise ValueError(
-                f"actor_learner.fault_injection.faults[{i}] needs kind/at_slab, got {dict(entry)!r}"
-            )
-        faults.append(
-            ALFaultSpec(
-                kind=entry["kind"],
-                at_slab=entry["at_slab"],
-                actor=int(entry.get("actor", -1)),
-                duration_s=float(entry.get("duration_s", 0.0) or 0.0),
-            )
-        )
-    return faults
+    entries = parse_fault_entries(
+        node,
+        domain="actor_learner.fault_injection",
+        required=("kind", "at_slab"),
+        fields=(
+            ("at_slab", int, 0),
+            ("actor", int, -1),
+            ("duration_s", float, 0.0),
+        ),
+    )
+    return [ALFaultSpec(**e) for e in entries]
 
 
 class LearnerFaultSchedule:
     """Learner-side half of the drill script; popped per admitted slab."""
 
     def __init__(self, faults: Sequence[ALFaultSpec]) -> None:
-        self._pending = sorted((f for f in faults if not f.is_actor_fault), key=lambda f: f.at_slab)
+        self._schedule = DeterministicSchedule(
+            [f for f in faults if not f.is_actor_fault], at=lambda f: f.at_slab
+        )
 
     def __bool__(self) -> bool:
-        return bool(self._pending)
+        return bool(self._schedule)
 
     def pop_due(self, admitted: int) -> List[ALFaultSpec]:
         """Faults due at (or before — nothing is silently dropped) the
         ``admitted``-th admitted slab, marked fired."""
-        due = [f for f in self._pending if f.at_slab <= admitted]
-        self._pending = [f for f in self._pending if f.at_slab > admitted]
-        return due
+        return self._schedule.pop_due(admitted)
 
 
 def actor_faults_for(faults: Sequence[ALFaultSpec], actor: int) -> List[ALFaultSpec]:

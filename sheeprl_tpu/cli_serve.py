@@ -102,7 +102,7 @@ def serving(args: Optional[List[str]] = None) -> None:
 
     from sheeprl_tpu.obs import configure_telemetry, shutdown_telemetry, telemetry_serve_event, telemetry_serve_stats
     from sheeprl_tpu.serve.config import serve_config_from_cfg
-    from sheeprl_tpu.serve.loadgen import run_load
+    from sheeprl_tpu.serve.loadgen import run_load, run_ramp
     from sheeprl_tpu.serve.policy import build_served_policy
     from sheeprl_tpu.serve.server import PolicyServer
     from sheeprl_tpu.utils.checkpoint import load_checkpoint
@@ -118,22 +118,46 @@ def serving(args: Optional[List[str]] = None) -> None:
     def on_event(kind: str, info: Dict[str, Any]) -> None:
         telemetry_serve_event(kind, **info)
 
-    server = PolicyServer(
-        policy,
-        serve_cfg,
-        step=int(man["step"]),
-        path=ckpt_path,
-        ckpt_dir=ckpt_dir,
-        on_event=on_event,
-    )
+    if serve_cfg.fleet.enabled:
+        from sheeprl_tpu.serve.fleet import FleetServer
+
+        server: Any = FleetServer(
+            policy,
+            serve_cfg,
+            step=int(man["step"]),
+            path=ckpt_path,
+            ckpt_dir=ckpt_dir,
+            on_event=on_event,
+        )
+    else:
+        server = PolicyServer(
+            policy,
+            serve_cfg,
+            step=int(man["step"]),
+            path=ckpt_path,
+            ckpt_dir=ckpt_dir,
+            on_event=on_event,
+        )
     t0 = time.perf_counter()
     server.start()
     warm = ", ".join(f"b{b}={dt * 1e3:.0f}ms" for b, dt in sorted(server.warmup_s.items()))
+    if serve_cfg.fleet.enabled:
+        tier = (
+            f"fleet replicas={serve_cfg.fleet.num_replicas} "
+            f"(min={serve_cfg.fleet.min_replicas} max={serve_cfg.fleet.max_replicas} "
+            f"spill={serve_cfg.fleet.cpu_spill_replicas}) "
+            f"pending<={serve_cfg.fleet.resolved_max_pending(serve_cfg)} "
+            f"hedge@p{serve_cfg.fleet.hedge_quantile * 100:.0f}"
+        )
+    else:
+        tier = (
+            f"gather={serve_cfg.gather_window_s * 1e3:.1f}ms "
+            f"queue<={serve_cfg.max_queue} replicas={serve_cfg.num_replicas}"
+        )
     print(
         f"serving {policy.name} step={man['step']} from {ckpt_path}\n"
         f"AOT ladder warmed in {time.perf_counter() - t0:.2f}s ({warm}); "
-        f"slo={serve_cfg.slo_ms:.0f}ms gather={serve_cfg.gather_window_s * 1e3:.1f}ms "
-        f"queue<={serve_cfg.max_queue} replicas={serve_cfg.num_replicas}"
+        f"slo={serve_cfg.slo_ms:.0f}ms {tier}"
     )
 
     stop = threading.Event()
@@ -151,7 +175,10 @@ def serving(args: Optional[List[str]] = None) -> None:
     final_snap: Optional[Dict[str, Any]] = None
     try:
         if serve_cfg.load.enabled:
-            report = run_load(server, serve_cfg.load)
+            if serve_cfg.load.ramp_steps > 0:
+                report = run_ramp(server, serve_cfg.load)
+            else:
+                report = run_load(server, serve_cfg.load)
             snap = server.snapshot()
             snap["load_report"] = report
             telemetry_serve_stats(snap)
@@ -172,6 +199,11 @@ def serving(args: Optional[List[str]] = None) -> None:
         # feeds the regression gates' serve_qps / serve_p95_ms cells
         from sheeprl_tpu.obs.registry import register_run
 
+        extra: Dict[str, Any] = {}
+        if serve_cfg.fleet.enabled:
+            # fleet runs get their own regress cells (`serve:...:fleet`) so
+            # the fleet's QPS gates never mix with single-server history
+            extra["variant"] = "fleet"
         register_run(
             cfg,
             kind="serve",
@@ -179,6 +211,7 @@ def serving(args: Optional[List[str]] = None) -> None:
             error=error,
             checkpoint=ckpt_path,
             serve_stats=final_snap,
+            **extra,
         )
         shutdown_telemetry()
 

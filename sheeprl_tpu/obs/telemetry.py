@@ -466,6 +466,20 @@ class RunTelemetry:
         self.emit("serve_event", kind=kind, **fields)
         self.writer.flush()
 
+    def _serve_section(self) -> Dict[str, Any]:
+        """The run_end/run_summary ``serve`` section. Fleet runs (PR 12) get
+        a dedicated ``fleet`` sub-section — router counters, scale events,
+        per-replica rows — lifted out of the last stats snapshot so registry
+        consumers (bench --serve-stats, regress) read it at a stable path."""
+        section: Dict[str, Any] = {
+            "stats": self._serve_last_stats or {},
+            "events": dict(self._serve_events),
+        }
+        fleet = (self._serve_last_stats or {}).get("fleet")
+        if fleet:
+            section["fleet"] = fleet
+        return section
+
     def record_resume_fallback(self, path: str, error: str, **fields: Any) -> None:
         """``resume_from=auto`` rejected a candidate checkpoint (load failure
         or mesh mismatch) and fell back to the next-newest: one
@@ -758,10 +772,7 @@ class RunTelemetry:
         if self._last_mfu is not None:
             summary["mfu"] = self._last_mfu
         if self._serve_last_stats is not None or self._serve_events:
-            summary["serve"] = {
-                "stats": self._serve_last_stats or {},
-                "events": dict(self._serve_events),
-            }
+            summary["serve"] = self._serve_section()
         captures = self.profile_captures or (self.profiler.captures if self.profiler is not None else [])
         if captures:
             summary["profile_captures"] = [dict(c) for c in captures]
@@ -787,10 +798,7 @@ class RunTelemetry:
         # only serving runs grow a `serve` section: training-run run_end
         # consumers keep seeing exactly the fields they already parse
         if self._serve_last_stats is not None or self._serve_events:
-            serve_fields["serve"] = {
-                "stats": self._serve_last_stats or {},
-                "events": dict(self._serve_events),
-            }
+            serve_fields["serve"] = self._serve_section()
         self.emit(
             "run_end",
             **serve_fields,

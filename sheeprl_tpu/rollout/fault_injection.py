@@ -7,6 +7,10 @@ command it sends that worker. Parent-side scheduling is what makes the
 harness deterministic across worker restarts — a crashed worker cannot lose
 the record of which faults already fired, because it never owned it.
 
+The schedule/parse machinery is the shared engine in
+:mod:`sheeprl_tpu.utils.faults`; this module keeps the rollout-flavored
+config keys (``worker``/``at_step``) and spec dataclass as aliases into it.
+
 Config shape (``rollout.fault_injection`` in the composed config)::
 
     rollout:
@@ -36,6 +40,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Dict, List, Mapping, Sequence
 
+from sheeprl_tpu.utils.faults import DeterministicSchedule, parse_fault_entries
+
 _KINDS = ("crash", "hang", "slow")
 
 
@@ -64,45 +70,33 @@ class FaultSpec:
 
 
 def parse_fault_config(node: Sequence[Mapping[str, Any]]) -> List[FaultSpec]:
-    faults = []
-    for i, entry in enumerate(node):
-        if not hasattr(entry, "get"):
-            raise ValueError(f"rollout.fault_injection.faults[{i}] must be a mapping, got {entry!r}")
-        if "kind" not in entry or "worker" not in entry or "at_step" not in entry:
-            raise ValueError(
-                f"rollout.fault_injection.faults[{i}] needs kind/worker/at_step, got {dict(entry)!r}"
-            )
-        faults.append(
-            FaultSpec(
-                kind=entry["kind"],
-                worker=entry["worker"],
-                at_step=entry["at_step"],
-                duration_s=float(entry.get("duration_s", 0.0) or 0.0),
-            )
-        )
-    return faults
+    entries = parse_fault_entries(
+        node,
+        domain="rollout.fault_injection",
+        required=("kind", "worker", "at_step"),
+        fields=(
+            ("worker", int, 0),
+            ("at_step", int, 0),
+            ("duration_s", float, 0.0),
+        ),
+    )
+    return [FaultSpec(**e) for e in entries]
 
 
 class FaultSchedule:
     """Tracks which faults already fired; queried once per pool step."""
 
     def __init__(self, faults: Sequence[FaultSpec]) -> None:
-        self._pending: List[FaultSpec] = sorted(faults, key=lambda f: f.at_step)
+        self._schedule = DeterministicSchedule(
+            faults, at=lambda f: f.at_step, index=lambda f: f.worker
+        )
 
     def __bool__(self) -> bool:
-        return bool(self._pending)
+        return bool(self._schedule)
 
     def pop_due(self, step: int) -> Dict[int, List[FaultSpec]]:
         """Return {worker_index: [faults]} due at pool step ``step`` and mark
         them fired. Faults scheduled for a step the pool already passed (e.g.
         ``at_step`` during a window where the worker was being restarted) fire
         on the next step so nothing is silently dropped."""
-        due: Dict[int, List[FaultSpec]] = {}
-        remaining: List[FaultSpec] = []
-        for f in self._pending:
-            if f.at_step <= step:
-                due.setdefault(f.worker, []).append(f)
-            else:
-                remaining.append(f)
-        self._pending = remaining
-        return due
+        return self._schedule.pop_due_by_index(step)

@@ -19,7 +19,7 @@ from __future__ import annotations
 from typing import Any
 
 from sheeprl_tpu.serve.batching import MicroBatcher, Request
-from sheeprl_tpu.serve.config import LoadConfig, ServeConfig, serve_config_from_cfg
+from sheeprl_tpu.serve.config import FleetConfig, LoadConfig, ServeConfig, serve_config_from_cfg
 from sheeprl_tpu.serve.errors import (
     DeadlineExceeded,
     InferenceFailed,
@@ -48,6 +48,16 @@ _LAZY = {
     "ReplicaSlot": "sheeprl_tpu.serve.supervisor",
     "ServeClient": "sheeprl_tpu.serve.client",
     "run_load": "sheeprl_tpu.serve.loadgen",
+    "run_ramp": "sheeprl_tpu.serve.loadgen",
+    "ramp_rates": "sheeprl_tpu.serve.loadgen",
+    "SlotPool": "sheeprl_tpu.serve.slots",
+    "safe_complete": "sheeprl_tpu.serve.slots",
+    "Router": "sheeprl_tpu.serve.router",
+    "RoutedRequest": "sheeprl_tpu.serve.router",
+    "RouteTarget": "sheeprl_tpu.serve.router",
+    "FleetServer": "sheeprl_tpu.serve.fleet",
+    "FleetReplica": "sheeprl_tpu.serve.fleet",
+    "FleetSlot": "sheeprl_tpu.serve.fleet",
     "POLICY_BUILDERS": "sheeprl_tpu.serve.policy",
     "build_served_policy": "sheeprl_tpu.serve.policy",
     "make_linear_state": "sheeprl_tpu.serve.policy",
@@ -66,6 +76,7 @@ def __getattr__(name: str) -> Any:
 
 __all__ = [
     "DeadlineExceeded",
+    "FleetConfig",
     "InferenceFailed",
     "LoadConfig",
     "MicroBatcher",

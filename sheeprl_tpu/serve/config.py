@@ -26,6 +26,71 @@ class LoadConfig:
     timeout_ms: Optional[float] = None  # per-request client deadline; None: server default
     max_retries: int = 3
     seed: int = 0
+    # stepped saturation ramp (``run_ramp``): 0 steps = plain run_load
+    ramp_steps: int = 0
+    ramp_start_hz: float = 50.0
+    ramp_factor: float = 1.6
+
+
+@dataclass
+class FleetConfig:
+    """Replica-fleet knobs (``serve.fleet.*``): router admission/hedging,
+    elastic scaling bounds and the CPU spill tier. Disabled by default — the
+    single :class:`PolicyServer` stays the small-deployment path."""
+
+    enabled: bool = False
+    num_replicas: int = 4  # initially-active device replicas
+    min_replicas: int = 1  # autoscale floor
+    max_replicas: int = 8  # autoscale ceiling (standby slots pre-created)
+    cpu_spill_replicas: int = 0  # host-backend replicas for batch-priority spill
+    backlog_per_replica: int = 16  # per-pool FIFO behind the slot window
+    max_pending: Optional[int] = None  # fleet admission bound; None: derived
+    hedge_quantile: float = 0.95  # hedge requests waiting past this latency quantile
+    hedge_floor_ms: float = 0.0  # never hedge earlier than this
+    hedge_max: int = 1  # hedge copies per request
+    hedge_scan_ms: float = 5.0  # hedge/rescue scan cadence
+    spill_depth: int = 4  # per-device-replica depth that opens the spill tier
+    autoscale_interval_s: float = 0.25
+    scale_up_depth: float = 4.0  # avg queued per active replica that adds one
+    scale_down_depth: float = 0.5  # avg queued per active replica that retires one
+    scale_patience: int = 3  # consecutive breaches before acting
+
+    def __post_init__(self) -> None:
+        if self.min_replicas < 1:
+            raise ValueError(f"serve.fleet.min_replicas must be >= 1, got {self.min_replicas}")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"serve.fleet.max_replicas ({self.max_replicas}) must be >= "
+                f"min_replicas ({self.min_replicas})"
+            )
+        if not (self.min_replicas <= self.num_replicas <= self.max_replicas):
+            raise ValueError(
+                f"serve.fleet.num_replicas ({self.num_replicas}) must lie in "
+                f"[min_replicas={self.min_replicas}, max_replicas={self.max_replicas}]"
+            )
+        if self.cpu_spill_replicas < 0:
+            raise ValueError(
+                f"serve.fleet.cpu_spill_replicas must be >= 0, got {self.cpu_spill_replicas}"
+            )
+        if not 0.0 < self.hedge_quantile <= 1.0:
+            raise ValueError(
+                f"serve.fleet.hedge_quantile must be in (0, 1], got {self.hedge_quantile}"
+            )
+        if self.hedge_max < 0:
+            raise ValueError(f"serve.fleet.hedge_max must be >= 0, got {self.hedge_max}")
+        if self.backlog_per_replica < 1:
+            raise ValueError(
+                f"serve.fleet.backlog_per_replica must be >= 1, got {self.backlog_per_replica}"
+            )
+
+    def resolved_max_pending(self, serve: "ServeConfig") -> int:
+        """The fleet-wide admission bound: explicit, else every active
+        replica's slot window + backlog (the fleet analogue of the single
+        server's ``max_queue``)."""
+        if self.max_pending is not None:
+            return int(self.max_pending)
+        per_replica = serve.max_batch + self.backlog_per_replica
+        return per_replica * (self.num_replicas + self.cpu_spill_replicas)
 
 
 @dataclass
@@ -55,6 +120,7 @@ class ServeConfig:
     stats_interval_s: float = 5.0  # serve_stats telemetry cadence
     faults: List[ServeFaultSpec] = field(default_factory=list)
     load: LoadConfig = field(default_factory=LoadConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
     def __post_init__(self) -> None:
         ladder = sorted({int(b) for b in self.batch_ladder})
@@ -94,6 +160,29 @@ def serve_config_from_cfg(cfg: Mapping[str, Any]) -> ServeConfig:
     faults: List[ServeFaultSpec] = []
     if bool(_get(fault_node, "enabled", False)):
         faults = parse_serve_faults(_get(fault_node, "faults") or [])
+    fleet_node = _get(node, "fleet") or {}
+    fleet = FleetConfig(
+        enabled=bool(_get(fleet_node, "enabled", False)),
+        num_replicas=int(_get(fleet_node, "num_replicas", 4)),
+        min_replicas=int(_get(fleet_node, "min_replicas", 1)),
+        max_replicas=int(_get(fleet_node, "max_replicas", 8)),
+        cpu_spill_replicas=int(_get(fleet_node, "cpu_spill_replicas", 0)),
+        backlog_per_replica=int(_get(fleet_node, "backlog_per_replica", 16)),
+        max_pending=(
+            None
+            if _get(fleet_node, "max_pending", None) is None
+            else int(_get(fleet_node, "max_pending"))
+        ),
+        hedge_quantile=float(_get(fleet_node, "hedge_quantile", 0.95)),
+        hedge_floor_ms=float(_get(fleet_node, "hedge_floor_ms", 0.0) or 0.0),
+        hedge_max=int(_get(fleet_node, "hedge_max", 1)),
+        hedge_scan_ms=float(_get(fleet_node, "hedge_scan_ms", 5.0)),
+        spill_depth=int(_get(fleet_node, "spill_depth", 4)),
+        autoscale_interval_s=float(_get(fleet_node, "autoscale_interval_s", 0.25)),
+        scale_up_depth=float(_get(fleet_node, "scale_up_depth", 4.0)),
+        scale_down_depth=float(_get(fleet_node, "scale_down_depth", 0.5)),
+        scale_patience=int(_get(fleet_node, "scale_patience", 3)),
+    )
     load_node = _get(node, "load") or {}
     load = LoadConfig(
         enabled=bool(_get(load_node, "enabled", False)),
@@ -103,6 +192,9 @@ def serve_config_from_cfg(cfg: Mapping[str, Any]) -> ServeConfig:
         timeout_ms=_opt_float(_get(load_node, "timeout_ms", None)),
         max_retries=int(_get(load_node, "max_retries", 3)),
         seed=int(_get(load_node, "seed", 0)),
+        ramp_steps=int(_get(load_node, "ramp_steps", 0)),
+        ramp_start_hz=float(_get(load_node, "ramp_start_hz", 50.0)),
+        ramp_factor=float(_get(load_node, "ramp_factor", 1.6)),
     )
     return ServeConfig(
         batch_ladder=list(_get(node, "batch_ladder", None) or [1, 2, 4, 8]),
@@ -122,6 +214,7 @@ def serve_config_from_cfg(cfg: Mapping[str, Any]) -> ServeConfig:
         stats_interval_s=float(_get(node, "stats_interval_s", 5.0)),
         faults=faults,
         load=load,
+        fleet=fleet,
     )
 
 

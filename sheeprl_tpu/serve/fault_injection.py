@@ -1,10 +1,13 @@
 """Deterministic fault injection for the serving tier.
 
-Same philosophy as ``rollout.fault_injection``: the recovery paths are
+Same philosophy as ``rollout.fault_injection`` — and since PR 12 literally
+the same engine (:mod:`sheeprl_tpu.utils.faults`): the recovery paths are
 exercised by scheduled drills in CI, not discovered in production. Faults are
 owned by the *schedule* (parent-side state), not by the replica that executes
 them, so a crashed-and-restarted replica cannot lose the record of which
-faults already fired.
+faults already fired. This module keeps the serve-flavored config keys
+(``replica``/``at_batch``/``at_swap``/``at_request``) as aliases into the
+shared parser.
 
 Config shape (``serve.fault_injection`` in the composed config)::
 
@@ -15,6 +18,7 @@ Config shape (``serve.fault_injection`` in the composed config)::
           - {kind: replica_crash,  replica: 0, at_batch: 5}
           - {kind: slow_inference, replica: 0, at_batch: 2, duration_s: 0.2, for_batches: 20}
           - {kind: poison_swap, at_swap: 1}
+          - {kind: router_blackhole, at_request: 10, duration_s: 0.2}
 
 ``kind``:
 
@@ -28,19 +32,26 @@ Config shape (``serve.fault_injection`` in the composed config)::
 - ``poison_swap`` — the ``at_swap``-th swap *attempt* (1-based) has its
   freshly loaded weights NaN-poisoned after the load, so the promotion
   validation must reject it and keep serving the previous executable.
+- ``router_blackhole`` — the fleet front door (:mod:`sheeprl_tpu.serve.
+  router`) swallows assignments for ``duration_s`` starting at its
+  ``at_request``-th routed request: the chosen replica never receives the
+  work, so the hedge/deadline machinery must rescue every admitted request.
+  Ignored by the single-server tier (there is no router to blackhole).
 
 ``at_batch`` counts batches *processed by that replica* (a monotone
-per-replica counter that survives restarts). Each fault fires exactly once
+per-replica counter that survives restarts); ``at_request`` counts requests
+*routed by the fleet router*. Each fault fires exactly once
 (``slow_inference`` covers its window, then expires).
 """
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
 from typing import Any, List, Mapping, Sequence
 
-_KINDS = ("replica_crash", "slow_inference", "poison_swap")
+from sheeprl_tpu.utils.faults import DeterministicSchedule, parse_fault_entries
+
+_KINDS = ("replica_crash", "slow_inference", "poison_swap", "router_blackhole")
 
 
 @dataclass
@@ -49,6 +60,7 @@ class ServeFaultSpec:
     replica: int = 0
     at_batch: int = 0
     at_swap: int = 1
+    at_request: int = 0
     duration_s: float = 0.0
     for_batches: int = 1
 
@@ -59,12 +71,15 @@ class ServeFaultSpec:
         self.replica = int(self.replica)
         self.at_batch = int(self.at_batch)
         self.at_swap = int(self.at_swap)
+        self.at_request = int(self.at_request)
         self.duration_s = float(self.duration_s)
         self.for_batches = int(self.for_batches)
         if self.replica < 0:
             raise ValueError(f"serve fault replica index must be >= 0, got {self.replica}")
         if self.at_batch < 0:
             raise ValueError(f"serve fault at_batch must be >= 0, got {self.at_batch}")
+        if self.at_request < 0:
+            raise ValueError(f"serve fault at_request must be >= 0, got {self.at_request}")
         if self.kind == "poison_swap" and self.at_swap < 1:
             raise ValueError(f"serve fault at_swap is 1-based, got {self.at_swap}")
         if self.for_batches < 1:
@@ -72,66 +87,57 @@ class ServeFaultSpec:
 
 
 def parse_serve_faults(node: Sequence[Mapping[str, Any]]) -> List[ServeFaultSpec]:
-    faults = []
-    for i, entry in enumerate(node):
-        if not hasattr(entry, "get"):
-            raise ValueError(f"serve.fault_injection.faults[{i}] must be a mapping, got {entry!r}")
-        if "kind" not in entry:
-            raise ValueError(f"serve.fault_injection.faults[{i}] needs a kind, got {dict(entry)!r}")
-        faults.append(
-            ServeFaultSpec(
-                kind=entry["kind"],
-                replica=int(entry.get("replica", 0)),
-                at_batch=int(entry.get("at_batch", 0)),
-                at_swap=int(entry.get("at_swap", 1)),
-                duration_s=float(entry.get("duration_s", 0.0) or 0.0),
-                for_batches=int(entry.get("for_batches", 1)),
-            )
-        )
-    return faults
+    entries = parse_fault_entries(
+        node,
+        domain="serve.fault_injection",
+        required=("kind",),
+        fields=(
+            ("replica", int, 0),
+            ("at_batch", int, 0),
+            ("at_swap", int, 1),
+            ("at_request", int, 0),
+            ("duration_s", float, 0.0),
+            ("for_batches", int, 1),
+        ),
+    )
+    return [ServeFaultSpec(**e) for e in entries]
 
 
 class ServeFaultSchedule:
-    """Thread-safe: replicas and the swap watcher query concurrently."""
+    """Thread-safe: replicas, the router and the swap watcher query
+    concurrently (each counter family gets its own pending set)."""
 
     def __init__(self, faults: Sequence[ServeFaultSpec]) -> None:
-        self._lock = threading.Lock()
-        self._batch_faults = [f for f in faults if f.kind in ("replica_crash", "slow_inference")]
-        self._swap_faults = [f for f in faults if f.kind == "poison_swap"]
+        self._batches = DeterministicSchedule(
+            [f for f in faults if f.kind in ("replica_crash", "slow_inference")],
+            at=lambda f: f.at_batch,
+            index=lambda f: f.replica,
+            window=lambda f: f.for_batches if f.kind == "slow_inference" else 1,
+        )
+        self._swaps = DeterministicSchedule(
+            [f for f in faults if f.kind == "poison_swap"], at=lambda f: f.at_swap
+        )
+        self._router = DeterministicSchedule(
+            [f for f in faults if f.kind == "router_blackhole"], at=lambda f: f.at_request
+        )
 
     def __bool__(self) -> bool:
-        with self._lock:
-            return bool(self._batch_faults or self._swap_faults)
+        return bool(self._batches) or bool(self._swaps) or bool(self._router)
 
     def batch_faults(self, replica: int, batch_index: int) -> List[ServeFaultSpec]:
         """Faults due for ``replica``'s ``batch_index``-th batch. A
         ``replica_crash`` whose step the replica already passed (scheduled
         while it was restarting) fires on the next batch, mirroring the
         rollout schedule's nothing-silently-dropped rule."""
-        due: List[ServeFaultSpec] = []
-        with self._lock:
-            remaining = []
-            for f in self._batch_faults:
-                if f.replica != replica:
-                    remaining.append(f)
-                elif f.kind == "replica_crash" and f.at_batch <= batch_index:
-                    due.append(f)
-                elif f.kind == "slow_inference" and f.at_batch <= batch_index < f.at_batch + f.for_batches:
-                    due.append(f)
-                    remaining.append(f)  # stays scheduled for its whole window
-                elif f.kind == "slow_inference" and batch_index >= f.at_batch + f.for_batches:
-                    pass  # window over: expire
-                else:
-                    remaining.append(f)
-            self._batch_faults = remaining
-        return due
+        return self._batches.pop_due(batch_index, index=replica)
 
     def poison_swap(self, attempt: int) -> bool:
         """True when the ``attempt``-th swap attempt (1-based) must have its
         loaded weights poisoned before validation."""
-        with self._lock:
-            for f in list(self._swap_faults):
-                if f.at_swap <= attempt:
-                    self._swap_faults.remove(f)
-                    return True
-        return False
+        return self._swaps.pop_first(attempt) is not None
+
+    def router_faults(self, request_index: int) -> List[ServeFaultSpec]:
+        """``router_blackhole`` faults due at the router's ``request_index``-th
+        routed request, marked fired (the router holds each blackhole open
+        for its ``duration_s``)."""
+        return self._router.pop_due(request_index)

@@ -151,3 +151,65 @@ def run_load(
     p95 = report["p95_ms"]
     report["slo_met"] = bool(p95 is not None and p95 <= server.config.slo_ms)
     return report
+
+
+def ramp_rates(start_hz: float, factor: float, steps: int) -> List[float]:
+    """The stepped open-loop schedule: ``start_hz * factor**k`` per step."""
+    if steps < 1:
+        raise ValueError(f"ramp needs >= 1 step, got {steps}")
+    if start_hz <= 0 or factor <= 1.0:
+        raise ValueError(
+            f"ramp needs start_hz > 0 and factor > 1, got start_hz={start_hz}, factor={factor}"
+        )
+    return [start_hz * (factor**k) for k in range(steps)]
+
+
+def run_ramp(
+    server: PolicyServer,
+    cfg: LoadConfig,
+    *,
+    rates_hz: Optional[List[float]] = None,
+    step_duration_s: Optional[float] = None,
+    obs_factory: Optional[Callable[[np.random.Generator], Any]] = None,
+    on_step: Optional[Callable[[int, float], None]] = None,
+) -> Dict[str, Any]:
+    """Stepped open-loop ramp that walks the offered rate up until the
+    server stops meeting its SLO — the saturation-knee finder.
+
+    Each step runs :func:`run_load` open-loop at one rate for
+    ``step_duration_s`` (default: ``cfg.duration_s`` split across the
+    steps). ``on_step(step_index, rate_hz)`` fires *before* each step — the
+    chaos drills use it to kill a replica mid-ramp. The report's knee is the
+    highest offered rate whose step still met the SLO with negligible
+    shedding; ``max_good_qps`` is the throughput claim the regress cell
+    gates (completed QPS while p95 <= SLO).
+    """
+    import dataclasses
+
+    rates = rates_hz or ramp_rates(cfg.ramp_start_hz, cfg.ramp_factor, cfg.ramp_steps)
+    per_step = step_duration_s if step_duration_s is not None else cfg.duration_s / len(rates)
+    steps: List[Dict[str, Any]] = []
+    knee_rate: Optional[float] = None
+    max_good_qps = 0.0
+    for k, rate in enumerate(rates):
+        if on_step is not None:
+            on_step(k, rate)
+        step_cfg = dataclasses.replace(cfg, rate_hz=float(rate), duration_s=float(per_step))
+        report = run_load(server, step_cfg, obs_factory=obs_factory)
+        report["step"] = k
+        report["offered_rate_hz"] = float(rate)
+        attempts = report["ok"] + report["shed"] + report["expired"] + report["errors"]
+        report["goodput_frac"] = (report["ok"] / attempts) if attempts else 0.0
+        steps.append(report)
+        if report["slo_met"] and report["goodput_frac"] >= 0.99:
+            knee_rate = float(rate)
+            max_good_qps = max(max_good_qps, float(report["qps"]))
+    return {
+        "mode": "ramp",
+        "steps": steps,
+        "offered_rates_hz": [float(r) for r in rates],
+        "knee_rate_hz": knee_rate,
+        "max_good_qps": max_good_qps,
+        "saturated": bool(steps and not (steps[-1]["slo_met"] and steps[-1]["goodput_frac"] >= 0.99)),
+        "slo_ms": server.config.slo_ms,
+    }

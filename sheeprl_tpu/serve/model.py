@@ -119,6 +119,12 @@ class CompiledLadder:
         n = len(obs_list)
         rung = self.rung_for(n)
         batch = stack_obs(self.policy.obs_spec, obs_list, rung)
+        return self.run_staged(params, batch, rung, n)
+
+    def run_staged(self, params: Any, batch: Any, rung: int, n: int) -> List[Any]:
+        """Run a pre-assembled (already rung-padded) batch — the slot-pool
+        path, where obs were staged at admission — returning the first ``n``
+        per-request host-side action pytrees."""
         out = jax.device_get(self._compiled[rung](params, batch))
         return [jax.tree.map(lambda leaf: leaf[i], out) for i in range(n)]
 

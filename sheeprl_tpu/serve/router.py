@@ -1,0 +1,370 @@
+"""The fleet's front door: admission, health-scored routing, hedged retries.
+
+One :class:`Router` sits in front of N replica slot pools
+(:mod:`sheeprl_tpu.serve.fleet`) and owns every fleet-wide request-path
+decision, so the per-replica machinery can stay dumb:
+
+- **admission** — one fleet-wide pending bound (``serve.fleet`` scales the
+  single-server ``max_queue``); past it, ``submit`` sheds with the same typed
+  :class:`~sheeprl_tpu.serve.errors.Overloaded` contract as the single
+  server. Re-routed and hedged placements of already-admitted requests bypass
+  admission — an admitted request is never shed by a fleet event it didn't
+  cause.
+- **routing** — consistent health-weighted least-loaded choice: each live
+  replica gets a health score in ``(0, 1]`` decayed from its heartbeat age
+  (fed by the fleet supervisor) and the router picks the lowest
+  ``outstanding / health``. A sick-but-alive replica therefore sees traffic
+  taper before the supervisor declares it dead, and routing is a pure
+  function of observable state (no RNG) so drills replay exactly.
+- **hedged retries** — a scan thread watches in-flight requests; one that has
+  waited past the fleet's rolling latency quantile
+  (``hedge_quantile``, floored by ``hedge_floor_ms``) is duplicated to a
+  different replica. Only *idempotent* requests hedge (single-step policy
+  calls are; anything submitted with ``idempotent=False`` never is), the
+  first completion wins the request's Future, and the loser's copy is
+  dropped at its pool's next dispatch assembly (``future.done()``), i.e. the
+  losing twin is cancelled rather than served dead.
+- **re-route-at-front** — when the fleet declares a replica dead, the
+  router drains that replica's pool (in-flight window first, admission order
+  preserved) and plants the work at the FRONT of the healthiest sibling:
+  the single-server crash-requeue-at-front contract, promoted across
+  replicas. Zero admitted requests are dropped by a crash; each still
+  expires only by its own deadline.
+- **blackhole drill** — a scheduled ``router_blackhole`` fault makes the
+  router swallow assignments for ``duration_s``: requests are admitted but
+  reach no replica, and the hedge scan must rescue every one of them. This
+  is the front door's own failure mode, drilled like every other.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence
+
+from sheeprl_tpu.serve.batching import Request
+from sheeprl_tpu.serve.errors import Overloaded, ServerClosed
+from sheeprl_tpu.serve.fault_injection import ServeFaultSchedule
+from sheeprl_tpu.serve.slots import SlotPool
+
+INTERACTIVE = "interactive"
+BATCH = "batch"  # eval / loadgen traffic, spillable to CPU replicas
+
+
+class RoutedRequest(Request):
+    """A fleet request: a :class:`Request` plus routing state the hedge scan
+    and the drills read. ``placements`` is the ordered list of replica
+    indices this request was offered to (first = primary route)."""
+
+    __slots__ = ("idempotent", "priority", "placements", "hedges", "rerouted")
+
+    def __init__(
+        self,
+        obs: Any,
+        enqueue_t: float,
+        deadline_t: float,
+        *,
+        idempotent: bool = True,
+        priority: str = INTERACTIVE,
+    ) -> None:
+        super().__init__(obs, enqueue_t, deadline_t)
+        self.idempotent = bool(idempotent)
+        self.priority = str(priority)
+        self.placements: List[int] = []
+        self.hedges = 0
+        self.rerouted = 0
+
+
+class RouteTarget(NamedTuple):
+    """One routable replica as the fleet advertises it to the router."""
+
+    index: int
+    pool: SlotPool
+    health: float  # (0, 1]; <= 0 means unroutable (masked/dead/retiring)
+    kind: str  # "device" | "cpu_spill"
+
+
+class Router:
+    """Fleet front door. ``targets()`` is the fleet's live routing table —
+    re-read on every decision so replica death/scale events take effect
+    immediately; the router holds no replica state of its own."""
+
+    LATENCY_RESERVOIR = 2048
+    MIN_HEDGE_SAMPLES = 16
+
+    def __init__(
+        self,
+        *,
+        targets: Callable[[], List[RouteTarget]],
+        max_pending: int,
+        slo_s: float,
+        hedge_quantile: float = 0.95,
+        hedge_floor_s: float = 0.0,
+        hedge_max: int = 1,
+        hedge_scan_s: float = 0.005,
+        spill_depth: int = 4,
+        fault_schedule: Optional[ServeFaultSchedule] = None,
+        on_event: Optional[Callable[[str, Dict[str, Any]], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._targets = targets
+        self.max_pending = int(max_pending)
+        self._slo_s = float(slo_s)
+        self.hedge_quantile = float(hedge_quantile)
+        self.hedge_floor_s = float(hedge_floor_s)
+        self.hedge_max = int(hedge_max)
+        self._hedge_scan_s = float(hedge_scan_s)
+        self.spill_depth = int(spill_depth)
+        self._faults = fault_schedule
+        self._on_event = on_event
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._inflight: Dict[int, RoutedRequest] = {}
+        self._latencies: List[float] = []
+        self._lat_pos = 0
+        self._route_seq = 0
+        self._blackhole_until = 0.0
+        self._closing = threading.Event()
+        self._scan_thread: Optional[threading.Thread] = None
+        # counters (drills and the fleet snapshot read these)
+        self.routed = 0
+        self.shed = 0
+        self.hedged = 0
+        self.hedged_won = 0  # completions that had at least one hedge twin
+        self.rerouted_requests = 0
+        self.blackholed = 0
+        self.spilled = 0
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self) -> "Router":
+        if self._scan_thread is None:
+            self._scan_thread = threading.Thread(
+                target=self._scan, name="fleet-router-hedge", daemon=True
+            )
+            self._scan_thread.start()
+        return self
+
+    def close(self) -> None:
+        self._closing.set()
+        if self._scan_thread is not None:
+            self._scan_thread.join(1.0)
+
+    # ---------------------------------------------------------------- routing
+    def submit(
+        self,
+        obs: Any,
+        deadline_s: float,
+        *,
+        idempotent: bool = True,
+        priority: str = INTERACTIVE,
+    ) -> RoutedRequest:
+        """Admit + route one request. Raises :class:`Overloaded` at the
+        fleet-wide bound, :class:`ServerClosed` when no replica exists at
+        all (fleet shut down)."""
+        if self._closing.is_set():
+            raise ServerClosed("fleet router is shut down")
+        now = self._clock()
+        depth = self.pending_depth()
+        if depth >= self.max_pending:
+            self.shed += 1
+            raise Overloaded(depth, self.max_pending, self._slo_s / 5.0)
+        req = RoutedRequest(
+            obs, now, now + float(deadline_s), idempotent=idempotent, priority=priority
+        )
+        with self._lock:
+            seq = self._route_seq
+            self._route_seq += 1
+            self._inflight[req.rid] = req
+        self.routed += 1
+        self._consume_router_faults(seq, now)
+        if now < self._blackhole_until:
+            # blackholed: admitted, tracked, but the assignment is swallowed;
+            # the hedge scan is the rescue path for every one of these
+            self.blackholed += 1
+            return req
+        self._place(req, now)
+        return req
+
+    def _place(self, req: RoutedRequest, now: float) -> bool:
+        """Offer ``req`` to the best target it hasn't been placed on yet."""
+        for target in self._ranked_targets(req):
+            try:
+                if target.pool.offer(req):
+                    req.placements.append(target.index)
+                    if target.kind == "cpu_spill":
+                        self.spilled += 1
+                    return True
+            except ServerClosed:
+                continue
+        return False  # every pool full/closed: the hedge scan retries
+
+    def _ranked_targets(self, req: RoutedRequest) -> List[RouteTarget]:
+        """Routable targets, best first: health-weighted least-loaded.
+        ``batch`` traffic spills to CPU replicas once the device replicas are
+        queueing past ``spill_depth`` each; interactive traffic only ever
+        lands on a spill replica when no device replica is routable."""
+        live = [t for t in self._targets() if t.health > 0 and not t.pool.closed]
+        fresh = [t for t in live if t.index not in req.placements]
+        device = [t for t in fresh if t.kind != "cpu_spill"]
+        spill = [t for t in fresh if t.kind == "cpu_spill"]
+
+        def score(t: RouteTarget) -> float:
+            return t.pool.outstanding() / max(t.health, 1e-6)
+
+        device.sort(key=score)
+        spill.sort(key=score)
+        if req.priority == BATCH and spill:
+            saturated = device and all(
+                t.pool.depth() >= self.spill_depth for t in device
+            )
+            if saturated or not device:
+                return spill + device
+        return device + spill
+
+    # ---------------------------------------------------------------- hedging
+    def hedge_threshold_s(self) -> float:
+        """How long a request may wait before it is hedged: the rolling
+        ``hedge_quantile`` of completed fleet latencies, floored by
+        ``hedge_floor_s``; one SLO until enough samples exist."""
+        with self._lock:
+            lats = sorted(self._latencies)
+        if len(lats) < self.MIN_HEDGE_SAMPLES:
+            return max(self.hedge_floor_s, self._slo_s)
+        idx = min(len(lats) - 1, max(0, math.ceil(self.hedge_quantile * len(lats)) - 1))
+        return max(self.hedge_floor_s, lats[idx])
+
+    def record_latency(self, latency_s: float) -> None:
+        """Feed one completed end-to-end latency into the hedge quantile."""
+        with self._lock:
+            if len(self._latencies) < self.LATENCY_RESERVOIR:
+                self._latencies.append(latency_s)
+            else:
+                self._latencies[self._lat_pos] = latency_s
+                self._lat_pos = (self._lat_pos + 1) % self.LATENCY_RESERVOIR
+
+    def _scan(self) -> None:
+        while not self._closing.wait(self._hedge_scan_s):
+            try:
+                self._scan_once()
+            except Exception:
+                pass  # the rescue path must outlive any one bad pass
+
+    def _scan_once(self) -> None:
+        now = self._clock()
+        threshold = self.hedge_threshold_s()
+        with self._lock:
+            inflight = list(self._inflight.values())
+        for req in inflight:
+            if req.future.done():
+                with self._lock:
+                    self._inflight.pop(req.rid, None)
+                if req.hedges and not req.future.exception():
+                    self.hedged_won += 1
+                continue
+            if now >= req.deadline_t:
+                continue  # its pool expires it against its own deadline
+            if not req.placements and now >= self._blackhole_until:
+                # swallowed by a blackhole (or every pool was full): rescue
+                if self._place(req, now):
+                    self._emit("router_rescue", {"rid": req.rid})
+                continue
+            if (
+                req.idempotent
+                and req.hedges < self.hedge_max
+                and now - req.enqueue_t >= threshold
+            ):
+                if self._place(req, now):
+                    req.hedges += 1
+                    self.hedged += 1
+                    self._emit(
+                        "hedge",
+                        {
+                            "rid": req.rid,
+                            "waited_ms": (now - req.enqueue_t) * 1e3,
+                            "threshold_ms": threshold * 1e3,
+                            "placements": list(req.placements),
+                        },
+                    )
+
+    # -------------------------------------------------------------- re-routing
+    def reroute(self, index: int, pool: SlotPool, reason: str) -> int:
+        """Drain a dead/retiring replica's pool and plant the work — in
+        admission order — at the FRONT of the healthiest surviving sibling.
+        Returns how many requests were re-homed. Requests with no live
+        sibling stay tracked in-flight; the hedge scan keeps retrying them
+        until a replica returns or their own deadline expires."""
+        drained = pool.drain()
+        if not drained:
+            return 0
+        moved = 0
+        for req in drained:
+            if isinstance(req, RoutedRequest):
+                req.rerouted += 1
+        targets = [
+            t
+            for t in self._ranked_targets_any()
+            if t.index != index and t.health > 0 and not t.pool.closed
+        ]
+        if targets:
+            targets[0].pool.offer_front(drained)
+            for req in drained:
+                if isinstance(req, RoutedRequest):
+                    req.placements.append(targets[0].index)
+            moved = len(drained)
+        else:
+            # nowhere to go right now: leave them in-flight; the scan retries
+            for req in drained:
+                if isinstance(req, RoutedRequest):
+                    req.placements.clear()
+        self.rerouted_requests += moved
+        self._emit(
+            "reroute",
+            {"replica": index, "reason": reason, "requests": len(drained), "moved": moved},
+        )
+        return moved
+
+    def _ranked_targets_any(self) -> List[RouteTarget]:
+        live = [t for t in self._targets() if t.health > 0 and not t.pool.closed]
+        return sorted(live, key=lambda t: t.pool.outstanding() / max(t.health, 1e-6))
+
+    # ------------------------------------------------------------------ stats
+    def pending_depth(self) -> int:
+        """Fleet-wide queued depth (the admission + autoscale signal)."""
+        return sum(t.pool.depth() for t in self._targets())
+
+    def inflight_count(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "routed": self.routed,
+            "shed": self.shed,
+            "hedged": self.hedged,
+            "hedged_won": self.hedged_won,
+            "rerouted_requests": self.rerouted_requests,
+            "blackholed": self.blackholed,
+            "spilled": self.spilled,
+            "inflight": self.inflight_count(),
+            "pending_depth": self.pending_depth(),
+            "hedge_threshold_ms": self.hedge_threshold_s() * 1e3,
+        }
+
+    # --------------------------------------------------------------- internal
+    def _consume_router_faults(self, seq: int, now: float) -> None:
+        if self._faults is None:
+            return
+        for fault in self._faults.router_faults(seq):
+            self._blackhole_until = max(self._blackhole_until, now + fault.duration_s)
+            self._emit(
+                "router_blackhole",
+                {"at_request": seq, "duration_s": fault.duration_s},
+            )
+
+    def _emit(self, kind: str, info: Dict[str, Any]) -> None:
+        if self._on_event is not None:
+            try:
+                self._on_event(kind, info)
+            except Exception:
+                pass

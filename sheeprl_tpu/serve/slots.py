@@ -1,0 +1,326 @@
+"""Continuous batching: a per-replica slot pool replacing the gather window.
+
+The PR 6 micro-batcher coalesced requests with a time window: the first
+waiting request opened a ``gather_window_s`` gate and the batch closed when
+the window elapsed. That buys batch occupancy with latency — every request
+pays up to one window of dead air even on an idle server.
+
+The slot pool is the vLLM-style alternative, trivial for single-step
+policies because there is no per-request generation state to keep resident:
+the replica's in-flight batch is a window of ``capacity`` *slots* (capacity
+= the top AOT ladder rung), and a request is admitted into any free slot at
+any time — including while the previous dispatch is still running on device.
+The replica loop runs back-to-back dispatches over whatever slots are
+occupied; a lone request rides the very next dispatch with zero gather
+latency, and a saturated replica runs full rungs continuously. Requests past
+the slot window wait in a bounded FIFO *backlog* that refills slots as
+dispatches free them.
+
+Two properties the fleet's robustness contract leans on:
+
+- **admission order is dispatch order** — slots and backlog are FIFO, every
+  occupied slot rides the next dispatch, and ``offer(front=True)`` (the
+  crash re-route path) inserts ahead of the backlog, so an admitted request
+  is never reordered behind later admissions (asserted by the ordering
+  property test).
+- **expiry only by a request's own deadline** — a request is completed
+  exceptionally when *its* deadline passes (at dispatch assembly, exactly
+  like the micro-batcher), never because a crash elsewhere re-routed it.
+
+Observation staging is slot-resident: each pool preallocates buffer rows
+per observation leaf (2x the slot window — the occupied slots and the
+in-flight batch hold rows at the same time) and admission writes the
+request's obs into its row immediately — batch assembly on the dispatch
+path is one vectorized row-gather instead of a per-request stacking loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.serve.batching import Request
+from sheeprl_tpu.serve.errors import ServerClosed
+
+
+def safe_complete(req: Request, out: Any) -> bool:
+    """Set ``req``'s result unless something else (a hedge twin, an expiry)
+    beat us to it. Returns True when this call delivered the result."""
+    if req.future.done():
+        return False
+    try:
+        req.future.set_result(out)
+        return True
+    except Exception:  # InvalidStateError: lost the race to the hedge twin
+        return False
+
+
+class SlotPool:
+    """One replica's continuous-batching window: ``capacity`` slots fed by a
+    bounded FIFO backlog.
+
+    ``on_expired(request)`` fires (outside the lock) for every request this
+    pool completes exceptionally at dispatch assembly.
+    """
+
+    def __init__(
+        self,
+        *,
+        capacity: int,
+        backlog_bound: int,
+        obs_spec: Any = None,
+        clock: Callable[[], float] = time.monotonic,
+        on_expired: Optional[Callable[[Request], None]] = None,
+    ) -> None:
+        if capacity < 1:
+            raise ValueError(f"slot pool capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.backlog_bound = int(backlog_bound)
+        self._clock = clock
+        self._on_expired = on_expired
+        self._cond = threading.Condition()
+        self._waiting: Deque[Request] = deque()  # occupied slots, admission order
+        self._backlog: Deque[Request] = deque()
+        self._inflight: List[Request] = []
+        self._closed = False
+        # slot-resident obs staging. Rows must cover the occupied slot window
+        # AND the in-flight batch at once — continuous batching admits into
+        # slots while the previous dispatch still holds its rows — so the
+        # buffer carries 2 * capacity rows (waiting <= capacity, in-flight
+        # <= capacity, nothing else stages).
+        self._spec = obs_spec
+        self._buffers: Optional[List[np.ndarray]] = None
+        self._leaf_paths: Optional[List[Any]] = None
+        self._rows: Dict[int, int] = {}  # rid -> staged slot row
+        self._free_rows: List[int] = list(range(2 * self.capacity))
+        if obs_spec is not None:
+            import jax
+
+            leaves = jax.tree.leaves(obs_spec)
+            self._buffers = [
+                np.zeros((2 * self.capacity,) + tuple(s.shape), dtype=s.dtype) for s in leaves
+            ]
+
+    # ------------------------------------------------------------- admission
+    def offer(self, req: Request, *, front: bool = False) -> bool:
+        """Place ``req`` into a free slot (else the backlog). Returns False
+        when slots and backlog are both full — the caller (router) owns the
+        fleet-wide admission decision, this is per-replica capacity only.
+        ``front=True`` is the re-route path: the request was admitted before
+        anything now waiting here, so it goes ahead of the backlog (or into
+        the head of the slot window when one is free)."""
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("slot pool is shut down")
+            if len(self._waiting) < self.capacity:
+                self._stage(req)
+                if front:
+                    self._waiting.appendleft(req)
+                else:
+                    self._waiting.append(req)
+                self._cond.notify()
+                return True
+            if len(self._backlog) >= self.backlog_bound:
+                return False
+            if front:
+                self._backlog.appendleft(req)
+            else:
+                self._backlog.append(req)
+            return True
+
+    # -------------------------------------------------------------- dispatch
+    def take_batch(self, wait_timeout_s: float) -> List[Request]:
+        """Block up to ``wait_timeout_s`` for at least one occupied slot,
+        then take the whole occupied window (admission order) as the next
+        dispatch, refilling slots from the backlog. ``[]`` on timeout/close
+        so replica loops can heartbeat. Expired requests are completed
+        exceptionally here — by their own deadline — and never dispatched."""
+        expired: List[Request] = []
+        batch: List[Request] = []
+        with self._cond:
+            deadline = self._clock() + wait_timeout_s
+            while not self._waiting and not self._closed:
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    return []
+                self._cond.wait(remaining)
+            if self._closed and not self._waiting:
+                return []
+            now = self._clock()
+            while self._waiting:
+                req = self._waiting.popleft()
+                if req.future.done():  # hedge twin won, or already expired
+                    self._unstage(req)
+                    continue
+                (expired if req.expired(now) else batch).append(req)
+            for req in expired:
+                self._unstage(req)
+            self._inflight = list(batch)
+            self._refill_locked()
+        now = self._clock()
+        for req in expired:
+            req.fail_expired(now)
+            if self._on_expired is not None:
+                try:
+                    self._on_expired(req)
+                except Exception:
+                    pass
+        return batch
+
+    def complete_batch(self, batch: Sequence[Request]) -> None:
+        """Release the in-flight window (called by the replica after the
+        dispatch's futures are settled) and free the staged rows."""
+        with self._cond:
+            for req in batch:
+                self._unstage(req)
+            self._inflight = []
+            self._refill_locked()
+
+    def staged_batch(self, batch: Sequence[Request], rung: int) -> Any:
+        """Assemble the padded obs batch for ``batch`` at ladder rung
+        ``rung`` from the slot-resident staging rows (one vectorized gather
+        per leaf); falls back to request-held obs when staging is off."""
+        if self._buffers is None or self._spec is None:
+            from sheeprl_tpu.serve.model import stack_obs
+
+            return stack_obs(self._spec, [r.obs for r in batch], rung)
+        import jax
+
+        with self._cond:
+            # stage-on-demand backstop: a request can only be row-less here if
+            # the 2x-capacity invariant was violated; never fail a dispatch
+            # over it (the request still holds its obs).
+            for req in batch:
+                if req.rid not in self._rows:
+                    self._stage(req)
+            rows = [self._rows.get(r.rid) for r in batch]
+        leaves = []
+        for li, buf in enumerate(self._buffers):
+            out = np.zeros((rung,) + buf.shape[1:], dtype=buf.dtype)
+            if None not in rows:
+                out[: len(rows)] = buf[rows]
+            else:
+                for i, (req, row) in enumerate(zip(batch, rows)):
+                    if row is not None:
+                        out[i] = buf[row]
+                    else:
+                        out[i] = np.asarray(jax.tree.leaves(req.obs)[li], dtype=buf.dtype)
+            leaves.append(out)
+        treedef = jax.tree.structure(self._spec)
+        return jax.tree.unflatten(treedef, leaves)
+
+    # ------------------------------------------------------------ re-routing
+    def offer_front(self, reqs: Sequence[Request]) -> None:
+        """Plant an ordered block of already-admitted requests AHEAD of this
+        pool's backlog (the re-route-at-front path). Bypasses the backlog
+        bound for the same reason the micro-batcher's ``requeue`` bypassed
+        admission: these requests were admitted once — a fleet event they
+        didn't cause must not shed them. Relative order is preserved; they
+        ride the next dispatches as slots free up."""
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("slot pool is shut down")
+            for req in reversed(reqs):
+                if not req.future.done():
+                    self._backlog.appendleft(req)
+            self._refill_locked()
+
+    def requeue_failed(self, batch: Sequence[Request]) -> None:
+        """Hand a failed dispatch back to this pool at the front (the
+        single-replica inference-failure retry; the batch has waited
+        longest). Releases the in-flight window, so call INSTEAD of
+        ``complete_batch``."""
+        with self._cond:
+            for req in batch:
+                self._unstage(req)
+            self._inflight = []
+            if not self._closed:
+                for req in reversed(batch):
+                    if not req.future.done():
+                        self._backlog.appendleft(req)
+            self._refill_locked()
+
+    def drain(self) -> List[Request]:
+        """Pull every request this pool still owes work for — the in-flight
+        window first (it has waited longest), then occupied slots, then the
+        backlog, preserving admission order within each — so a dead replica's
+        work can be re-routed at the FRONT of a sibling. The pool stays open
+        (a restarted incarnation reuses it)."""
+        with self._cond:
+            drained = [r for r in self._inflight if not r.future.done()]
+            drained += [r for r in self._waiting if not r.future.done()]
+            drained += [r for r in self._backlog if not r.future.done()]
+            for req in list(self._waiting) + list(self._inflight):
+                self._unstage(req)
+            self._inflight = []
+            self._waiting.clear()
+            self._backlog.clear()
+        return drained
+
+    # ------------------------------------------------------------ inspection
+    def depth(self) -> int:
+        """Queued work (occupied slots + backlog), the autoscale signal."""
+        with self._cond:
+            return len(self._waiting) + len(self._backlog)
+
+    def outstanding(self) -> int:
+        """Everything this pool owes an answer for (queued + in flight), the
+        router's load score."""
+        with self._cond:
+            return len(self._waiting) + len(self._backlog) + len(self._inflight)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -------------------------------------------------------------- lifecycle
+    def close(self) -> None:
+        """Stop admitting; fail everything still queued with ServerClosed."""
+        with self._cond:
+            self._closed = True
+            pending = list(self._waiting) + list(self._backlog)
+            self._waiting.clear()
+            self._backlog.clear()
+            self._rows.clear()
+            self._free_rows = list(range(2 * self.capacity))
+            self._cond.notify_all()
+        for req in pending:
+            if not req.future.done():
+                try:
+                    req.future.set_exception(ServerClosed("slot pool is shut down"))
+                except Exception:
+                    pass
+
+    # -------------------------------------------------------------- internal
+    def _refill_locked(self) -> None:
+        while self._backlog and len(self._waiting) < self.capacity:
+            req = self._backlog.popleft()
+            if req.future.done():
+                continue
+            self._stage(req)
+            self._waiting.append(req)
+        if self._waiting:
+            self._cond.notify()
+
+    def _stage(self, req: Request) -> None:
+        if self._buffers is None:
+            return
+        if req.rid in self._rows or not self._free_rows:
+            return
+        import jax
+
+        row = self._free_rows.pop()
+        self._rows[req.rid] = row
+        for buf, leaf in zip(self._buffers, jax.tree.leaves(req.obs)):
+            buf[row] = np.asarray(leaf, dtype=buf.dtype)
+
+    def _unstage(self, req: Request) -> None:
+        if self._buffers is None:
+            return
+        row = self._rows.pop(req.rid, None)
+        if row is not None:
+            self._free_rows.append(row)

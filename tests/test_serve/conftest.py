@@ -61,6 +61,45 @@ def make_server(tmp_path):
         server.close()
 
 
+# fleet drill node merged over DRILL_SERVE: small fleet, fast autoscale and
+# hedge scans so chaos drills converge in tens of milliseconds
+DRILL_FLEET: Dict[str, Any] = {
+    "enabled": True,
+    "num_replicas": 2,
+    "min_replicas": 1,
+    "max_replicas": 2,
+    "backlog_per_replica": 64,
+    "hedge_scan_ms": 2.0,
+    "autoscale_interval_s": 0.05,
+}
+
+
+@pytest.fixture
+def make_fleet(tmp_path):
+    """Factory: a FleetServer over the same committed linear checkpoint.
+    ``fleet=`` overrides merge into the drill fleet node, other keywords into
+    the serve node; every fleet is closed at teardown."""
+    from sheeprl_tpu.serve.config import serve_config_from_cfg
+    from sheeprl_tpu.serve.fleet import FleetServer
+    from sheeprl_tpu.serve.policy import build_linear_policy
+
+    servers = []
+
+    def build(*, fleet: Optional[Dict[str, Any]] = None, **serve_overrides: Any):
+        ckpt_dir = str(tmp_path / "checkpoint")
+        path, state = commit_linear(ckpt_dir, 100, seed=0)
+        policy = build_linear_policy({"algo": {"name": "linear"}}, state)
+        node = {**DRILL_SERVE, **serve_overrides, "fleet": {**DRILL_FLEET, **(fleet or {})}}
+        cfg = serve_config_from_cfg({"serve": node})
+        server = FleetServer(policy, cfg, step=100, path=path, ckpt_dir=ckpt_dir)
+        servers.append(server)
+        return server, ckpt_dir, state
+
+    yield build
+    for server in servers:
+        server.close()
+
+
 def linear_obs(state: Dict[str, Any], value: float = 1.0):
     """A deterministic observation matching the linear policy's spec."""
     import numpy as np

@@ -1,0 +1,378 @@
+"""Replica-fleet drills (howto/serving.md, fleet section): warmup before
+traffic on every replica, health-weighted routing, hedged retries rescuing a
+stuck primary, router blackhole rescue, kill-mid-burst with zero dropped
+admitted requests, budget exhaustion -> masked degraded N-1, CPU spill for
+batch-priority traffic, elastic scale up/down — and the slow chaos ramp:
+kill a replica mid-ramp on a 4-replica fleet and hold the SLO on survivors.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serve.batching import Request
+from sheeprl_tpu.serve.errors import Overloaded
+
+from .conftest import expected_action, linear_obs
+
+pytestmark = [pytest.mark.serve, pytest.mark.fleet]
+
+
+def _wait_until(predicate, timeout_s=5.0, interval_s=0.005):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+# ------------------------------------------------------------------ fleet ----
+
+
+def test_fleet_warmup_then_correct_actions_and_snapshot(make_fleet):
+    server, _, state = make_fleet()
+    server.start()
+    assert sorted(server.warmup_s) == [1, 2, 4]
+    obs = linear_obs(state, value=0.5)
+    out = server.infer(obs)
+    np.testing.assert_allclose(out, expected_action(state, obs), rtol=1e-5)
+    snap = server.snapshot()
+    assert snap["completed"] == 1 and snap["serving_step"] == 100
+    assert snap["replicas_alive"] == 2 and not snap["degraded"]
+    fleet = snap["fleet"]
+    assert fleet["active_device_replicas"] == 2
+    assert fleet["router"]["routed"] == 1 and fleet["router"]["shed"] == 0
+    assert len(fleet["replicas"]) == 2
+    assert all(r["health"] > 0 for r in fleet["replicas"] if r["active"])
+
+
+def test_fleet_admission_bound_sheds_typed(make_fleet):
+    server, _, state = make_fleet(
+        fleet={"max_pending": 1, "num_replicas": 1, "max_replicas": 1},
+        fault_injection={
+            "enabled": True,
+            "faults": [
+                {"kind": "slow_inference", "replica": 0, "at_batch": 0, "duration_s": 0.2, "for_batches": 50}
+            ],
+        },
+    )
+    server.start()
+    reqs = []
+    shed = 0
+    for _ in range(6):
+        try:
+            reqs.append(server.submit(linear_obs(state), deadline_s=5.0))
+        except Overloaded:
+            shed += 1
+    assert shed >= 1  # past the fleet-wide pending bound: typed, immediate
+    for req in reqs:
+        server.wait(req)  # admitted requests still complete
+    assert server.router.shed == shed
+
+
+def test_kill_replica_mid_burst_zero_dropped(make_fleet):
+    """The fast chaos drill: kill a replica while a burst is in flight —
+    every admitted request completes (re-route-at-front), the fleet restarts
+    the dead replica, and the survivors keep serving."""
+    server, _, state = make_fleet(
+        fleet={"num_replicas": 2, "max_replicas": 2, "max_pending": 10_000}
+    )
+    server.start()
+    results, errors = [], []
+
+    def client(n):
+        for i in range(n):
+            try:
+                obs = linear_obs(state, value=float(i % 7))
+                out = server.infer(obs, deadline_s=10.0)
+                np.testing.assert_allclose(out, expected_action(state, obs), rtol=1e-5)
+                results.append(out)
+            except Exception as err:  # noqa: BLE001 — drill collects everything
+                errors.append(err)
+
+    threads = [threading.Thread(target=client, args=(30,)) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.02)
+    assert server.kill_replica(0)
+    for t in threads:
+        t.join(20.0)
+    assert not errors and len(results) == 120
+    assert _wait_until(lambda: server.slots[0].alive)  # budgeted restart
+    snap = server.snapshot()
+    assert snap["failed"] == 0 and snap["restarts"] >= 1
+    assert snap["fleet"]["router"]["rerouted_requests"] >= 0  # counter present
+
+
+def test_budget_exhaustion_masks_and_fleet_serves_degraded(make_fleet):
+    server, _, state = make_fleet(
+        max_restarts=1,
+        restart_refund_s=None,
+        fleet={"num_replicas": 2, "max_replicas": 2},
+    )
+    server.start()
+    for _ in range(2):  # budget of 1: second death masks the slot
+        assert _wait_until(lambda: server.slots[0].alive)
+        server.kill_replica(0)
+        assert _wait_until(lambda: not server.slots[0].alive, timeout_s=2.0)
+        _wait_until(lambda: server.slots[0].masked or server.slots[0].restart_at is not None or server.slots[0].alive)
+    assert _wait_until(lambda: server.slots[0].masked)
+    obs = linear_obs(state)
+    np.testing.assert_allclose(server.infer(obs), expected_action(state, obs), rtol=1e-5)
+    snap = server.snapshot()
+    assert snap["degraded"] and snap["replicas_masked"] == 1
+    assert snap["fleet"]["active_device_replicas"] == 1  # N-1, still serving
+
+
+def test_emergency_floor_reactivates_standby_after_last_replica_masked(make_fleet):
+    """Losing the LAST active replica (masked, budget spent) must not strand
+    the fleet at zero capacity: the autoscaler's emergency floor activates a
+    standby slot immediately — no queue-depth signal required, because an
+    empty fleet can never generate one — and the hedge scan re-places every
+    stranded request on the recovered capacity."""
+    server, _, state = make_fleet(
+        max_restarts=0,
+        restart_refund_s=None,
+        fleet={"num_replicas": 1, "min_replicas": 1, "max_replicas": 2, "max_pending": 10_000},
+    )
+    server.start()
+    obs = linear_obs(state)
+    server.infer(obs)
+    server.kill_replica(0)
+    assert _wait_until(lambda: server.slots[0].masked, timeout_s=5.0)
+    # a request submitted into the dead window is parked unplaced and
+    # rescued once the standby comes up
+    req = server.submit(obs, deadline_s=10.0)
+    np.testing.assert_allclose(server.wait(req), expected_action(state, obs), rtol=1e-5)
+    assert server.slots[1].alive and server.slots[1].active
+    snap = server.snapshot()
+    assert snap["degraded"] and snap["fleet"]["active_device_replicas"] == 1
+    assert snap["fleet"]["scale_ups"] >= 1
+
+
+def test_cpu_spill_absorbs_batch_priority(make_fleet):
+    server, _, state = make_fleet(
+        fleet={
+            "num_replicas": 1,
+            "max_replicas": 1,
+            "cpu_spill_replicas": 1,
+            "spill_depth": 0,  # device "saturated" immediately: spill opens
+        }
+    )
+    server.start()
+    spill_index = server.config.fleet.max_replicas  # spill slots follow device slots
+    obs = linear_obs(state)
+    req = server.submit(obs, deadline_s=5.0, priority="batch")
+    assert req.placements == [spill_index]
+    np.testing.assert_allclose(server.wait(req), expected_action(state, obs), rtol=1e-5)
+    assert server.router.spilled == 1
+    # interactive traffic never lands on the spill tier while a device
+    # replica is routable
+    req = server.submit(obs, deadline_s=5.0)
+    assert req.placements and req.placements[0] != spill_index
+    server.wait(req)
+
+
+def test_autoscale_up_under_pressure_then_down_when_idle(make_fleet):
+    server, _, state = make_fleet(
+        fleet={
+            "num_replicas": 1,
+            "min_replicas": 1,
+            "max_replicas": 2,
+            "max_pending": 10_000,
+            "scale_up_depth": 2.0,
+            "scale_down_depth": 0.5,
+            "scale_patience": 1,
+            "autoscale_interval_s": 0.02,
+        },
+        fault_injection={
+            "enabled": True,
+            "faults": [
+                {"kind": "slow_inference", "replica": 0, "at_batch": 0, "duration_s": 0.1, "for_batches": 30}
+            ],
+        },
+    )
+    server.start()
+    assert server.snapshot()["fleet"]["active_device_replicas"] == 1
+    reqs = [server.submit(linear_obs(state, value=float(i)), deadline_s=30.0) for i in range(24)]
+    assert _wait_until(lambda: server.scale_ups >= 1, timeout_s=5.0)
+    for req in reqs:
+        # the scaled-up replica (no fault) plus hedges past the latency
+        # quantile drain the backlog
+        server.wait(req)
+    assert _wait_until(lambda: server.scale_downs >= 1, timeout_s=5.0)
+    snap = server.snapshot()
+    assert snap["fleet"]["scale_ups"] >= 1 and snap["fleet"]["scale_downs"] >= 1
+    assert snap["fleet"]["active_device_replicas"] == 1  # back at the floor
+    assert snap["failed"] == 0
+
+
+# ----------------------------------------------------------------- router ----
+
+
+def _pools(n, capacity=4):
+    from sheeprl_tpu.serve.slots import SlotPool
+
+    return [SlotPool(capacity=capacity, backlog_bound=64) for _ in range(n)]
+
+
+def _targets(pools, healths=None, kinds=None):
+    from sheeprl_tpu.serve.router import RouteTarget
+
+    healths = healths or [1.0] * len(pools)
+    kinds = kinds or ["device"] * len(pools)
+    return lambda: [
+        RouteTarget(i, p, h, k) for i, (p, h, k) in enumerate(zip(pools, healths, kinds))
+    ]
+
+
+def test_router_health_weighted_least_loaded():
+    from sheeprl_tpu.serve.router import Router
+
+    pools = _pools(3)
+    now = time.monotonic()
+    # pool 0 holds 2 requests, sickly pool 1 holds 1, pool 2 is empty
+    for _ in range(2):
+        pools[0].offer(Request(None, now, now + 60.0))
+    pools[1].offer(Request(None, now, now + 60.0))
+    healths = [1.0, 0.1, 1.0]
+    router = Router(targets=_targets(pools, healths), max_pending=100, slo_s=0.1)
+    req = router.submit(None, 60.0)
+    assert req.placements == [2]  # least loaded wins outright
+    # saturate pool 2: now the sick-but-emptier pool 1 (1/0.1 = 10) loses to
+    # the healthy-but-busier pool 0 (2/1.0 = 2) — traffic tapers off a
+    # struggling replica before the supervisor ever declares it dead
+    for _ in range(3):
+        pools[2].offer(Request(None, now, now + 60.0))
+    req2 = router.submit(None, 60.0)
+    assert req2.placements == [0]
+    router.close()
+
+
+def test_hedged_retry_first_completion_wins():
+    """A request stuck on a silent primary is duplicated to a sibling after
+    the hedge threshold; the twin's completion wins the Future and the
+    loser's copy is dropped at its pool's next dispatch assembly."""
+    from sheeprl_tpu.serve.router import Router
+    from sheeprl_tpu.serve.slots import safe_complete
+
+    pools = _pools(2)
+    router = Router(
+        targets=_targets(pools),
+        max_pending=100,
+        slo_s=0.02,  # few samples -> hedge threshold = max(floor, slo)
+        hedge_scan_s=0.002,
+    ).start()
+    req = router.submit(np.float32(7.0), 60.0)
+    assert req.placements == [0]
+    assert _wait_until(lambda: req.hedges == 1, timeout_s=5.0)
+    assert req.placements == [0, 1]
+    # the sibling serves the hedge twin
+    batch = pools[1].take_batch(1.0)
+    assert [r.rid for r in batch] == [req.rid]
+    assert safe_complete(batch[0], "served-by-1")
+    pools[1].complete_batch(batch)
+    assert req.future.result(timeout=1.0) == "served-by-1"
+    # the loser's copy is skipped (future already done), not served dead
+    assert pools[0].take_batch(0.05) == []
+    assert _wait_until(lambda: router.hedged_won == 1, timeout_s=2.0)
+    assert router.hedged == 1
+    router.close()
+
+
+def test_router_blackhole_rescued_by_scan():
+    from sheeprl_tpu.serve.fault_injection import parse_serve_faults, ServeFaultSchedule
+    from sheeprl_tpu.serve.router import Router
+
+    pools = _pools(2)
+    schedule = ServeFaultSchedule(
+        parse_serve_faults([
+            {"kind": "router_blackhole", "at_request": 0, "duration_s": 0.05}
+        ])
+    )
+    router = Router(
+        targets=_targets(pools),
+        max_pending=100,
+        slo_s=60.0,  # hedging out of the picture: only the rescue path moves it
+        hedge_scan_s=0.002,
+        fault_schedule=schedule,
+    ).start()
+    req = router.submit(None, 60.0)
+    assert req.placements == []  # swallowed at the front door
+    assert router.blackholed == 1
+    assert _wait_until(lambda: req.placements != [], timeout_s=5.0)  # rescued
+    assert pools[req.placements[0]].outstanding() == 1
+    router.close()
+
+
+def test_reroute_at_front_lands_on_healthiest_sibling():
+    from sheeprl_tpu.serve.router import Router
+
+    pools = _pools(3, capacity=2)
+    now = time.monotonic()
+    pools[2].offer(Request(None, now, now + 60.0))  # sibling 2 is busier
+    router = Router(targets=_targets(pools), max_pending=100, slo_s=60.0)
+    victims = [router.submit(None, 60.0) for _ in range(2)]
+    assert all(v.placements == [1] or v.placements == [0] for v in victims)
+    dead = victims[0].placements[0]
+    moved = router.reroute(dead, pools[dead], "drill")
+    survivors = [v for v in victims if v.placements[0] == dead]
+    assert moved == len(survivors)
+    for v in survivors:
+        assert v.rerouted == 1 and v.placements[-1] not in (dead, 2)
+    assert router.rerouted_requests == moved
+    router.close()
+
+
+# ------------------------------------------------------------- chaos ramp ----
+
+
+@pytest.mark.slow
+def test_chaos_ramp_kill_mid_ramp_holds_slo_on_survivors(make_fleet):
+    """The headline drill: a 4-replica fleet under a stepped saturation
+    ramp; one replica is killed as the second step begins. Zero admitted
+    requests are dropped or expired, the ramp still finds a knee, and the
+    surviving N-1 fleet holds the SLO at the knee."""
+    from sheeprl_tpu.serve.config import LoadConfig
+    from sheeprl_tpu.serve.loadgen import run_ramp
+
+    server, _, state = make_fleet(
+        slo_ms=500.0,
+        max_restarts=0,  # the dead replica stays dead: survivors own the SLO
+        restart_refund_s=None,
+        fleet={
+            # min == num == max: the elasticity is pinned out of the drill —
+            # this one measures crash resilience on a fixed fleet
+            "num_replicas": 4,
+            "min_replicas": 4,
+            "max_replicas": 4,
+            "max_pending": 10_000,
+        },
+    )
+    server.start()
+    assert server.snapshot()["replicas_alive"] == 4
+    killed = []
+
+    def on_step(step, rate):
+        if step == 1:
+            killed.append(server.kill_replica(0))
+
+    report = run_ramp(
+        server,
+        LoadConfig(enabled=True, duration_s=1.0, concurrency=8, max_retries=5, seed=0),
+        rates_hz=[60.0, 100.0, 160.0],
+        step_duration_s=0.6,
+        on_step=on_step,
+    )
+    assert killed == [True]
+    total_expired = sum(s["expired"] for s in report["steps"])
+    total_errors = sum(s["errors"] for s in report["steps"])
+    assert total_expired == 0 and total_errors == 0  # zero dropped admitted
+    assert report["knee_rate_hz"] is not None and report["max_good_qps"] > 0
+    snap = server.snapshot()
+    assert snap["replicas_alive"] == 3  # survivors, no restart budget
+    assert snap["shed_expired"] == 0 and snap["failed"] == 0
+    assert snap["p95_ms"] is not None and snap["p95_ms"] <= server.config.slo_ms

@@ -1,0 +1,186 @@
+"""Ordering property tests for the continuous-batching slot pool and the
+crash re-route path (seeded randomized trials — the repo carries no
+hypothesis dependency, so each property runs across many seeded
+interleavings instead).
+
+The two contracts under test (sheeprl_tpu/serve/slots.py docstring):
+
+1. **admission order is dispatch order** — within a pool, an admitted
+   request is never reordered behind a later admission, across any
+   interleaving of offers, dispatches and completions.
+2. **re-route-at-front** — when a replica dies mid-flight, its drained work
+   (in-flight window first, admission order preserved) lands AHEAD of the
+   surviving pool's backlog: no admitted request is dropped, none is
+   duplicated, neither pool's internal admission order is disturbed, and no
+   request is expired by a crash it didn't cause.
+"""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serve.batching import Request
+from sheeprl_tpu.serve.slots import SlotPool, safe_complete
+
+pytestmark = [pytest.mark.serve, pytest.mark.fleet]
+
+FAR = 3600.0  # deadlines far enough that only a bug could expire a request
+
+
+def _req():
+    now = time.monotonic()
+    return Request(None, now, now + FAR)
+
+
+def _dispatch_all(pool):
+    """Serve the pool dry, returning the request dispatch order."""
+    order = []
+    while pool.depth() or pool.outstanding():
+        batch = pool.take_batch(0.0)
+        if not batch:
+            break
+        order.extend(batch)
+        for req in batch:
+            safe_complete(req, "ok")
+        pool.complete_batch(batch)
+    return order
+
+
+def test_admission_order_is_dispatch_order_across_interleavings():
+    for seed in range(25):
+        rng = random.Random(seed)
+        pool = SlotPool(capacity=rng.choice([1, 2, 4]), backlog_bound=128)
+        admitted, dispatched = [], []
+        for _ in range(rng.randrange(20, 60)):
+            if rng.random() < 0.6:
+                req = _req()
+                assert pool.offer(req)
+                admitted.append(req)
+            else:
+                batch = pool.take_batch(0.0)
+                dispatched.extend(batch)
+                for req in batch:
+                    safe_complete(req, "ok")
+                pool.complete_batch(batch)
+        dispatched.extend(_dispatch_all(pool))
+        assert [r.rid for r in dispatched] == [r.rid for r in admitted], f"seed {seed}"
+        assert all(r.future.result(timeout=0) == "ok" for r in admitted)
+        pool.close()
+
+
+def test_staging_survives_admission_during_inflight_batch():
+    """Continuous batching admits into slots while the previous dispatch
+    still holds its staged rows — the pool must stage BOTH windows at once
+    (regression: rows sized to the slot window alone left mid-flight
+    admissions row-less, and the next dispatch assembly blew up, turning
+    sustained load into an inference-failure storm)."""
+    import jax
+
+    spec = {"vector": jax.ShapeDtypeStruct((3,), np.float32)}
+    for seed in range(25):
+        rng = random.Random(seed)
+        cap = rng.choice([1, 2, 4])
+        pool = SlotPool(capacity=cap, backlog_bound=64, obs_spec=spec)
+        value = {}
+
+        def req():
+            now = time.monotonic()
+            r = Request(
+                {"vector": np.full((3,), float(len(value)), np.float32)}, now, now + FAR
+            )
+            value[r.rid] = float(len(value))
+            return r
+
+        inflight = []
+        for _ in range(rng.randrange(20, 60)):
+            roll = rng.random()
+            if roll < 0.55:
+                pool.offer(req())
+            elif roll < 0.8 and not inflight:
+                inflight = pool.take_batch(0.0)
+            elif inflight:
+                # assemble while later admissions sit staged in the slots
+                staged = pool.staged_batch(inflight, cap)
+                got = staged["vector"][: len(inflight), 0]
+                want = [value[r.rid] for r in inflight]
+                assert got.tolist() == want, f"seed {seed}: staged rows corrupt"
+                for r in inflight:
+                    safe_complete(r, "ok")
+                pool.complete_batch(inflight)
+                inflight = []
+        while inflight or pool.depth():
+            if not inflight:
+                inflight = pool.take_batch(0.0)
+            staged = pool.staged_batch(inflight, cap)
+            got = staged["vector"][: len(inflight), 0]
+            assert got.tolist() == [value[r.rid] for r in inflight], f"seed {seed}"
+            for r in inflight:
+                safe_complete(r, "ok")
+            pool.complete_batch(inflight)
+            inflight = []
+        pool.close()
+
+
+def test_crash_reroute_at_front_never_reorders_drops_or_expires():
+    for seed in range(25):
+        rng = random.Random(seed)
+        pool_a = SlotPool(capacity=rng.choice([2, 4]), backlog_bound=128)
+        pool_b = SlotPool(capacity=rng.choice([2, 4]), backlog_bound=128)
+        admitted = {id(pool_a): [], id(pool_b): []}
+        # phase 1: random admissions to both pools, occasional dispatches on
+        # B, and A "takes a batch" it will never finish (the in-flight window
+        # a crash strands)
+        dispatched_b = []
+        for _ in range(rng.randrange(10, 40)):
+            pool = rng.choice([pool_a, pool_b])
+            req = _req()
+            assert pool.offer(req)
+            admitted[id(pool)].append(req)
+            if rng.random() < 0.2:
+                batch = pool_b.take_batch(0.0)
+                dispatched_b.extend(batch)
+                for r in batch:
+                    safe_complete(r, "ok")
+                pool_b.complete_batch(batch)
+        stranded = pool_a.take_batch(0.0)  # A dies holding this window
+
+        # phase 2: the crash — drain A (in-flight first, admission order) and
+        # plant the block at the front of B, ahead of B's backlog
+        drained = pool_a.drain()
+        assert [r.rid for r in drained] == [r.rid for r in admitted[id(pool_a)]], (
+            f"seed {seed}: drain lost admission order (in-flight window "
+            f"{[r.rid for r in stranded]})"
+        )
+        pool_b.offer_front(drained)
+
+        # phase 3: post-crash admissions to the survivor only
+        post = []
+        for _ in range(rng.randrange(0, 15)):
+            req = _req()
+            assert pool_b.offer(req)
+            post.append(req)
+
+        order = dispatched_b + _dispatch_all(pool_b)
+        rids = [r.rid for r in order]
+
+        # zero dropped, zero duplicated: every admitted request dispatched once
+        everything = admitted[id(pool_a)] + admitted[id(pool_b)] + post
+        assert sorted(rids) == sorted(r.rid for r in everything), f"seed {seed}"
+        # per-source admission order survives the re-route
+        for source in (admitted[id(pool_a)], admitted[id(pool_b)], post):
+            want = [r.rid for r in source]
+            assert [rid for rid in rids if rid in set(want)] == want, f"seed {seed}"
+        # the re-routed block went AHEAD of B's backlog: every A request
+        # dispatches before every post-crash admission
+        if admitted[id(pool_a)] and post:
+            last_a = max(rids.index(r.rid) for r in admitted[id(pool_a)])
+            first_post = min(rids.index(r.rid) for r in post)
+            assert last_a < first_post, f"seed {seed}: re-route fell behind later admissions"
+        # nothing expired: a crash-induced re-route must not cost a request
+        # its deadline (all deadlines are an hour out)
+        for req in everything:
+            assert req.future.result(timeout=0) == "ok", f"seed {seed}: rid {req.rid} expired"
+        pool_a.close()
+        pool_b.close()

@@ -24,6 +24,10 @@ overlap_fraction    higher  heartbeat rollup (env time hidden behind train)
 mfu                 higher  last heartbeat MFU
 serve_qps           higher  serve run_end stats (``serve.stats.qps``)
 serve_p95_ms        lower   serve run_end stats (``serve.stats.p95_ms``)
+qps@p95             higher  SLO-conditioned goodput: the load/ramp report's
+                            completed QPS while p95 <= SLO, else 0 (fleet
+                            acceptance cells gate on this — throughput that
+                            blows the SLO counts as zero)
 worker_restarts     lower   rollout supervision totals (slack 1)
 masked_slots        lower   rollout supervision totals (slack 1)
 nan_rollbacks       lower   resilience totals (slack 1)
@@ -67,6 +71,7 @@ METRICS: Dict[str, Tuple[bool, float]] = {
     "mfu": (True, 0.0),
     "serve_qps": (True, 0.0),
     "serve_p95_ms": (False, 0.0),
+    "qps@p95": (True, 0.0),
     "worker_restarts": (False, 1.0),
     "masked_slots": (False, 1.0),
     "nan_rollbacks": (False, 1.0),
@@ -175,7 +180,30 @@ def record_metrics(rec: Dict[str, Any]) -> Dict[str, float]:
         out["serve_qps"] = float(stats["qps"])
     if isinstance(stats.get("p95_ms"), (int, float)):
         out["serve_p95_ms"] = float(stats["p95_ms"])
+    goodput = slo_goodput(stats)
+    if goodput is not None:
+        out["qps@p95"] = goodput
     return out
+
+
+def slo_goodput(stats: Dict[str, Any]) -> Optional[float]:
+    """``qps@p95``: completed QPS while p95 <= SLO, else 0.0. Prefers the
+    load/ramp report inside the snapshot (measured under offered load; a
+    ramp's ``max_good_qps`` already encodes the conditioning), falling back
+    to the server-side uptime counters."""
+    report = stats.get("load_report")
+    if isinstance(report, dict):
+        if report.get("mode") == "ramp":
+            value = report.get("max_good_qps")
+            return float(value) if isinstance(value, (int, float)) else None
+        qps, p95, slo = report.get("qps"), report.get("p95_ms"), report.get("slo_ms")
+        if isinstance(qps, (int, float)):
+            met = isinstance(p95, (int, float)) and isinstance(slo, (int, float)) and p95 <= slo
+            return float(qps) if met else 0.0
+    qps, p95, slo = stats.get("qps"), stats.get("p95_ms"), stats.get("slo_ms")
+    if isinstance(qps, (int, float)) and isinstance(p95, (int, float)) and isinstance(slo, (int, float)):
+        return float(qps) if p95 <= slo else 0.0
+    return None
 
 
 def _metric_verdict(
@@ -343,6 +371,16 @@ def self_test() -> int:
         rec(2, "ppo", 310.0, variant="fused_rollout"),
         rec(3, "ppo", 315.0, variant="fused_rollout"),
     ]
+    # fleet serve cells gate SLO-conditioned goodput: blowing the SLO zeroes
+    # qps@p95 even when raw QPS looks healthy
+    def serve_rec(t, qps, p95):
+        r = rec(t, "ppo", None, variant="fleet")
+        r.pop("sps_env")
+        r["kind"] = "serve"
+        r["serve_stats"] = {"qps": qps, "p95_ms": p95, "slo_ms": 100.0}
+        return r
+
+    records += [serve_rec(1, 400.0, 40.0), serve_rec(2, 410.0, 45.0), serve_rec(3, 405.0, 50.0)]
     doc = evaluate(records)
     got = {}
     for key, cell in doc["cells"].items():
@@ -358,6 +396,17 @@ def self_test() -> int:
         failures.append(f"variant cell: want separate 3-run pass cell, got {fused}")
     if doc["cells"]["train:ppo:CartPole-v1:cpux1p1"]["runs"] != 4:
         failures.append("variant records leaked into the base cell history")
+    fleet_cell = doc["cells"].get("serve:ppo:CartPole-v1:cpux1p1:fleet")
+    if (
+        fleet_cell is None
+        or fleet_cell["verdict"] != "pass"
+        or "qps@p95" not in (fleet_cell.get("metrics") or {})
+    ):
+        failures.append(f"fleet serve cell: want 3-run pass cell gating qps@p95, got {fleet_cell}")
+    if slo_goodput({"qps": 900.0, "p95_ms": 250.0, "slo_ms": 100.0}) != 0.0:
+        failures.append("qps@p95: an SLO miss must zero the goodput")
+    if slo_goodput({"load_report": {"mode": "ramp", "max_good_qps": 123.0}}) != 123.0:
+        failures.append("qps@p95: a ramp report's max_good_qps must win over uptime counters")
     if exit_code(doc) != 1:
         failures.append(f"exit code: want 1, got {exit_code(doc)}")
     if exit_code(evaluate([r for r in records if r["algo"] != "sac"])) != 0:
