@@ -240,6 +240,7 @@ class FleetSlot:
         self.masked = False
         self.mask_reason: Optional[str] = None
         self.restart_at: Optional[float] = None
+        self.restart_deferrals = 0  # restarts held back by a hung-alive thread
         self.restarts = 0
         self.total_requests = 0
         self.total_failures = 0
@@ -253,6 +254,10 @@ class FleetSlot:
         age so a struggling replica sheds traffic before it is declared
         hung."""
         if not self.active or self.masked or self.retiring or not self.alive:
+            return 0.0
+        if self.restart_at is not None:
+            # declared hung, awaiting restart: the thread may be alive (stuck
+            # in a dispatch) but nothing will serve new work until respawn
             return 0.0
         if self.stats is None:
             return 0.0
@@ -552,40 +557,75 @@ class FleetServer:
         while not self._closing.is_set():
             now = time.monotonic()
             for slot in self.slots:
-                if not slot.active or slot.masked:
-                    continue
-                if slot.restart_at is not None:
-                    if now >= slot.restart_at:
-                        slot.restart_at = None
-                        self._spawn(slot)
-                    continue
-                if not slot.alive:
-                    reason = (
-                        slot.thread.exit_reason if slot.thread is not None else None
-                    ) or "thread exited"
-                    self._handle_fault(slot, reason)
-                elif (
-                    slot.stats is not None
-                    and now - slot.stats.heartbeat > self.config.replica_timeout_s
-                ):
-                    age = now - slot.stats.heartbeat
-                    slot.thread.request_stop()
-                    self._event("replica_hung", {"replica": slot.index, "heartbeat_age_s": age})
-                    self._handle_fault(slot, f"hung (heartbeat {age:.1f}s stale)")
+                try:
+                    self._supervise_slot(slot, now)
+                except Exception as err:
+                    # one bad pass on one slot must not kill the fleet's only
+                    # supervision thread (mirrors the hedge scan's loop)
+                    self._event(
+                        "monitor_error", {"replica": slot.index, "error": repr(err)}
+                    )
             if now - self._last_autoscale_t >= fleet.autoscale_interval_s:
                 self._last_autoscale_t = now
                 try:
                     self._autoscale()
-                except Exception:
-                    pass
+                except Exception as err:
+                    self._event("monitor_error", {"replica": None, "error": repr(err)})
             self._closing.wait(interval)
+
+    def _supervise_slot(self, slot: FleetSlot, now: float) -> None:
+        if not slot.active or slot.masked:
+            return
+        if slot.restart_at is not None:
+            if now < slot.restart_at:
+                return
+            prev = slot.thread
+            if prev is not None and prev.is_alive():
+                prev.join(0.05)
+            if prev is not None and prev.is_alive():
+                # the hung incarnation is still inside a dispatch on this
+                # pool: a second thread on the same pool would race it, so
+                # the restart waits until the old thread is confirmed dead
+                # (its late complete/requeue is ownership-checked anyway)
+                slot.restart_at = now + max(self.config.monitor_interval_s, 0.05)
+                if slot.restart_deferrals == 0:
+                    self._event("replica_restart_deferred", {"replica": slot.index})
+                slot.restart_deferrals += 1
+                return
+            slot.restart_at = None
+            slot.restart_deferrals = 0
+            self._spawn(slot)
+            return
+        if not slot.alive:
+            reason = (
+                slot.thread.exit_reason if slot.thread is not None else None
+            ) or "thread exited"
+            self._handle_fault(slot, reason)
+        elif (
+            slot.stats is not None
+            and now - slot.stats.heartbeat > self.config.replica_timeout_s
+        ):
+            age = now - slot.stats.heartbeat
+            slot.thread.request_stop()
+            self._event("replica_hung", {"replica": slot.index, "heartbeat_age_s": age})
+            self._handle_fault(slot, f"hung (heartbeat {age:.1f}s stale)")
 
     def _handle_fault(self, slot: FleetSlot, reason: str) -> None:
         """Crash-requeue-at-front, fleet edition: the dead replica's work is
         re-routed to a sibling FIRST, then the restart/mask decision runs —
         recovery of the *work* never waits on recovery of the *worker*."""
         if self.router is not None:
-            self.router.reroute(slot.index, slot.pool, reason)
+            # a dead thread's in-flight window re-homes in full; a hung but
+            # still-alive thread may yet finish its dispatch, so only its
+            # idempotent requests are duplicated (hedge semantics) — the
+            # rest complete when it wakes or expire by their own deadline
+            alive = slot.thread is not None and slot.thread.is_alive()
+            self.router.reroute(
+                slot.index,
+                slot.pool,
+                reason,
+                inflight="idempotent" if alive else "all",
+            )
         slot.fold_stats()
         if slot.budget.exhausted:
             slot.masked = True
@@ -678,7 +718,16 @@ class FleetServer:
                 slot = device_slots[-1]
                 slot.retiring = True  # router stops targeting it immediately
                 if self.router is not None:
-                    self.router.reroute(slot.index, slot.pool, "scale_down")
+                    # a healthy retiring thread finishes its own in-flight
+                    # dispatch (re-homing it would double-run non-idempotent
+                    # requests); only its queued work moves to a sibling
+                    alive = slot.thread is not None and slot.thread.is_alive()
+                    self.router.reroute(
+                        slot.index,
+                        slot.pool,
+                        "scale_down",
+                        inflight="none" if alive else "all",
+                    )
                 if slot.thread is not None:
                     slot.thread.request_stop()
                 slot.active = False
@@ -688,6 +737,19 @@ class FleetServer:
 
     # --------------------------------------------------------------- internal
     def _spawn(self, slot: FleetSlot) -> None:
+        prev = slot.thread
+        if prev is not None and prev.is_alive():
+            # never run two incarnations on one pool: stop the old thread and
+            # give it a beat to exit; if it is still alive (hung mid-dispatch,
+            # or a retired thread draining its window) arm a deferred restart
+            # and let the monitor spawn once it is confirmed dead
+            prev.request_stop()
+            prev.join(0.05)
+            if prev.is_alive():
+                slot.restart_at = time.monotonic() + max(
+                    self.config.monitor_interval_s, 0.05
+                )
+                return
         if slot.ladder is None:
             slot.ladder = self._ladder_for(slot.device)
         slot.stats = ReplicaStats()

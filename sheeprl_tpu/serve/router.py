@@ -29,7 +29,11 @@ decision, so the per-replica machinery can stay dumb:
   preserved) and plants the work at the FRONT of the healthiest sibling:
   the single-server crash-requeue-at-front contract, promoted across
   replicas. Zero admitted requests are dropped by a crash; each still
-  expires only by its own deadline.
+  expires only by its own deadline. The in-flight window re-homes in full
+  only when the replica thread is confirmed dead — a hung-but-alive thread
+  may still finish its dispatch, so only its idempotent requests are
+  duplicated (hedge semantics) and a healthy retiring thread keeps its
+  whole window.
 - **blackhole drill** — a scheduled ``router_blackhole`` fault makes the
   router swallow assignments for ``duration_s``: requests are admitted but
   reach no replica, and the hedge scan must rescue every one of them. This
@@ -135,6 +139,7 @@ class Router:
         self.rerouted_requests = 0
         self.blackholed = 0
         self.spilled = 0
+        self.expired = 0  # backstop expiries of unplaced requests
 
     # -------------------------------------------------------------- lifecycle
     def start(self) -> "Router":
@@ -165,7 +170,10 @@ class Router:
         if self._closing.is_set():
             raise ServerClosed("fleet router is shut down")
         now = self._clock()
-        depth = self.pending_depth()
+        # queued depth alone misses admitted-but-unplaced requests (blackhole
+        # window, every pool full): they occupy no pool, so they must count
+        # here or a blackhole makes the fleet-wide bound unenforceable
+        depth = self.pending_depth() + self.unplaced_inflight()
         if depth >= self.max_pending:
             self.shed += 1
             raise Overloaded(depth, self.max_pending, self._slo_s / 5.0)
@@ -263,7 +271,16 @@ class Router:
                     self.hedged_won += 1
                 continue
             if now >= req.deadline_t:
-                continue  # its pool expires it against its own deadline
+                # backstop expiry: a placed request is normally expired by
+                # its pool at dispatch assembly, but an unplaced one (black-
+                # holed, every pool full, re-route with no live sibling) is
+                # in NO pool — without this it would leak in-flight forever
+                # and its consumer would hang on a raw future
+                req.fail_expired(now)
+                with self._lock:
+                    self._inflight.pop(req.rid, None)
+                self.expired += 1
+                continue
             if not req.placements and now >= self._blackhole_until:
                 # swallowed by a blackhole (or every pool was full): rescue
                 if self._place(req, now):
@@ -288,13 +305,20 @@ class Router:
                     )
 
     # -------------------------------------------------------------- re-routing
-    def reroute(self, index: int, pool: SlotPool, reason: str) -> int:
+    def reroute(self, index: int, pool: SlotPool, reason: str, *, inflight: str = "all") -> int:
         """Drain a dead/retiring replica's pool and plant the work — in
         admission order — at the FRONT of the healthiest surviving sibling.
         Returns how many requests were re-homed. Requests with no live
         sibling stay tracked in-flight; the hedge scan keeps retrying them
-        until a replica returns or their own deadline expires."""
-        drained = pool.drain()
+        until a replica returns or their own deadline expires.
+
+        ``inflight`` (see :meth:`SlotPool.drain`) scopes the in-flight
+        window: ``"all"`` only when the replica thread is confirmed dead —
+        re-homing a live thread's window would run non-idempotent requests
+        twice. A hung-but-alive replica uses ``"idempotent"`` (duplication
+        there is hedging: first completion wins), a healthy retiring one
+        ``"none"``."""
+        drained = pool.drain(inflight=inflight)
         if not drained:
             return 0
         moved = 0
@@ -306,13 +330,17 @@ class Router:
             for t in self._ranked_targets_any()
             if t.index != index and t.health > 0 and not t.pool.closed
         ]
-        if targets:
-            targets[0].pool.offer_front(drained)
+        for target in targets:
+            try:
+                target.pool.offer_front(drained)
+            except ServerClosed:
+                continue  # closed between the ranking and the offer: next one
             for req in drained:
                 if isinstance(req, RoutedRequest):
-                    req.placements.append(targets[0].index)
+                    req.placements.append(target.index)
             moved = len(drained)
-        else:
+            break
+        if moved == 0:
             # nowhere to go right now: leave them in-flight; the scan retries
             for req in drained:
                 if isinstance(req, RoutedRequest):
@@ -337,6 +365,16 @@ class Router:
         with self._lock:
             return len(self._inflight)
 
+    def unplaced_inflight(self) -> int:
+        """Admitted requests currently in NO pool (blackholed, or every pool
+        was full/closed at placement). Part of the admission signal."""
+        with self._lock:
+            return sum(
+                1
+                for r in self._inflight.values()
+                if not r.placements and not r.future.done()
+            )
+
     def snapshot(self) -> Dict[str, Any]:
         return {
             "routed": self.routed,
@@ -346,7 +384,9 @@ class Router:
             "rerouted_requests": self.rerouted_requests,
             "blackholed": self.blackholed,
             "spilled": self.spilled,
+            "expired": self.expired,
             "inflight": self.inflight_count(),
+            "unplaced_inflight": self.unplaced_inflight(),
             "pending_depth": self.pending_depth(),
             "hedge_threshold_ms": self.hedge_threshold_s() * 1e3,
         }

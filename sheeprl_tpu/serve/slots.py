@@ -85,7 +85,11 @@ class SlotPool:
         self._cond = threading.Condition()
         self._waiting: Deque[Request] = deque()  # occupied slots, admission order
         self._backlog: Deque[Request] = deque()
-        self._inflight: List[Request] = []
+        # rid -> request, insertion-ordered. Keyed (not a plain list) so
+        # release is ownership-checked per dispatch: a stale replica
+        # incarnation whose window was already drained/re-routed releases
+        # nothing, and can never clobber the live incarnation's tracking.
+        self._inflight: Dict[int, Request] = {}
         self._closed = False
         # slot-resident obs staging. Rows must cover the occupied slot window
         # AND the in-flight batch at once — continuous batching admits into
@@ -159,7 +163,8 @@ class SlotPool:
                 (expired if req.expired(now) else batch).append(req)
             for req in expired:
                 self._unstage(req)
-            self._inflight = list(batch)
+            for req in batch:
+                self._inflight[req.rid] = req
             self._refill_locked()
         now = self._clock()
         for req in expired:
@@ -172,12 +177,16 @@ class SlotPool:
         return batch
 
     def complete_batch(self, batch: Sequence[Request]) -> None:
-        """Release the in-flight window (called by the replica after the
-        dispatch's futures are settled) and free the staged rows."""
+        """Release ``batch``'s slice of the in-flight window (called by the
+        replica after the dispatch's futures are settled) and free its staged
+        rows. Only requests this pool still tracks in-flight are released: a
+        stale incarnation — declared hung, its window drained and re-routed,
+        then waking late — releases nothing that now belongs to the live
+        incarnation."""
         with self._cond:
             for req in batch:
-                self._unstage(req)
-            self._inflight = []
+                if self._inflight.pop(req.rid, None) is not None:
+                    self._unstage(req)
             self._refill_locked()
 
     def staged_batch(self, batch: Sequence[Request], rung: int) -> Any:
@@ -232,31 +241,46 @@ class SlotPool:
     def requeue_failed(self, batch: Sequence[Request]) -> None:
         """Hand a failed dispatch back to this pool at the front (the
         single-replica inference-failure retry; the batch has waited
-        longest). Releases the in-flight window, so call INSTEAD of
-        ``complete_batch``."""
+        longest). Releases the batch's in-flight slice, so call INSTEAD of
+        ``complete_batch``. Same ownership check: requests a drain already
+        re-routed are not requeued here — they ride their sibling."""
         with self._cond:
-            for req in batch:
+            owned = [r for r in batch if self._inflight.pop(r.rid, None) is not None]
+            for req in owned:
                 self._unstage(req)
-            self._inflight = []
             if not self._closed:
-                for req in reversed(batch):
+                for req in reversed(owned):
                     if not req.future.done():
                         self._backlog.appendleft(req)
             self._refill_locked()
 
-    def drain(self) -> List[Request]:
+    def drain(self, *, inflight: str = "all") -> List[Request]:
         """Pull every request this pool still owes work for — the in-flight
         window first (it has waited longest), then occupied slots, then the
         backlog, preserving admission order within each — so a dead replica's
         work can be re-routed at the FRONT of a sibling. The pool stays open
-        (a restarted incarnation reuses it)."""
+        (a restarted incarnation reuses it).
+
+        ``inflight`` scopes the window when the replica thread may still be
+        executing it: ``"all"`` (the replica is confirmed dead — nothing else
+        will ever complete these), ``"idempotent"`` (hung but alive: re-home
+        only what is safe to run twice, first completion wins exactly like a
+        hedge; non-idempotent requests stay with their original executor),
+        ``"none"`` (healthy and retiring: it finishes its own window)."""
         with self._cond:
-            drained = [r for r in self._inflight if not r.future.done()]
+            drained: List[Request] = []
+            if inflight != "none":
+                for req in list(self._inflight.values()):
+                    if inflight == "idempotent" and not getattr(req, "idempotent", True):
+                        continue
+                    del self._inflight[req.rid]
+                    self._unstage(req)
+                    if not req.future.done():
+                        drained.append(req)
             drained += [r for r in self._waiting if not r.future.done()]
             drained += [r for r in self._backlog if not r.future.done()]
-            for req in list(self._waiting) + list(self._inflight):
+            for req in list(self._waiting):
                 self._unstage(req)
-            self._inflight = []
             self._waiting.clear()
             self._backlog.clear()
         return drained

@@ -327,6 +327,114 @@ def test_reroute_at_front_lands_on_healthiest_sibling():
     router.close()
 
 
+def test_stale_incarnation_cannot_clobber_live_inflight_window():
+    """A hung incarnation that wakes AFTER its window was drained and a new
+    incarnation started must release nothing: in-flight tracking is
+    ownership-checked per dispatch, so the live window survives a stale
+    complete/requeue and stays recoverable by a later drain."""
+    from sheeprl_tpu.serve.slots import SlotPool
+
+    pool = SlotPool(capacity=2, backlog_bound=8)
+    now = time.monotonic()
+    a, b = Request(None, now, now + 60.0), Request(None, now, now + 60.0)
+    pool.offer(a), pool.offer(b)
+    stale = pool.take_batch(0.0)  # the incarnation that will hang here
+    assert [r.rid for r in stale] == [a.rid, b.rid]
+    drained = pool.drain()  # declared hung/dead: the fleet re-homes its window
+    assert [r.rid for r in drained] == [a.rid, b.rid]
+    c = Request(None, now, now + 60.0)
+    pool.offer(c)
+    live = pool.take_batch(0.0)  # the restarted incarnation dispatches
+    assert [r.rid for r in live] == [c.rid]
+    pool.complete_batch(stale)  # stale thread wakes late: releases nothing
+    assert pool.outstanding() == 1
+    pool.requeue_failed(stale)  # ...and requeues nothing it no longer owns
+    assert pool.depth() == 0 and pool.outstanding() == 1
+    assert [r.rid for r in pool.drain()] == [c.rid]  # live window recoverable
+
+
+def test_drain_scopes_inflight_by_executor_liveness():
+    """Re-homing a live thread's in-flight window would run non-idempotent
+    requests twice, so drain scopes it: a healthy retiring replica keeps the
+    whole window, a hung-but-alive one gives up only idempotent requests
+    (duplication there is hedging), a confirmed-dead one gives up all."""
+    from sheeprl_tpu.serve.router import RoutedRequest
+    from sheeprl_tpu.serve.slots import SlotPool
+
+    pool = SlotPool(capacity=4, backlog_bound=8)
+    now = time.monotonic()
+    idem = RoutedRequest(None, now, now + 60.0, idempotent=True)
+    nonidem = RoutedRequest(None, now, now + 60.0, idempotent=False)
+    pool.offer(idem), pool.offer(nonidem)
+    assert len(pool.take_batch(0.0)) == 2
+    queued = RoutedRequest(None, now, now + 60.0, idempotent=False)
+    pool.offer(queued)
+    assert [r.rid for r in pool.drain(inflight="none")] == [queued.rid]
+    assert pool.outstanding() == 2  # the whole window stays with its executor
+    assert [r.rid for r in pool.drain(inflight="idempotent")] == [idem.rid]
+    assert pool.outstanding() == 1  # non-idempotent stays with its executor
+    assert [r.rid for r in pool.drain()] == [nonidem.rid]
+    assert pool.outstanding() == 0
+
+
+def test_router_expires_unplaced_requests_at_deadline():
+    """A request admitted but never placed (blackhole, full fleet) is in NO
+    pool, so no pool can expire it — the scan's backstop must fail it at its
+    own deadline and drop the in-flight tracking, or it leaks forever and a
+    raw-future consumer hangs."""
+    from sheeprl_tpu.serve.errors import DeadlineExceeded
+    from sheeprl_tpu.serve.fault_injection import ServeFaultSchedule, parse_serve_faults
+    from sheeprl_tpu.serve.router import Router
+
+    pools = _pools(2)
+    schedule = ServeFaultSchedule(
+        parse_serve_faults([
+            {"kind": "router_blackhole", "at_request": 0, "duration_s": 30.0}
+        ])
+    )
+    router = Router(
+        targets=_targets(pools),
+        max_pending=100,
+        slo_s=60.0,  # hedging out of the picture: only the backstop can act
+        hedge_scan_s=0.002,
+        fault_schedule=schedule,
+    ).start()
+    req = router.submit(None, 0.05)
+    assert req.placements == []
+    with pytest.raises(DeadlineExceeded):
+        req.future.result(timeout=5.0)
+    assert _wait_until(lambda: router.inflight_count() == 0, timeout_s=5.0)
+    assert router.expired == 1
+    router.close()
+
+
+def test_admission_bound_counts_unplaced_inflight():
+    """Blackholed requests occupy no pool, so pool depth alone would let the
+    router admit past ``max_pending`` for the blackhole's whole duration —
+    the admission signal must include admitted-but-unplaced requests."""
+    from sheeprl_tpu.serve.fault_injection import ServeFaultSchedule, parse_serve_faults
+    from sheeprl_tpu.serve.router import Router
+
+    pools = _pools(2)
+    schedule = ServeFaultSchedule(
+        parse_serve_faults([
+            {"kind": "router_blackhole", "at_request": 0, "duration_s": 30.0}
+        ])
+    )
+    router = Router(
+        targets=_targets(pools),
+        max_pending=2,
+        slo_s=60.0,
+        fault_schedule=schedule,
+    ).start()
+    for _ in range(2):
+        assert router.submit(None, 60.0).placements == []
+    assert router.unplaced_inflight() == 2
+    with pytest.raises(Overloaded):
+        router.submit(None, 60.0)
+    router.close()
+
+
 # ------------------------------------------------------------- chaos ramp ----
 
 
