@@ -931,6 +931,90 @@ def wait_for_backend(max_wait_s: float) -> bool:
         retry_s = min(retry_s * 2.0, 120.0)
 
 
+# ---------------------------------------------------------------- queue ----
+
+_QUEUE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks", "QUEUE.json")
+
+
+def load_queue() -> list:
+    """Entries of the chip-gated workload queue (``benchmarks/QUEUE.json``,
+    ROADMAP item 5). Standing workloads: draining one records its evidence
+    (its own ``--record`` flag appends RUNS.jsonl cells) but keeps the entry
+    for the next tunnel window."""
+    try:
+        with open(_QUEUE_PATH) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return []
+    entries = doc.get("entries") if isinstance(doc, dict) else None
+    return [e for e in entries or [] if isinstance(e, dict) and e.get("argv")]
+
+
+def probe_backend() -> str:
+    """``jax.default_backend()`` probed in a subprocess (this parent stays
+    jax-free, and a failed probe cannot poison any backend cache)."""
+    import subprocess
+
+    timeout = float(os.environ.get("SHEEPRL_TPU_BENCH_PROBE_TIMEOUT", "180"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return "unreachable"
+    if proc.returncode != 0:
+        return "unreachable"
+    return (proc.stdout or "").strip() or "unreachable"
+
+
+def drain_queue(budget_fn=None, backend: str | None = None) -> list:
+    """Run every backend-eligible queue entry within the remaining budget.
+
+    Each entry runs as a subprocess from the repo root so its own
+    ``--record`` flags land in ``./RUNS.jsonl`` where ``--regress`` gates
+    them. Returns one ``{id, outcome, ...}`` dict per entry; a failed or
+    timed-out entry never corrupts the bench record (it simply stays queued
+    for the next window)."""
+    import subprocess
+
+    entries = load_queue()
+    if not entries:
+        return []
+    if backend is None:
+        backend = probe_backend()
+    results = []
+    for entry in entries:
+        requires = entry.get("requires", "tpu")
+        res = {"id": entry.get("id") or entry["argv"][0], "requires": requires}
+        if requires != backend:
+            res["outcome"] = f"skipped (backend={backend})"
+            results.append(res)
+            continue
+        cap = float(entry.get("timeout_s", 1800))
+        if budget_fn is not None:
+            cap = budget_fn(cap)
+        if cap < 60.0:
+            res["outcome"] = "skipped (budget exhausted)"
+            results.append(res)
+            continue
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                [sys.executable] + list(entry["argv"]),
+                timeout=cap,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            res["outcome"] = "completed" if proc.returncode == 0 else f"failed rc={proc.returncode}"
+        except subprocess.TimeoutExpired:
+            res["outcome"] = f"timeout after {cap:.0f}s"
+        res["wall_s"] = round(time.time() - t0, 1)
+        results.append(res)
+    return results
+
+
 # ---------------------------------------------------------------- cache ----
 
 
@@ -1163,6 +1247,14 @@ def main() -> None:
     if p:
         probes.append(p)
 
+    # ROADMAP item 5: drain the chip-gated workload queue in whatever budget
+    # the core workloads left. Each entry records its own evidence (RUNS.jsonl
+    # cells via --record, stdout in the driver tail); a failure or timeout
+    # leaves the entry queued for the next tunnel window and never touches
+    # the bench record below.
+    for qr in drain_queue(budget_fn=budget):
+        print(f"# queue {qr['id']}: {qr['outcome']}", file=sys.stderr, flush=True)
+
     if dv3 and ppo:
         record = _assemble(dv3, ppo, probes)
         _checkpoint(cache, "record", record, stamp)
@@ -1244,6 +1336,13 @@ if __name__ == "__main__":
         "--regress gating, print the stage JSON",
     )
     parser.add_argument(
+        "--queue",
+        choices=("list", "drain"),
+        help="chip-gated workload queue (benchmarks/QUEUE.json, ROADMAP item "
+        "5): 'list' prints entries with eligibility against the probed "
+        "backend, 'drain' runs every eligible entry now",
+    )
+    parser.add_argument(
         "--static",
         action="store_true",
         help="static gate: run the jaxcheck rule scan + config-matrix "
@@ -1251,6 +1350,27 @@ if __name__ == "__main__":
         "summary, exit nonzero on any new finding or failed config cell",
     )
     args = parser.parse_args()
+    if args.queue:
+        backend = probe_backend()
+        if args.queue == "list":
+            for entry in load_queue():
+                print(
+                    json.dumps(
+                        {
+                            "id": entry.get("id") or entry["argv"][0],
+                            "requires": entry.get("requires", "tpu"),
+                            "eligible": entry.get("requires", "tpu") == backend,
+                            "argv": entry["argv"],
+                            "note": entry.get("note"),
+                        }
+                    )
+                )
+            print(f"# probed backend: {backend}", file=sys.stderr)
+            sys.exit(0)
+        results = drain_queue(backend=backend)
+        print(json.dumps(results, indent=1))
+        ran = [r for r in results if not r["outcome"].startswith("skipped")]
+        sys.exit(0 if all(r["outcome"] == "completed" for r in ran) else 1)
     if args.static:
         # jaxcheck imports the config plane with algo imports gated off, so
         # the child never loads jax; a subprocess keeps this parent identical

@@ -30,17 +30,26 @@ Usage::
 
     python benchmarks/mfu_probe.py --sizes bench S --chain 8 --repeat 2
     python benchmarks/mfu_probe.py --sizes S --trace /tmp/dv3_trace  # adds a profiler trace
+    # ISSUE-14 2-D sweep: (data, model) layouts x global batches to the
+    # per-device ~B=300 knee, each probe recorded as a regress mfu cell
+    python benchmarks/mfu_probe.py --sizes XL --mesh 1x4 2x4 --batch-size 64 128 256 304 --record
 
-Writes one JSON line per size.
+Writes one JSON line per (size, mesh, batch). ``--record`` appends each
+probe to the run registry as a ``train:dreamer_v3:mfu_probe:<backend>x<n>p1:mfu``
+cell — ``tools/regress.py`` floors TPU cells at 30% MFU (ISSUE 14 bar).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SIZES = {
     # the bench.py shape (tiny nets, 4 envs recipe): MFU here states how
@@ -78,8 +87,13 @@ BASE_OVERRIDES = [
 ]
 
 
-def build_step(size: str, batch_size: int, seq_len: int):
-    """(train_fn, args tuple) at `size`, mirroring dreamer_v3.main's build."""
+def build_step(size: str, batch_size: int, seq_len: int, mesh: tuple[int, int] = (1, 1)):
+    """(train_fn, args tuple) at `size`, mirroring dreamer_v3.main's build.
+
+    ``mesh=(d, m)`` places the step on a 2-D ``(data, model)`` mesh over
+    ``d*m`` devices: params/opt model-sharded (GSPMD train path), the
+    ``[T, B]`` batch split over the data axis — ``batch_size`` is GLOBAL.
+    The default ``(1, 1)`` keeps the original single-chip probe."""
     import jax
     import jax.numpy as jnp
 
@@ -97,7 +111,16 @@ def build_step(size: str, batch_size: int, seq_len: int):
         f"algo.per_rank_sequence_length={seq_len}",
     ]
     cfg = compose("config", overrides)
-    fabric = Fabric(devices=1, precision=str(cfg.fabric.get("precision", "fp32")))
+    d, m = mesh
+    if (d, m) == (1, 1):
+        fabric = Fabric(devices=1, precision=str(cfg.fabric.get("precision", "fp32")))
+    else:
+        fabric = Fabric(
+            devices=d * m,
+            precision=str(cfg.fabric.get("precision", "fp32")),
+            mesh_axes=("data", "model") if m > 1 else ("data",),
+            mesh_shape=(d, m) if m > 1 else (d,),
+        )
 
     from sheeprl_tpu.envs import make_env
 
@@ -113,16 +136,24 @@ def build_step(size: str, batch_size: int, seq_len: int):
     world_tx = build_tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
     actor_tx = build_tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
     critic_tx = build_tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
-    world_opt = world_tx.init(jax.device_get(wm_params))
-    actor_opt = actor_tx.init(jax.device_get(actor_params))
-    critic_opt = critic_tx.init(jax.device_get(critic_params))
+    # shard_params co-shards Adam moments with their params on a model-axis
+    # mesh and replicates on a 1-D one (no topology check at the call site)
+    world_opt = fabric.shard_params(world_tx.init(jax.device_get(wm_params)))
+    actor_opt = fabric.shard_params(actor_tx.init(jax.device_get(actor_params)))
+    critic_opt = fabric.shard_params(critic_tx.init(jax.device_get(critic_params)))
     moments_state = init_moments()
+    if fabric.world_size > 1:
+        moments_state = fabric.replicate(moments_state)
 
     train_fn = make_train_fn(
         fabric, wm, actor, critic, world_tx, actor_tx, critic_tx, cfg, False, actions_dim
     )
 
     T, B, A = seq_len, batch_size, int(np.sum(actions_dim))
+    if fabric.world_size > 1 and B % max(1, fabric.data_parallel_size) != 0:
+        raise SystemExit(
+            f"global batch {B} not divisible by data={fabric.data_parallel_size}"
+        )
     rng = np.random.default_rng(0)
     data = {
         # NHWC — this repo's native pixel layout (envs/dummy.py:4)
@@ -134,6 +165,11 @@ def build_step(size: str, batch_size: int, seq_len: int):
         "is_first": jnp.zeros((T, B, 1), jnp.float32),
     }
     key = jax.random.PRNGKey(0)
+    if fabric.world_size > 1:
+        # commit batch over the data axis, key replicated — matches the train
+        # loop's placements so the probe measures the trained layout
+        data = jax.device_put(data, fabric.sharding(None, fabric.data_axis))
+        key = fabric.replicate(key)
     args = (
         wm_params,
         actor_params,
@@ -149,20 +185,30 @@ def build_step(size: str, batch_size: int, seq_len: int):
     return train_fn, args
 
 
-def measure(size: str, batch_size: int, seq_len: int, chain: int, repeat: int, trace: str | None):
+def measure(
+    size: str,
+    batch_size: int,
+    seq_len: int,
+    chain: int,
+    repeat: int,
+    trace: str | None,
+    mesh: tuple[int, int] = (1, 1),
+):
     import jax
 
     from sheeprl_tpu.utils.profiler import compiled_flops
 
+    d, m = mesh
     rec = {
         "size": size,
         "batch_size": batch_size,
         "sequence_length": seq_len,
         "chain": chain,
+        "mesh": f"{d}x{m}",
         "device": jax.devices()[0].device_kind,
     }
     rtt0 = tiny_rtt()
-    train_fn, args = build_step(size, batch_size, seq_len)
+    train_fn, args = build_step(size, batch_size, seq_len, mesh=mesh)
 
     def run_chain(args):
         # step i+1 consumes step i's outputs — XLA executes back-to-back.
@@ -213,7 +259,9 @@ def measure(size: str, batch_size: int, seq_len: int, chain: int, repeat: int, t
         rec["achieved_tflops"] = round(flops / step_s / 1e12, 2)
         peak = PEAK_BF16.get(rec["device"])
         if peak:
-            rec["mfu"] = round(flops / step_s / peak, 4)
+            # cost analysis reports the whole (pre-partition) module, so the
+            # denominator is the aggregate peak of every chip in the mesh
+            rec["mfu"] = round(flops / step_s / (peak * d * m), 4)
 
     if trace:
         with jax.profiler.trace(f"{trace}/{size}"):
@@ -222,18 +270,83 @@ def measure(size: str, batch_size: int, seq_len: int, chain: int, repeat: int, t
     return rec
 
 
+def _record_cell(rec: dict, runs_path: str | None) -> None:
+    """Append an obs-registry record so ``tools/regress.py`` tracks the probe
+    as a ``train:dreamer_v3:<env>:<backend>x<n>p1:mfu`` cell (the ISSUE-14
+    MFU gate). ``mfu`` falls back to 0.0 on devices missing from the bf16
+    peak table (CPU virtual-mesh cells — tracked for continuity, never
+    floored; the 30% bar applies to TPU backends only)."""
+    import jax
+
+    from sheeprl_tpu.obs.registry import SCHEMA_VERSION, append_run_record, runs_jsonl_path
+
+    record = {
+        "schema": SCHEMA_VERSION,
+        "t": time.time(),
+        "kind": "train",
+        "algo": "dreamer_v3",
+        "env": "mfu_probe",
+        "backend": jax.default_backend(),
+        "local_device_count": jax.local_device_count(),
+        "process_count": jax.process_count(),
+        "variant": "mfu",
+        "outcome": "completed",
+        "mfu": rec.get("mfu", 0.0),
+        "mfu_measured": "mfu" in rec,
+        "size": rec["size"],
+        "mesh": rec["mesh"],
+        "batch_size": rec["batch_size"],
+        "step_ms": rec.get("step_ms"),
+    }
+    path = runs_jsonl_path(None, runs_path)
+    if path is None:
+        print("run registry disabled (SHEEPRL_TPU_RUNS_JSONL empty); record dropped", flush=True)
+        return
+    append_run_record(record, path)
+    print(f"recorded mfu cell -> {path}", flush=True)
+
+
+def _parse_meshes(specs: list[str]) -> list[tuple[int, int]]:
+    out = []
+    for item in specs:
+        d, _, m = item.strip().partition("x")
+        out.append((int(d), int(m) if m else 1))
+    return out
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--sizes", nargs="+", default=["bench", "S"], choices=list(SIZES))
-    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--batch-size", type=int, nargs="+", default=[16])
     p.add_argument("--seq-len", type=int, default=64)
     p.add_argument("--chain", type=int, default=8)
     p.add_argument("--repeat", type=int, default=2)
     p.add_argument("--trace", default=None, help="jax.profiler trace output dir")
+    p.add_argument(
+        "--mesh",
+        nargs="+",
+        default=["1x1"],
+        help="DxM (data x model) mesh layouts to sweep, e.g. --mesh 1x1 2x4 1x4",
+    )
+    p.add_argument(
+        "--record",
+        nargs="?",
+        const="",
+        default=None,
+        metavar="RUNS_JSONL",
+        help="append an obs-registry record per probe (regress mfu cell); "
+        "optional path overrides the default RUNS.jsonl",
+    )
     args = p.parse_args()
     for size in args.sizes:
-        rec = measure(size, args.batch_size, args.seq_len, args.chain, args.repeat, args.trace)
-        print(json.dumps(rec), flush=True)
+        for mesh in _parse_meshes(args.mesh):
+            for batch in args.batch_size:
+                rec = measure(
+                    size, batch, args.seq_len, args.chain, args.repeat, args.trace, mesh=mesh
+                )
+                print(json.dumps(rec), flush=True)
+                if args.record is not None:
+                    _record_cell(rec, args.record or None)
 
 
 if __name__ == "__main__":

@@ -379,6 +379,7 @@ def make_fused_train_fn(
     gather,
     num_steps: int,
     ctx_spec=None,
+    carry_specs=None,
     check_finite: bool = False,
 ):
     """``num_steps`` gradient steps — replay gather, EMA target refresh and
@@ -389,6 +390,16 @@ def make_fused_train_fn(
     gradients and metrics), ``gather`` must draw shard-locally
     (``fold_sample_key(..., axis_name=fabric.data_axis)``), and ``ctx_spec``
     gives the sample context's partition spec.
+
+    On a 2-D ``(data, model)`` mesh the scan is one GSPMD program instead:
+    pass ``carry_specs=(param_specs, aux_specs)`` (PartitionSpec trees from
+    ``fabric.match_partition_rules`` over the exact ``params``/``aux``
+    tuples) so the jitted superstep commits params AND their optimizer/EMA
+    twins to the model-axis layout and keeps each W2 shard device-resident
+    across the window; the body is the same GSPMD ``local_train`` the
+    per-step model-axis path uses (no pmean), and ``gather`` must be the
+    :func:`~sheeprl_tpu.ops.superstep.pregathered` host stack (the device
+    replay ring is pure-DP only).
 
     The jitted fn's signature is ``(params, aux, counter, sample_ctx, key) ->
     (params, aux, key, metrics[num_steps, len(METRIC_ORDER)])`` with
@@ -416,14 +427,17 @@ def make_fused_train_fn(
         t_p = periodic_target_ema(counter, c_p, t_p, freq, tau)
         return (wm_p, a_p, c_p, t_p), aux
 
+    model_axis = fabric.model_axis if carry_specs is not None else None
     return make_superstep_fn(
         train_body,
         gather,
         num_steps,
         pre_step=pre_step,
-        mesh=fabric.mesh if use_shard_map else None,
+        mesh=fabric.mesh if (use_shard_map or model_axis is not None) else None,
         data_axis=fabric.data_axis if use_shard_map else None,
         ctx_spec=ctx_spec,
+        model_axis=model_axis,
+        carry_specs=carry_specs,
         check_finite=check_finite,
     )
 
@@ -586,17 +600,11 @@ def main(fabric, cfg: Dict[str, Any]):
                 "per-step train path",
             )
             fused_k = 0
-        elif fabric.world_size > 1 and fabric.model_axis is not None:
-            fused_fallback(
-                "model_axis",
-                "algo.fused_gradient_steps is pure data-parallel, but this run "
-                f"shards params over model_axis={fabric.model_axis!r}; falling "
-                "back to the per-step (GSPMD) train path",
-            )
-            fused_k = 0
-    # on a (pure-DP) mesh the superstep runs under shard_map: each device
-    # draws/consumes its own per_rank batch shard and the scan body pmeans
-    fused_sharded = fused_k > 0 and fabric.world_size > 1
+    # model-axis meshes fuse via GSPMD (the scan's carry shardings pin each
+    # W2 / Adam / EMA shard device-resident — no shard_map, no pmean);
+    # pure-DP meshes keep the explicit-collective shard_map scan
+    fused_gspmd = fused_k > 0 and fabric.model_axis is not None
+    fused_sharded = fused_k > 0 and fabric.world_size > 1 and not fused_gspmd
     fused_fns: Dict[int, Any] = {}  # one compiled superstep per distinct scan length
     fused_batch_size = per_rank_batch_size * fabric.local_data_parallel_size
     fused_draw_size = fused_batch_size // (fabric.data_parallel_size if fused_sharded else 1)
@@ -628,6 +636,27 @@ def main(fabric, cfg: Dict[str, Any]):
             if use_device_rb
             else P(None, None, fused_axis)
         )
+    elif fused_gspmd:
+        # GSPMD scan: the pre-gathered [n, T, B, ...] stack is batch-sharded
+        # over the data axis (the model peers co-own each shard)
+        fused_ctx_spec = P(None, None, fabric.data_axis)
+
+    # (data, model) superstep carries: one spec per leaf of the exact
+    # params/aux tuples the superstep scans over, so optimizer and EMA
+    # twins ride model-sharded instead of silently replicated
+    fused_carry_specs = None
+    if fused_gspmd:
+        fused_carry_specs = (
+            fabric.match_partition_rules(
+                (wm_params, actor_params, critic_params, target_critic_params)
+            ),
+            fabric.match_partition_rules((world_opt, actor_opt, critic_opt, moments_state)),
+        )
+        # commit the only still-host carry leaves (the moments scalars) to the
+        # mesh now: an uncommitted input in window 1 vs the committed superstep
+        # output in window 2 keys a SECOND executable — breaking the
+        # zero-recompile-after-window-1 invariant the dryrun asserts
+        moments_state = fabric.replicate(moments_state)
 
     def get_fused_fn(n: int):
         fn = fused_fns.get(n)
@@ -646,6 +675,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 fused_gather,
                 n,
                 ctx_spec=fused_ctx_spec,
+                carry_specs=fused_carry_specs,
                 check_finite=resil.finite_checks,
             )
         return fn
@@ -658,14 +688,19 @@ def main(fabric, cfg: Dict[str, Any]):
         from sheeprl_tpu.data.buffers import to_device
 
         sample = rb.sample(fused_batch_size, sequence_length=sequence_length, n_samples=n)
+        batch_axis = fabric.data_axis if (fused_sharded or fused_gspmd) else None
         return to_device(
             {k: (v if k in cnn_keys else v.astype(np.float32)) for k, v in sample.items()},
-            sharding=fabric.sharding(None, None, fused_axis) if fused_sharded else None,
+            sharding=fabric.sharding(None, None, batch_axis) if batch_axis else None,
         )
 
     key = jax.random.PRNGKey(int(cfg.seed))
     if cfg.checkpoint.resume_from and "rng_key" in state:
         key = jnp.asarray(state["rng_key"])
+    if fused_gspmd:
+        # same zero-recompile reasoning as the moments above: the superstep
+        # returns the key mesh-committed, so it must enter window 1 that way
+        key = fabric.replicate(key)
     # action sampling draws from its own stream committed to the player's
     # device, so a host-pinned player (agent.PlayerDV3 device) never waits on
     # a chip round trip for a key

@@ -103,26 +103,51 @@ def _kernel(x_ref, h_ref, w1_ref, b1_ref, g1_ref, be1_ref, w2_ref, g2_ref, be2_r
     out_ref[:] = update * cand + (1.0 - update) * h
 
 
-def _tile_bytes(in_dim: int, dense_units: int, hidden: int, tile_b: int) -> int:
-    weights = in_dim * dense_units + (hidden + dense_units) * 3 * hidden
-    acts = tile_b * (in_dim + dense_units + hidden + 3 * hidden + hidden)
-    return 4 * (weights + acts)
+def _tile_bytes(
+    in_dim: int,
+    dense_units: int,
+    hidden: int,
+    tile_b: int,
+    dtype: Any = jnp.float32,
+    model_shards: int = 1,
+) -> int:
+    """VMEM footprint of one batch tile: weights at their STORAGE dtype
+    (bf16 halves the dominant W2 term — the L/XL fits-vmem verdicts flip on
+    this), activations always fp32 (the kernel upcasts in registers).
+    ``model_shards`` > 1 sizes the per-device slice of a model-axis-sharded
+    W2 ([H+D, 3H/mp]) and its [B, 3H/mp] projection."""
+    w_itemsize = jnp.dtype(dtype).itemsize
+    weights = in_dim * dense_units + (hidden + dense_units) * 3 * hidden // model_shards
+    acts = tile_b * (in_dim + dense_units + hidden + 3 * hidden // model_shards + hidden)
+    return w_itemsize * weights + 4 * acts
 
 
-def best_tile_b(in_dim: int, dense_units: int, hidden: int) -> Optional[int]:
+def best_tile_b(
+    in_dim: int,
+    dense_units: int,
+    hidden: int,
+    dtype: Any = jnp.float32,
+    model_shards: int = 1,
+) -> Optional[int]:
     """Largest batch tile (multiple of the fp32 sublane) whose weights +
     activations fit the VMEM budget; None when even the minimum doesn't."""
     tile = _MAX_TILE_B
     while tile >= _SUBLANE:
-        if _tile_bytes(in_dim, dense_units, hidden, tile) <= _VMEM_BUDGET_BYTES:
+        if _tile_bytes(in_dim, dense_units, hidden, tile, dtype, model_shards) <= _VMEM_BUDGET_BYTES:
             return tile
         tile //= 2
     return None
 
 
-def fits_vmem(in_dim: int, dense_units: int, hidden: int) -> bool:
+def fits_vmem(
+    in_dim: int,
+    dense_units: int,
+    hidden: int,
+    dtype: Any = jnp.float32,
+    model_shards: int = 1,
+) -> bool:
     """True when the kernel has a workable VMEM-resident tiling."""
-    return best_tile_b(in_dim, dense_units, hidden) is not None
+    return best_tile_b(in_dim, dense_units, hidden, dtype, model_shards) is not None
 
 
 def _round_up(n: int, m: int) -> int:
@@ -224,36 +249,242 @@ def fused_recurrent_step(
     )
 
 
-def resolve_backend(mode: Any, in_dim: int, dense_units: int, hidden: int) -> Tuple[bool, bool]:
+# --------------------------------------------------------------------------- #
+# Model-sharded variant: per-device W2 slice pinned in VMEM, GRU state
+# assembled with one all-gather (the XL weight-streaming fix — see
+# howto/model_parallel.md for the roofline)
+# --------------------------------------------------------------------------- #
+
+
+def _proj_tile_b(rows: int, cols: int, hidden: int, dense_units: int, w_itemsize: int) -> Optional[int]:
+    """Batch tile for the sharded projection kernel: the per-device W2 slice
+    ``[rows, cols]`` at its storage dtype + fp32 ``h``/``feat``/``out``
+    tiles must fit the VMEM budget."""
+    tile = _MAX_TILE_B
+    while tile >= _SUBLANE:
+        if w_itemsize * rows * cols + 4 * tile * (hidden + dense_units + cols) <= _VMEM_BUDGET_BYTES:
+            return tile
+        tile //= 2
+    return None
+
+
+def _proj_kernel(h_ref, f_ref, w2_ref, out_ref, *, hidden):
+    # [h, feat] @ W2_slice without materialising the concat: W2 split by rows.
+    # Weights load at their storage dtype (bf16 VMEM footprint) and upcast in
+    # registers; the MXU accumulates fp32.
+    h = h_ref[:].astype(jnp.float32)
+    f = f_ref[:].astype(jnp.float32)
+    out_ref[:] = jnp.dot(
+        h, w2_ref[:hidden, :].astype(jnp.float32), preferred_element_type=jnp.float32
+    ) + jnp.dot(f, w2_ref[hidden:, :].astype(jnp.float32), preferred_element_type=jnp.float32)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_sharded_proj(interpret: bool):
+    """Custom-VJP pallas projection ``(h [B,H], feat [B,D], w2 [H+D, C]) ->
+    [B, C]`` — the weight-stationary piece of the sharded step. The backward
+    is three plain matmuls (XLA), matching the recompute philosophy of the
+    full fused kernel."""
+
+    def _forward(h, feat, w2):
+        from jax.experimental import pallas as pl
+
+        batch, hidden = h.shape
+        dense_units = feat.shape[1]
+        cols = w2.shape[1]
+        tile_b = _proj_tile_b(w2.shape[0], cols, hidden, dense_units, jnp.dtype(w2.dtype).itemsize)
+        if tile_b is None:
+            raise ValueError(
+                "sharded_recurrent_step: per-device W2 slice too large for the "
+                "VMEM-resident kernel; gate on fits_vmem(..., model_shards=mp)"
+            )
+        pad_b = _round_up(max(batch, _SUBLANE), _SUBLANE)
+        tile_b = min(pad_b, tile_b)
+        pad_b = _round_up(pad_b, tile_b)
+        if pad_b != batch:
+            h = jnp.pad(h, ((0, pad_b - batch), (0, 0)))
+            feat = jnp.pad(feat, ((0, pad_b - batch), (0, 0)))
+        out = pl.pallas_call(
+            functools.partial(_proj_kernel, hidden=hidden),
+            grid=(pad_b // tile_b,),
+            in_specs=[
+                pl.BlockSpec((tile_b, hidden), lambda i: (i, 0)),
+                pl.BlockSpec((tile_b, dense_units), lambda i: (i, 0)),
+                pl.BlockSpec(w2.shape, lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((tile_b, cols), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((pad_b, cols), jnp.float32),
+            interpret=interpret,
+        )(h.astype(jnp.float32), feat.astype(jnp.float32), w2)
+        return out[:batch]
+
+    @jax.custom_vjp
+    def proj(h, feat, w2):
+        return _forward(h, feat, w2)
+
+    def _fwd(h, feat, w2):
+        return _forward(h, feat, w2), (h, feat, w2)
+
+    def _bwd(res, g):
+        h, feat, w2 = res
+        hidden = h.shape[1]
+        g = g.astype(jnp.float32)
+        w2f = w2.astype(jnp.float32)
+        dh = g @ w2f[:hidden, :].T
+        df = g @ w2f[hidden:, :].T
+        dw2 = jnp.concatenate(
+            [h.astype(jnp.float32).T @ g, feat.astype(jnp.float32).T @ g], axis=0
+        ).astype(w2.dtype)
+        return dh.astype(h.dtype), df.astype(feat.dtype), dw2
+
+    proj.defvjp(_fwd, _bwd)
+    return proj
+
+
+def sharded_recurrent_step(
+    x: Array,
+    h: Array,
+    w1: Array,
+    b1: Array,
+    g1: Array,
+    be1: Array,
+    w2: Array,
+    g2: Array,
+    be2: Array,
+    *,
+    mesh,
+    model_axis: str = "model",
+    data_axis: Optional[str] = None,
+    eps1: float = 1e-3,
+    eps2: float = 1e-5,
+    use_pallas: bool = True,
+    interpret: bool = False,
+) -> Array:
+    """Model-axis-sharded fused step, numerically ≡ :func:`reference_step`.
+
+    The joint projection ``W2 [H+D, 3H]`` is viewed gate-major as
+    ``[H+D, 3, H]`` and sharded over ``model_axis`` on the LAST dim, so each
+    of the ``mp`` devices owns the same ``H/mp`` hidden columns of all three
+    gates — the gate arithmetic stays elementwise-local. Per device:
+
+    1. the input projection (replicated ``w1``) runs locally;
+    2. the ``[B, 3, H/mp]`` pre-activation comes from the weight-stationary
+       pallas projection (per-shard W2 slice pinned in VMEM — ~1/mp of the
+       HBM stream the replicated scan pays every timestep);
+    3. the LayerNorm over the full ``3H`` axis uses two ``psum``s over
+       ``model_axis`` (mean, then centered second moment — bitwise-faithful
+       to the reference's two-pass statistics);
+    4. the new ``h`` shard is assembled with one tiled ``all_gather``.
+
+    ``data_axis`` additionally shards the batch (the 2-D layout the A/B
+    sweeps); ``use_pallas=False`` keeps step 2 in plain jnp (the XLA
+    baseline of the A/B). Gradients flow through a custom VJP on the
+    projection and the collectives. Requires ``H % mp == 0``.
+    """
+    from jax import lax
+
+    from sheeprl_tpu.parallel.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    hidden = h.shape[-1]
+    mp = mesh.shape[model_axis]
+    if hidden % mp != 0:
+        raise ValueError(f"hidden ({hidden}) must divide by the model axis ({mp})")
+    w2g = w2.reshape(w2.shape[0], 3, hidden)
+    g2g = g2.reshape(3, hidden)
+    be2g = be2.reshape(3, hidden)
+    bspec = P(data_axis) if data_axis is not None else P()
+
+    def local_step(x, h, w1, b1, g1, be1, w2g, g2g, be2g):
+        x = x.astype(jnp.float32)
+        h = h.astype(jnp.float32)
+
+        def _ln(v, g, b, eps):
+            mu = jnp.mean(v, axis=-1, keepdims=True)
+            var = jnp.mean(jnp.square(v - mu), axis=-1, keepdims=True)
+            return (v - mu) * lax.rsqrt(var + eps) * g + b
+
+        feat = jax.nn.silu(_ln(x @ w1 + b1, g1, be1, eps1))
+        hs = hidden // mp
+        w2l = w2g.reshape(w2g.shape[0], 3 * hs)
+        if use_pallas:
+            pre = _make_sharded_proj(interpret)(h, feat, w2l)
+        else:
+            pre = h @ w2l[:hidden, :] + feat @ w2l[hidden:, :]
+        pre = pre.reshape(-1, 3, hs)
+        # LayerNorm over the GLOBAL 3H axis: two-pass statistics via psum
+        n = jnp.float32(3 * hidden)
+        mu = lax.psum(jnp.sum(pre, axis=(1, 2)), model_axis) / n
+        var = lax.psum(jnp.sum(jnp.square(pre - mu[:, None, None]), axis=(1, 2)), model_axis) / n
+        proj = (pre - mu[:, None, None]) * lax.rsqrt(var + eps2)[:, None, None] * g2g + be2g
+        update = jax.nn.sigmoid(proj[:, 2] - 1.0)
+        cand = jnp.tanh(jax.nn.sigmoid(proj[:, 0]) * proj[:, 1])
+        idx = lax.axis_index(model_axis)
+        h_local = lax.dynamic_slice_in_dim(h, idx * hs, hs, axis=1)
+        h_new = update * cand + (1.0 - update) * h_local
+        return lax.all_gather(h_new, model_axis, axis=1, tiled=True)
+
+    return shard_map(
+        local_step,
+        mesh,
+        in_specs=(
+            bspec,
+            bspec,
+            P(),
+            P(),
+            P(),
+            P(),
+            P(None, None, model_axis),
+            P(None, model_axis),
+            P(None, model_axis),
+        ),
+        out_specs=bspec,
+    )(x, h, w1, b1, g1, be1, w2g, g2g, be2g)
+
+
+def resolve_backend(
+    mode: Any,
+    in_dim: int,
+    dense_units: int,
+    hidden: int,
+    dtype: Any = jnp.float32,
+    model_shards: int = 1,
+) -> Tuple[bool, bool]:
     """Map a config flag to ``(use_pallas, interpret)``.
 
-    ``mode``: ``"auto"`` (currently the flax cell — see below),
-    ``True``/``"pallas"`` (force; interpreter off-TPU — for tests),
-    ``False``/``"flax"`` (never).
+    ``mode``: ``"auto"`` (see below), ``True``/``"pallas"`` (force;
+    interpreter off-TPU — for tests), ``False``/``"flax"`` (never).
+    ``dtype``/``model_shards`` size the VMEM verdict for the weights'
+    storage dtype and a model-axis-sharded W2 slice.
 
-    ``auto`` resolves to the flax cell: the round-3 on-chip A/B
-    (``benchmarks/pallas_gru_ab.py``, TPU v5e) measured the kernel at parity
-    with XLA's own fusion at the XS scale (1.01–1.03x) and SLOWER at S
-    (0.62x forward) — XLA already fuses the Dense→LN→SiLU→GRU body well, and
-    the hand-written kernel's VMEM tiling loses to the compiler's scheduling
-    as the weights grow. The kernel stays available behind ``"pallas"`` for
-    future re-evaluation on other TPU generations.
+    ``auto`` on a replicated (mp=1) layout resolves to the flax cell: the
+    round-3 on-chip A/B (``benchmarks/pallas_gru_ab.py``, TPU v5e) measured
+    the kernel at parity with XLA's own fusion at the XS scale (1.01–1.03x)
+    and SLOWER at S (0.62x forward) — XLA already fuses the
+    Dense→LN→SiLU→GRU body well and the replicated kernel just re-streams
+    the same HBM bytes. On a model-sharded layout (``model_shards`` > 1) the
+    economics invert — the per-shard slice is weight-stationary in VMEM
+    while the XLA baseline still streams it — so ``auto`` picks the sharded
+    kernel whenever the slice fits on-chip.
     """
     if mode in (False, None, "flax", "off"):
         return False, False
     on_tpu = jax.default_backend() == "tpu"
-    fits = fits_vmem(in_dim, dense_units, hidden)
+    fits = fits_vmem(in_dim, dense_units, hidden, dtype, model_shards)
     if mode in (True, "pallas", "force"):
         if not fits:
             import warnings
 
             warnings.warn(
                 f"fused={mode!r} requested but the RSSM step (in={in_dim}, "
-                f"dense={dense_units}, hidden={hidden}) exceeds the VMEM-resident "
-                "kernel's budget — falling back to the flax cell",
+                f"dense={dense_units}, hidden={hidden}, shards={model_shards}) "
+                "exceeds the VMEM-resident kernel's budget — falling back to "
+                "the flax cell",
                 stacklevel=2,
             )
         return fits, not on_tpu
     if str(mode).lower() == "auto":
-        return False, False  # measured: XLA fusion ties/wins (docstring)
+        if model_shards > 1:
+            return on_tpu and fits, False
+        return False, False  # replicated: measured, XLA fusion ties/wins
     raise ValueError(f"unknown fused-recurrent mode {mode!r}")

@@ -42,7 +42,7 @@ from typing import Any, Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheeprl_tpu.obs.telemetry import telemetry_fused_fallback
 from sheeprl_tpu.parallel.shard_map import shard_map
@@ -140,6 +140,8 @@ def make_superstep_fn(
     mesh=None,
     data_axis: Optional[str] = None,
     ctx_spec=None,
+    model_axis: Optional[str] = None,
+    carry_specs: Optional[Tuple[Any, Any]] = None,
     check_finite: bool = False,
 ):
     """Wrap one un-jitted gradient step into a donated ``jax.jit(lax.scan)``
@@ -166,6 +168,20 @@ def make_superstep_fn(
       every carry stays replicated, so the ``train_body`` MUST ``pmean`` its
       gradients/metrics over ``data_axis`` and in-scan gathers must fold the
       sampling key with ``axis_name=data_axis``.
+    - ``model_axis`` / ``carry_specs`` — the 2-D ``(data, model)`` path. Pass
+      ``mesh``, the model axis name and ``carry_specs=(param_specs,
+      aux_specs)`` (PartitionSpec trees matching ``params``/``aux`` —
+      ``Fabric.match_partition_rules`` over the carry) to run the scan as a
+      single GSPMD program instead of ``shard_map``: the jit's in/out
+      shardings commit the carries to their model-axis layout and a
+      ``with_sharding_constraint`` at the end of each scan body pins them
+      there, so each device's W2 (and Adam/EMA twin) shard stays resident
+      across all ``num_steps`` iterations — no per-step all-gather of full
+      weights. ``ctx_spec`` shards the pre-gathered batch stack over
+      ``data_axis`` (the in-scan device-ring gather is shard_map-only; use
+      :func:`pregathered` here). The ``train_body`` must NOT ``pmean``
+      (GSPMD global semantics — XLA inserts the reductions), matching the
+      per-step model-axis train path.
 
     Returns a jitted ``superstep(params, aux, counter, sample_ctx, key) ->
     (params, aux, key, metrics)`` where ``counter`` is the run's cumulative
@@ -183,8 +199,26 @@ def make_superstep_fn(
     """
     if num_steps <= 0:
         raise ValueError(f"'num_steps' ({num_steps}) must be greater than 0")
+    if model_axis is not None:
+        if mesh is None or carry_specs is None:
+            raise ValueError("model-axis supersteps need both 'mesh' and 'carry_specs'")
+        if data_axis is not None:
+            raise ValueError(
+                "pass either 'data_axis' (pure-DP shard_map scan) or 'model_axis' "
+                "(2-D GSPMD scan), not both — the GSPMD path shards the batch via "
+                "'ctx_spec' and needs no axis name in the body"
+            )
 
     from sheeprl_tpu.resilience.sentinel import all_finite
+
+    _is_spec = lambda s: isinstance(s, P)
+    carry_shardings = None
+    if model_axis is not None:
+        param_specs, aux_specs = carry_specs
+        carry_shardings = tuple(
+            jax.tree.map(lambda s: NamedSharding(mesh, s), specs, is_leaf=_is_spec)
+            for specs in (param_specs, aux_specs)
+        )
 
     def superstep(params, aux, counter, sample_ctx, key):
         def body(carry, step_index):
@@ -194,6 +228,14 @@ def make_superstep_fn(
             key, k_train = jax.random.split(key)
             batch = gather(sample_ctx, k_train, step_index)
             params, aux, metrics = train_body(params, aux, batch, k_train)
+            if carry_shardings is not None:
+                # pin the carries to their (data, model) layout every
+                # iteration: without the constraint GSPMD is free to
+                # re-replicate the updated params/opt-state between scan
+                # steps, which is exactly the full-weight all-gather per
+                # step this path exists to eliminate
+                params = lax.with_sharding_constraint(params, carry_shardings[0])
+                aux = lax.with_sharding_constraint(aux, carry_shardings[1])
             out = metrics
             if check_finite:
                 # metrics catch NaN losses; params catch an Inf that reached
@@ -210,6 +252,29 @@ def make_superstep_fn(
             metrics, finite = out
             return params, aux, key, metrics, finite
         return params, aux, key, out
+
+    if model_axis is not None:
+        # 2-D GSPMD scan: carries committed to their model-axis layout via
+        # jit in/out shardings (so the compiled program keeps each W2 /
+        # Adam / EMA shard device-resident across the window), batch stack
+        # sharded per ctx_spec, counter/key/metrics replicated.
+        replicated = NamedSharding(mesh, P())
+        ctx_shardings = (
+            jax.tree.map(lambda s: NamedSharding(mesh, s), ctx_spec, is_leaf=_is_spec)
+            if ctx_spec is not None
+            else replicated
+        )
+        param_shardings, aux_shardings = carry_shardings
+        return jax.jit(
+            superstep,
+            in_shardings=(param_shardings, aux_shardings, replicated, ctx_shardings, replicated),
+            out_shardings=(
+                (param_shardings, aux_shardings, replicated, replicated, replicated)
+                if check_finite
+                else (param_shardings, aux_shardings, replicated, replicated)
+            ),
+            donate_argnums=(1,),
+        )
 
     if mesh is not None:
         if data_axis is None or ctx_spec is None:

@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import re
+import warnings
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -75,6 +77,51 @@ class Precision:
             lambda x: x.astype(dtype) if isinstance(x, (jax.Array, np.ndarray)) and jnp.issubdtype(x.dtype, jnp.floating) else x,
             tree,
         )
+
+
+# --------------------------------------------------------------------------- #
+# Regex partition-rule table (megatron-lm / EasyLM style)
+# --------------------------------------------------------------------------- #
+# Rules map a regex over the '/'-joined pytree path of a leaf to a sharding
+# strategy. Because Adam's mu/nu (and any EMA twin of the params) mirror the
+# param tree structure, a rule anchored on the leaf name ("kernel") covers the
+# param AND its optimizer-state twins — the property the fused superstep needs
+# so opt/EMA carries stay model-sharded instead of silently riding replicated.
+#
+# Strategies: "auto" (shape-based model-axis rule, Fabric.param_spec),
+# "replicate" (force P()), or an explicit PartitionSpec. First match wins;
+# unmatched leaves fall back to replicated with a warn-once per path.
+DEFAULT_PARTITION_RULES: Tuple[Tuple[str, Any], ...] = (
+    # dense/conv kernels and embeddings (+ their mu/nu/EMA twins): shape rule
+    (r"(^|/)(kernel|embedding)$", "auto"),
+    # LayerNorm affine, biases, the learnable h0: small — keep replicated
+    (r"(^|/)(bias|scale|initial_recurrent_state)$", "replicate"),
+    # optimizer bookkeeping and return-normalizer moments: scalars
+    (r"(^|/)(count|mu_hat|nu_hat|low|high)$", "replicate"),
+)
+
+_warned_unmatched_paths: set = set()
+
+
+def reset_partition_rule_warnings() -> None:
+    """Re-arm the unmatched-leaf warn-once filter (tests / repeated runs)."""
+    _warned_unmatched_paths.clear()
+
+
+def _path_token(entry: Any) -> str:
+    """One tree-path entry as a plain string: dict keys, namedtuple/attr
+    fields and sequence indices all render bare so rules can anchor on
+    ``(^|/)name$`` regardless of the container type."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def tree_path_str(path: Sequence[Any]) -> str:
+    """'/'-joined rendering of a ``tree_flatten_with_path`` key path, e.g.
+    ``1/0/mu/Dense_0/kernel`` for the Adam mu twin of a flax kernel."""
+    return "/".join(_path_token(e) for e in path)
 
 
 class Fabric:
@@ -328,6 +375,67 @@ class Fabric:
             lambda leaf: NamedSharding(self.mesh, self.param_spec(leaf)), tree
         )
         return jax.device_put(tree, shardings)
+
+    def match_partition_rules(self, tree: Any, rules: Optional[Sequence[Tuple[str, Any]]] = None) -> Any:
+        """PartitionSpec pytree for ``tree`` from a regex rule table.
+
+        Every leaf's '/'-joined path (:func:`tree_path_str`) is matched
+        against ``rules`` (default :data:`DEFAULT_PARTITION_RULES`) in order;
+        the first hit decides the spec: ``"auto"`` delegates to the
+        shape-based :meth:`param_spec`, ``"replicate"`` forces ``P()``, and
+        an explicit ``PartitionSpec`` is used verbatim. Unmatched leaves fall
+        back to replicated with a warn-once per path — a silent fallback on
+        a large matrix is exactly the all-gather-per-scan-step bug this
+        table exists to prevent.
+
+        Because optimizer state (Adam mu/nu) and EMA twins mirror the param
+        tree, applying the same table to the whole superstep carry
+        ``(params, opt, ema, moments)`` co-shards every twin of a kernel
+        with the kernel itself. Returns a pytree with the exact structure of
+        ``tree`` whose leaves are ``PartitionSpec``s (feed through
+        ``NamedSharding(mesh, spec)`` for placement or jit shardings).
+        """
+        table = DEFAULT_PARTITION_RULES if rules is None else tuple(rules)
+        compiled = [(re.compile(pattern), strategy) for pattern, strategy in table]
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        specs = []
+        for path, leaf in flat:
+            name = tree_path_str(path)
+            for pattern, strategy in compiled:
+                if pattern.search(name):
+                    if strategy == "auto":
+                        specs.append(self.param_spec(leaf))
+                    elif strategy == "replicate":
+                        specs.append(P())
+                    elif isinstance(strategy, P):
+                        specs.append(strategy)
+                    else:
+                        raise ValueError(
+                            f"unknown partition-rule strategy {strategy!r} for pattern "
+                            f"{pattern.pattern!r} (use 'auto', 'replicate' or a PartitionSpec)"
+                        )
+                    break
+            else:
+                if name not in _warned_unmatched_paths:
+                    _warned_unmatched_paths.add(name)
+                    warnings.warn(
+                        f"no partition rule matched leaf {name!r} "
+                        f"(shape={getattr(leaf, 'shape', ())}); replicating it — add a rule "
+                        "if this leaf should be model-sharded",
+                        UserWarning,
+                        stacklevel=2,
+                    )
+                specs.append(P())
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+    def carry_shardings(self, tree: Any, rules: Optional[Sequence[Tuple[str, Any]]] = None) -> Any:
+        """:meth:`match_partition_rules` materialised as ``NamedSharding``s
+        (same structure as ``tree``) — the form ``jax.jit`` in/out shardings
+        and ``with_sharding_constraint`` consume."""
+        specs = self.match_partition_rules(tree, rules)
+        return jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), specs, is_leaf=lambda s: isinstance(s, P)
+        )
 
     def make_global(self, tree: Any, spec: Any) -> Any:
         """Assemble per-process host arrays into one global sharded array

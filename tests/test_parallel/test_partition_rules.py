@@ -1,0 +1,119 @@
+"""Regex partition rules (ISSUE 14): every superstep carry leaf gets a
+PartitionSpec from the rule table, Adam moment twins co-shard with their
+kernels, and unmatched leaves fall back to replication with a warn-once
+per path."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from sheeprl_tpu.parallel.fabric import (
+    Fabric,
+    reset_partition_rule_warnings,
+    tree_path_str,
+)
+
+_IS_SPEC = lambda s: isinstance(s, P)  # noqa: E731 — P() nests as a pytree
+
+
+@pytest.fixture
+def fabric2d():
+    return Fabric(devices=8, precision="fp32", mesh_axes=("data", "model"), mesh_shape=(2, 4))
+
+
+def _params():
+    # flax-style names: the repo's only custom param names are
+    # kernel / bias / scale / initial_recurrent_state
+    return {
+        "Dense_0": {"kernel": jnp.zeros((8, 8)), "bias": jnp.zeros((8,))},
+        "LayerNorm_0": {"scale": jnp.ones((8,)), "bias": jnp.zeros((8,))},
+        "cell": {"initial_recurrent_state": jnp.zeros((1, 8))},
+    }
+
+
+def test_every_carry_leaf_gets_a_spec_and_twins_co_shard(fabric2d):
+    """The whole (params, opt) carry maps leaf-for-leaf to PartitionSpecs:
+    kernels shard P(None, 'model'), bias/scale/initial state replicate, and
+    Adam mu/nu mirror their kernel's spec (the silent-all-gather fix)."""
+    params = _params()
+    opt = optax.adam(1e-3).init(params)
+    specs = fabric2d.match_partition_rules((params, opt))
+
+    spec_leaves = jax.tree.leaves(specs, is_leaf=_IS_SPEC)
+    assert len(spec_leaves) == len(jax.tree.leaves((params, opt)))
+    assert all(isinstance(s, P) for s in spec_leaves)
+
+    param_specs, opt_specs = specs
+    assert param_specs["Dense_0"]["kernel"] == P(None, "model")
+    assert param_specs["Dense_0"]["bias"] == P()
+    assert param_specs["LayerNorm_0"]["scale"] == P()
+    assert param_specs["cell"]["initial_recurrent_state"] == P()
+    adam = opt_specs[0]  # optax.adam = chain(scale_by_adam, scale)
+    assert adam.mu["Dense_0"]["kernel"] == P(None, "model")
+    assert adam.nu["Dense_0"]["kernel"] == P(None, "model")
+    assert adam.mu["Dense_0"]["bias"] == P()
+    assert adam.count == P()
+
+
+def test_explicit_spec_and_custom_rules_win_over_defaults(fabric2d):
+    """First-match-wins: a caller rule earlier in the table overrides the
+    defaults, and an explicit PartitionSpec is used verbatim."""
+    params = _params()
+    rules = (
+        (r"Dense_0/kernel$", P("model", None)),
+        (r"(^|/)kernel$", "replicate"),
+        (r".*", "replicate"),
+    )
+    specs = fabric2d.match_partition_rules(params, rules=rules)
+    assert specs["Dense_0"]["kernel"] == P("model", None)
+    assert specs["LayerNorm_0"]["scale"] == P()
+
+    with pytest.raises(ValueError, match="unknown partition-rule strategy"):
+        fabric2d.match_partition_rules(params, rules=((r".*", "shard-it"),))
+
+
+def test_unmatched_leaf_replicates_with_warn_once(fabric2d):
+    """An unmatched leaf falls back to P() and warns exactly once per path;
+    reset_partition_rule_warnings re-arms the filter."""
+    reset_partition_rule_warnings()
+    tree = {"mystery_stat": jnp.zeros((4, 4))}
+    with pytest.warns(UserWarning, match="no partition rule matched leaf 'mystery_stat'"):
+        specs = fabric2d.match_partition_rules(tree)
+    assert specs["mystery_stat"] == P()
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # a second warning would raise
+        again = fabric2d.match_partition_rules(tree)
+    assert again["mystery_stat"] == P()
+
+    reset_partition_rule_warnings()
+    with pytest.warns(UserWarning, match="mystery_stat"):
+        fabric2d.match_partition_rules(tree)
+    reset_partition_rule_warnings()
+
+
+def test_carry_shardings_wrap_specs_in_named_shardings(fabric2d):
+    """carry_shardings maps the spec tree onto the fabric mesh for
+    device_put / jit shardings; leaf-for-leaf with the carry."""
+    params = {"Dense_0": {"kernel": jnp.zeros((8, 8)), "bias": jnp.zeros((8,))}}
+    shardings = fabric2d.carry_shardings(params)
+    kern = shardings["Dense_0"]["kernel"]
+    assert kern.mesh == fabric2d.mesh and kern.spec == P(None, "model")
+    placed = jax.device_put(params, shardings)
+    assert "model" in repr(placed["Dense_0"]["kernel"].sharding)
+
+
+def test_path_rendering_covers_namedtuple_dict_and_sequence_keys():
+    """tree_path_str renders optax namedtuple fields, dict keys and chain
+    indices into the '/'-joined names the rule table matches against."""
+    params = {"Dense_0": {"kernel": jnp.zeros((4, 4))}}
+    opt = optax.adam(1e-3).init(params)
+    flat, _ = jax.tree_util.tree_flatten_with_path(opt)
+    names = [tree_path_str(p) for p, _ in flat]
+    assert "0/count" in names
+    assert "0/mu/Dense_0/kernel" in names
+    assert "0/nu/Dense_0/kernel" in names

@@ -90,6 +90,127 @@ def superstep_equivalence_case(n_devices, out_path):
     np.savez(out_path, **{f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)})
 
 
+def superstep_equivalence_case_2d(n_devices, out_path):
+    """ISSUE 14: TWO K=4 fused superstep windows over a deterministic linear
+    train body on a 2-D ``(data, model)`` GSPMD mesh (``n_devices > 1``; the
+    mesh is ``2 x n/2``) or a single device, dumping (params, opt state,
+    target EMA, metrics) to ``out_path``. The mesh child additionally asserts
+    the ISSUE-14 carry invariants in-process: the kernel AND its Adam moment
+    twins stay model-axis sharded across windows, and window 2 reuses window
+    1's executable (zero recompiles)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from sheeprl_tpu.ops.superstep import make_superstep_fn, periodic_target_ema, pregathered
+
+    n_devices = int(n_devices)
+    multi = n_devices > 1
+    if multi:
+        fabric = Fabric(
+            devices=n_devices,
+            precision="fp32",
+            mesh_axes=("data", "model"),
+            mesh_shape=(2, n_devices // 2),
+        )
+    else:
+        fabric = Fabric(devices=1, precision="fp32")
+    K, B, D, H = 4, 8, 8, 8
+    rng = np.random.default_rng(11)
+    xs = jnp.asarray(rng.normal(size=(K, B, D)).astype(np.float32))
+    ys = jnp.asarray(rng.normal(size=(K, B, H)).astype(np.float32))
+    # leaf names match the partition-rule table: "kernel" shards its last
+    # dim over the model axis, "bias" replicates — and the Adam moments pick
+    # up the same specs through match_partition_rules on the aux carry
+    model = {
+        "kernel": jnp.asarray(rng.normal(size=(D, H)).astype(np.float32)),
+        "bias": jnp.zeros((H,), jnp.float32),
+    }
+    target = jax.tree.map(jnp.zeros_like, model)
+    tx = optax.adam(1e-2)
+    opt = tx.init(model)
+
+    def train_body(params, aux, batch, key):
+        del key  # deterministic body (see superstep_equivalence_case)
+        model, target = params
+        (opt,) = aux
+        x, y = batch
+
+        def loss_fn(m):
+            return jnp.mean(jnp.square(x @ m["kernel"] + m["bias"] - y))
+
+        # GSPMD path: global-batch semantics, no explicit pmean — XLA
+        # inserts the collectives the shardings imply
+        loss, grads = jax.value_and_grad(loss_fn)(model)
+        updates, opt = tx.update(grads, opt, model)
+        model = optax.apply_updates(model, updates)
+        return (model, target), (opt,), jnp.stack([loss])
+
+    def pre_step(params, aux, counter):
+        model, target = params
+        target = periodic_target_ema(counter, model, target, 2, 0.25)
+        return (model, target), aux
+
+    params, aux = (model, target), (opt,)
+    kwargs = {}
+    if multi:
+        carry_specs = (fabric.match_partition_rules(params), fabric.match_partition_rules(aux))
+        kwargs = dict(
+            mesh=fabric.mesh,
+            model_axis=fabric.model_axis,
+            carry_specs=carry_specs,
+            ctx_spec=P(None, fabric.data_axis),
+        )
+    superstep = make_superstep_fn(train_body, pregathered, K, pre_step=pre_step, **kwargs)
+    ctx = (xs, ys)
+    key = jax.random.PRNGKey(0)
+    if multi:
+        # every input enters window 1 committed exactly as the superstep
+        # returns it, so window 2 must not key a second executable
+        params = jax.device_put(params, fabric.carry_shardings(params))
+        aux = jax.device_put(aux, fabric.carry_shardings(aux))
+        ctx = jax.device_put(ctx, fabric.sharding(None, fabric.data_axis))
+        key = fabric.replicate(key)
+    all_metrics = []
+    for window in range(2):
+        params, aux, key, metrics = superstep(params, aux, jnp.int32(window * K), ctx, key)
+        all_metrics.append(metrics)
+
+    if multi:
+        adam = aux[0][0]  # optax.adam = chain(scale_by_adam, scale)
+        for name, leaf in (
+            ("kernel", params[0]["kernel"]),
+            ("target kernel", params[1]["kernel"]),
+            ("adam mu", adam.mu["kernel"]),
+            ("adam nu", adam.nu["kernel"]),
+        ):
+            assert "model" in repr(leaf.sharding), f"{name} not model-sharded: {leaf.sharding!r}"
+        assert superstep._cache_size() == 1, (
+            f"window 2 recompiled: {superstep._cache_size()} executables"
+        )
+    leaves = jax.tree.leaves((params, aux, all_metrics))
+    np.savez(out_path, **{f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)})
+
+
+@pytest.mark.multichip
+def test_2d_superstep_matches_single_device(multichip_run, tmp_path):
+    """ISSUE-14 acceptance: two K=4 superstep windows on an 8-device
+    (2 data x 4 model) virtual mesh produce the same params / Adam state /
+    EMA target / metrics (fp32, CPU) as the single-device superstep — with
+    the mesh child's in-process asserts proving the carries stayed
+    model-sharded and window 2 hit the window-1 executable."""
+    mesh_out = tmp_path / "mesh2d.npz"
+    single_out = tmp_path / "single.npz"
+    target = "tests.test_parallel.test_sharded_superstep:superstep_equivalence_case_2d"
+    multichip_run(target, 8, "8", str(mesh_out))
+    multichip_run(target, 1, "1", str(single_out))
+    got, want = np.load(mesh_out), np.load(single_out)
+    assert set(got.files) == set(want.files) and got.files
+    for name in got.files:
+        np.testing.assert_allclose(got[name], want[name], rtol=1e-5, atol=1e-6, err_msg=name)
+
+
 @pytest.mark.multichip
 def test_sharded_superstep_matches_single_device(multichip_run, tmp_path):
     """ISSUE acceptance: K fused steps on a 4-device virtual mesh produce
