@@ -1392,9 +1392,13 @@ if __name__ == "__main__":
             sys.stderr.write(proc.stdout + proc.stderr)
             sys.exit(proc.returncode or 2)
         by_rule = ", ".join(f"{k}:{v}" for k, v in report["counts_by_rule"].items()) or "none"
+        by_family = ", ".join(
+            f"{k}:{v}" for k, v in (report.get("counts_by_family") or {}).items()
+        ) or "none"
         cfg = report.get("config") or {}
         print(
             f"static: {report['findings_total']} findings ({by_rule}), "
+            f"families ({by_family}), "
             f"{report['baseline_suppressed']} baseline-suppressed, {len(report['new'])} new; "
             f"config cells {cfg.get('pass', 0)}/{cfg.get('cells', 0)} pass "
             f"({cfg.get('fail', 0)} fail, {cfg.get('warnings', 0)} warnings)"

@@ -121,8 +121,11 @@ class TelemetryWriter:
         return [p for p in (self.path + ".1", self.path) if os.path.exists(p)]
 
     def close(self) -> None:
-        self.flush()
-        self._fh.close()
+        # under the lock: a racing write() could rotate and swap _fh between
+        # a bare flush() and the close, leaking the fresh segment's handle
+        with self._lock:
+            self._flush_locked()
+            self._fh.close()
 
 
 class RunTelemetry:

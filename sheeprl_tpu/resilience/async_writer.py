@@ -38,7 +38,10 @@ class AsyncCheckpointWriter:
 
     @property
     def busy(self) -> bool:
-        t = self._thread
+        # snapshot under the lock (a racing submit() swaps _thread), then
+        # poll liveness on the snapshot outside it
+        with self._lock:
+            t = self._thread
         return t is not None and t.is_alive()
 
     @property
@@ -81,9 +84,10 @@ class AsyncCheckpointWriter:
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Wait for the in-flight write (if any). Returns ``True`` when no
         write remains in flight afterwards."""
-        t = self._thread
+        with self._lock:
+            t = self._thread
         if t is not None and t.is_alive():
-            t.join(timeout)
+            t.join(timeout)  # outside the lock: never block submit on a join
         return not self.busy
 
 

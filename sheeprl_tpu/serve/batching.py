@@ -88,14 +88,15 @@ class MicroBatcher:
         with self._cond:
             if self._closed:
                 raise ServerClosed("policy server is shut down")
-            if len(self._pending) >= self.max_queue:
-                depth = len(self._pending)
-                self._shed("overloaded")
-                raise Overloaded(depth, self.max_queue, self.gather_window_s)
-            req = Request(obs, now, now + float(deadline_s))
-            self._pending.append(req)
-            self._cond.notify()
-        return req
+            depth = len(self._pending)
+            if depth < self.max_queue:
+                req = Request(obs, now, now + float(deadline_s))
+                self._pending.append(req)
+                self._cond.notify()
+                return req
+        # shed path: the stats hook is user code — never run it under the lock
+        self._shed("overloaded")
+        raise Overloaded(depth, self.max_queue, self.gather_window_s)
 
     def depth(self) -> int:
         with self._cond:
@@ -154,16 +155,19 @@ class MicroBatcher:
             self._shed("expired")
         if not keep:
             return
+        failed: List[Request] = []
         with self._cond:
             if self._closed:
-                for r in keep:
-                    if not r.future.done():
-                        r.future.set_exception(ServerClosed("policy server is shut down"))
-                return
-            for r in reversed(keep):
-                r.attempts += 1
-                self._pending.appendleft(r)
-            self._cond.notify_all()
+                # completing a Future wakes its waiter — do that after release
+                failed = keep
+            else:
+                for r in reversed(keep):
+                    r.attempts += 1
+                    self._pending.appendleft(r)
+                self._cond.notify_all()
+        for r in failed:
+            if not r.future.done():
+                r.future.set_exception(ServerClosed("policy server is shut down"))
 
     # -------------------------------------------------------------- lifecycle
     def close(self) -> None:

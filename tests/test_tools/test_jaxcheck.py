@@ -41,6 +41,84 @@ def test_disabling_the_rule_silences_it(code):
     assert code not in {f.rule for f in _analyze(positive, disabled={code})}
 
 
+def test_all_twelve_rules_registered():
+    # three families, twelve rules, every rule has a self-test fixture pair
+    assert sorted(jaxcheck.RULES) == [f"JX{i:02d}" for i in range(1, 13)]
+    assert sorted(jaxcheck.FAMILIES) == ["concurrency", "sharding", "tracing"]
+    assert sorted(c for codes in jaxcheck.FAMILIES.values() for c in codes) == sorted(jaxcheck.RULES)
+    assert sorted(selftest.FIXTURES) == sorted(jaxcheck.RULES)
+
+
+def test_counts_by_family_buckets_every_rule():
+    positive, _ = selftest.FIXTURES["JX06"]
+    by_family = jaxcheck.counts_by_family(_analyze(positive))
+    assert by_family["concurrency"] >= 1
+    assert set(by_family) >= {"tracing", "concurrency", "sharding"}
+
+
+def test_seqlock_reader_pair():
+    # the reader side of the JX07 contract: missing seq re-check fires,
+    # the param-lane-shaped re-read-and-compare is quiet
+    assert "JX07" in {f.rule for f in _analyze(selftest.SEQLOCK_READER_POSITIVE)}
+    assert "JX07" not in {f.rule for f in _analyze(selftest.SEQLOCK_READER_NEGATIVE)}
+
+
+def test_pr13_stale_incarnation_clobber_is_redetectable():
+    # the exact race class PR 13 fixed by review, stripped to its shape:
+    # lock-free clear of a lock-guarded in-flight map
+    findings = [f for f in _analyze(selftest.PR13_CLOBBER_POSITIVE) if f.rule == "JX06"]
+    assert findings and "_inflight" in findings[0].message
+    assert "JX06" not in {f.rule for f in _analyze(selftest.PR13_CLOBBER_NEGATIVE)}
+
+
+def test_lock_inference_tolerates_locked_private_helpers():
+    # the SlotPool idiom: a private helper every caller invokes while already
+    # holding the lock must count as guarded, not pollute the majority vote
+    source = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._free = []
+
+        def _refill_locked(self):
+            self._free.append(object())
+
+        def take(self):
+            with self._lock:
+                if not self._free:
+                    self._refill_locked()
+                return self._free.pop()
+
+        def put(self, x):
+            with self._lock:
+                self._free.append(x)
+    """
+    assert "JX06" not in {f.rule for f in _analyze(source)}
+
+
+def test_callback_under_lock_sees_one_level_of_indirection():
+    # submit -> self._shed -> user hook, with the lock held at the top call
+    source = """
+    import threading
+
+    class Q:
+        def __init__(self, on_shed):
+            self._lock = threading.Lock()
+            self._on_shed = on_shed
+
+        def submit(self):
+            with self._lock:
+                self._shed("overloaded")
+
+        def _shed(self, kind):
+            self._on_shed(kind)
+    """
+    findings = [f for f in _analyze(source) if f.rule == "JX10"]
+    assert findings and any("submit" in f.qualname for f in findings)
+
+
 def test_hot_loop_taint_mode():
     # float() per loop iteration on a train_fn result fires; the same loop
     # after a single np.asarray host fetch is quiet — the exact shape of the
@@ -117,6 +195,47 @@ def test_baseline_reports_stale_suppressions(tmp_path):
     new, stale = compare_to_baseline(_analyze(negative), load_baseline(baseline_path))
     assert new == []
     assert stale == [f"JX01:{FIXTURE_PATH}::sample"]
+
+
+def _write_fixture_tree(tmp_path, source):
+    target = tmp_path / "mod.py"
+    target.write_text(textwrap.dedent(source))
+    return str(target)
+
+
+def test_baseline_gc_prunes_stale_and_ci_fails_on_them(tmp_path):
+    # a baseline written against the JX01 positive goes stale once the code
+    # is fixed: --baseline-gc --ci reports it and exits 1 without touching
+    # the file; plain --baseline-gc rewrites it and the next scan is clean
+    positive, negative = selftest.FIXTURES["JX01"]
+    mod = _write_fixture_tree(tmp_path, positive)
+    baseline_path = str(tmp_path / "baseline.json")
+    # keys must match the CLI's repo-root-relative rendering of the target
+    rel = os.path.relpath(mod, REPO).replace(os.sep, "/")
+    write_baseline(baseline_path, jaxcheck.analyze_source(textwrap.dedent(positive), rel))
+    (tmp_path / "mod.py").write_text(textwrap.dedent(negative))
+
+    def run(*flags):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.jaxcheck", mod,
+             "--baseline", baseline_path, "--no-configcheck", "--no-scenarios", *flags],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+
+    ci = run("--baseline-gc", "--ci")
+    assert ci.returncode == 1, ci.stdout + ci.stderr
+    assert "stale" in ci.stdout
+    assert load_baseline(baseline_path), "--ci must not rewrite the baseline"
+
+    gc = run("--baseline-gc")
+    assert gc.returncode == 0, gc.stdout + gc.stderr
+    assert load_baseline(baseline_path) == {}, "stale suppression should be pruned"
+
+    clean = run("--baseline-gc", "--ci")
+    assert clean.returncode == 0, clean.stdout + clean.stderr
 
 
 def test_checked_in_baseline_documents_every_suppression():

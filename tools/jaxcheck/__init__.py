@@ -3,9 +3,13 @@
 Two halves, both hardware-free and executed-code-free:
 
 * the **rule engine** (:mod:`tools.jaxcheck.rules`) parses every python file
-  with stdlib ``ast`` and reports JX01–JX05 hazards (PRNG key reuse, host
-  syncs in hot paths, use-after-donate, tracer branching, retrace hazards) —
-  the static complement of the runtime ``CompileWatchdog``;
+  with stdlib ``ast`` and reports JX01–JX12 hazards in three families —
+  tracing (JX01–JX05: PRNG key reuse, host syncs in hot paths,
+  use-after-donate, tracer branching, retrace hazards), concurrency/lifecycle
+  (JX06–JX10: lock discipline, seqlock protocol, thread lifecycle, shm
+  lifecycle, callback-under-lock), and sharding consistency (JX11–JX12:
+  PartitionSpec axis names vs the mesh, donated args returned un-aliased) —
+  the static complement of the runtime ``CompileWatchdog`` and chaos drills;
 * **configcheck** (:mod:`tools.jaxcheck.configcheck`) composes every cell of
   the ``exp × fabric`` / env / algo scenario matrix through the first-party
   Hydra-lite compose API and validates interpolations, required keys, and
@@ -27,9 +31,10 @@ from .core import (  # noqa: F401  (re-exported API)
     ModuleInfo,
     compare_to_baseline,
     load_baseline,
+    prune_baseline,
     write_baseline,
 )
-from .rules import RULES, run_rules  # noqa: F401
+from .rules import FAMILIES, RULES, family_of, run_rules  # noqa: F401
 
 DEFAULT_TARGETS = ("sheeprl_tpu", "tools", "benchmarks", "examples", "bench.py")
 EXCLUDE_DIR_NAMES = {"__pycache__", ".git", "configs", "tests"}
@@ -97,3 +102,12 @@ def counts_by_rule(findings: Sequence[Finding]) -> Dict[str, int]:
     for f in findings:
         out[f.rule] = out.get(f.rule, 0) + 1
     return {k: out[k] for k in sorted(out)}
+
+
+def counts_by_family(findings: Sequence[Finding]) -> Dict[str, int]:
+    """Findings bucketed by rule family (tracing/concurrency/sharding) —
+    the per-family breakdown bench.py --static folds into SCENARIOS.json."""
+    out: Dict[str, int] = {family: 0 for family in FAMILIES}
+    for f in findings:
+        out[family_of(f.rule)] = out.get(family_of(f.rule), 0) + 1
+    return out

@@ -1,9 +1,14 @@
 """``python -m tools.jaxcheck`` — the repo's static-analysis gate.
 
-Default run: scan the source tree with rules JX01–JX05, gate findings
-against ``tools/jaxcheck_baseline.json`` (only *new* findings fail), compose
-and validate the full config matrix, fold verdicts into ``SCENARIOS.json``,
-and exit nonzero on any new finding or failed config cell.
+Default run: scan the source tree with rules JX01–JX12 (tracing,
+concurrency/lifecycle, sharding consistency), gate findings against
+``tools/jaxcheck_baseline.json`` (only *new* findings fail), compose and
+validate the full config matrix, fold verdicts into ``SCENARIOS.json``, and
+exit nonzero on any new finding or failed config cell.
+
+``--baseline-gc`` prunes stale suppressions (entries whose finding no longer
+exists) from the baseline in place; with ``--ci`` it rewrites nothing and
+exits 1 if any stale entry remains, so CI forces the shrink to be committed.
 """
 
 from __future__ import annotations
@@ -18,8 +23,10 @@ from . import (
     RULES,
     compare_to_baseline,
     configcheck,
+    counts_by_family,
     counts_by_rule,
     load_baseline,
+    prune_baseline,
     repo_root,
     scan,
     write_baseline,
@@ -34,6 +41,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("paths", nargs="*", help="files/dirs to scan (default: the source tree)")
     parser.add_argument("--baseline", default=None, help=f"suppression file (default: {DEFAULT_BASELINE})")
     parser.add_argument("--write-baseline", action="store_true", help="rewrite the baseline from this scan")
+    parser.add_argument(
+        "--baseline-gc",
+        action="store_true",
+        help="prune stale suppressions from the baseline (with --ci: check only, exit 1 if stale)",
+    )
+    parser.add_argument(
+        "--ci",
+        action="store_true",
+        help="with --baseline-gc: do not rewrite, fail if any stale suppression remains",
+    )
     parser.add_argument("--disable", action="append", metavar="CODE", help="disable a rule (repeatable)")
     parser.add_argument("--json", action="store_true", help="machine-readable report on stdout")
     parser.add_argument("--self-test", action="store_true", help="run the built-in rule fixtures and exit")
@@ -73,6 +90,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.write_baseline:
         new, stale = [], []
 
+    if args.baseline_gc:
+        if stale and not args.ci:
+            removed = prune_baseline(baseline_path, stale)
+            print(f"jaxcheck: baseline-gc removed {removed} stale suppressions -> {baseline_path}")
+            for key in stale:
+                print(f"  - {key}")
+            stale = []
+        elif stale:
+            print(f"jaxcheck: baseline-gc (--ci) found {len(stale)} stale suppressions — "
+                  f"run --baseline-gc locally and commit the shrunken baseline:")
+            for key in stale:
+                print(f"  - {key}")
+            return 1
+        else:
+            print("jaxcheck: baseline-gc found no stale suppressions")
+        if args.ci:
+            return 0
+
     config_doc = None
     if not args.no_configcheck:
         config_doc = configcheck.run_configcheck()
@@ -86,6 +121,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "total": len(findings),
                     "new": len(new),
                     "by_rule": counts_by_rule(findings),
+                    "by_family": counts_by_family(findings),
                     "baseline_suppressed": len(findings) - len(new),
                 },
             )
@@ -98,6 +134,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "parse_errors": parse_errors,
             "findings_total": len(findings),
             "counts_by_rule": counts_by_rule(findings),
+            "counts_by_family": counts_by_family(findings),
             "baseline_suppressed": len(findings) - len(new),
             "new": [f.render() for f in new],
             "stale_baseline": stale,
@@ -120,8 +157,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"  - {key}")
     counts = counts_by_rule(findings)
     summary = ", ".join(f"{k}:{v}" for k, v in counts.items()) or "none"
+    families = ", ".join(f"{k}:{v}" for k, v in counts_by_family(findings).items())
     print(
-        f"# jaxcheck: {files_scanned} files, {len(findings)} findings ({summary}), "
+        f"# jaxcheck: {files_scanned} files, {len(findings)} findings ({summary}; {families}), "
         f"{len(findings) - len(new)} baseline-suppressed, {len(new)} new"
     )
     if config_doc is not None:

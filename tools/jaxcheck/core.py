@@ -31,6 +31,18 @@ SHARD_MAP_SUFFIXES = {"shard_map"}
 # as plain python, and they vastly outnumber ``lax.map`` in this codebase.
 TRACED_ARG_CALLS = {"scan", "while_loop", "fori_loop", "cond", "switch", "associative_scan"}
 
+# ctor suffixes whose instances are mutual-exclusion context managers.  Event
+# is deliberately absent: it is its own synchronisation and ``with event:`` is
+# not a thing.
+LOCK_SUFFIXES = {"Lock", "RLock", "Condition"}
+
+# container methods that mutate the receiver in place — the signal that a
+# ``self.X`` attribute is shared *mutable* state, not read-only config
+MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "insert", "add", "update", "setdefault",
+    "pop", "popleft", "popitem", "remove", "discard", "clear", "sort", "reverse",
+}
+
 
 @dataclass(frozen=True)
 class Finding:
@@ -118,6 +130,171 @@ def jit_donation(call: ast.Call) -> DonationSpec:
 FuncNode = Any  # ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda | ast.Module
 
 
+@dataclass
+class AttrAccess:
+    """One ``self.X`` touch inside a method body."""
+
+    node: ast.AST
+    method: str  # plain method name
+    method_qual: str  # dotted qualname for finding keys
+    lineno: int
+    write: bool  # plain attribute (re)bind: ``self.X = ...``
+    mutates: bool  # write, del, subscript store, or in-place container method
+    held: frozenset  # lock-attr names held at the enclosing statement
+
+
+@dataclass
+class HeldCall:
+    """A Call evaluated while at least one of the class's locks is held."""
+
+    node: ast.Call
+    method: str
+    method_qual: str
+    held: frozenset
+
+
+class ClassInfo:
+    """Per-class lock/attribute facts with cross-method guard inference.
+
+    The lock discipline of this codebase is lexical (``with self._lock:``)
+    except for one idiom: private helpers (``_refill_locked``, ``_stage``)
+    that every caller invokes while already holding the lock.  A fixpoint
+    pass propagates lock context into any ``_``-private method whose internal
+    call sites *all* hold a common lock, so those helpers' attribute accesses
+    count as guarded instead of polluting the majority vote.
+    """
+
+    def __init__(self, info: "ModuleInfo", node: ast.ClassDef) -> None:
+        self.info = info
+        self.node = node
+        self.name = node.name
+        self.methods: Dict[str, FuncNode] = {
+            child.name: child
+            for child in node.body
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.lock_attrs: Set[str] = set()
+        for meth in self.methods.values():
+            for stmt in info.own_statements(meth):
+                if not (isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call)):
+                    continue
+                if last_part(dotted_name(stmt.value.func)) not in LOCK_SUFFIXES:
+                    continue
+                for t in stmt.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        self.lock_attrs.add(t.attr)
+        self.accesses: Dict[str, List[AttrAccess]] = {}
+        self.held_calls: List[HeldCall] = []
+        self.ambient: Dict[str, frozenset] = {m: frozenset() for m in self.methods}
+        if self.lock_attrs:
+            self._infer()
+
+    # -- lock-context walk ---------------------------------------------------
+
+    def _lock_name(self, expr: ast.AST) -> Optional[str]:
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and expr.attr in self.lock_attrs
+        ):
+            return expr.attr
+        return None
+
+    def _walk_held(
+        self, body: Sequence[ast.stmt], held: frozenset, out: List[Tuple[ast.stmt, frozenset]]
+    ) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            out.append((stmt, held))
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                acquired = {n for n in (self._lock_name(i.context_expr) for i in stmt.items) if n}
+                self._walk_held(stmt.body, held | frozenset(acquired), out)
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                inner = getattr(stmt, field, None)
+                if inner:
+                    self._walk_held(inner, held, out)
+            for handler in getattr(stmt, "handlers", []) or []:
+                self._walk_held(handler.body, held, out)
+
+    def _method_stmts(self, name: str) -> List[Tuple[ast.stmt, frozenset]]:
+        out: List[Tuple[ast.stmt, frozenset]] = []
+        self._walk_held(self.methods[name].body, self.ambient.get(name, frozenset()), out)
+        return out
+
+    def _infer(self) -> None:
+        # fixpoint: a private method whose every internal ``self.m()`` call
+        # site holds a common lock inherits that lock as ambient context
+        for _ in range(len(self.methods) + 1):
+            sites: Dict[str, List[frozenset]] = {}
+            for name in self.methods:
+                for stmt, held in self._method_stmts(name):
+                    for node in walk_exprs(stmt):
+                        if (
+                            isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and isinstance(node.func.value, ast.Name)
+                            and node.func.value.id == "self"
+                            and node.func.attr in self.methods
+                        ):
+                            sites.setdefault(node.func.attr, []).append(held)
+            new_ambient: Dict[str, frozenset] = {}
+            for name in self.methods:
+                common: frozenset = frozenset()
+                if name.startswith("_") and not name.startswith("__") and sites.get(name):
+                    common = frozenset.intersection(*sites[name])
+                new_ambient[name] = common
+            if new_ambient == self.ambient:
+                break
+            self.ambient = new_ambient
+
+        for name, meth in self.methods.items():
+            qual = self.info.qualname_of(meth)
+            for stmt, held in self._method_stmts(name):
+                for node in walk_exprs(stmt):
+                    if isinstance(node, ast.Call) and held:
+                        self.held_calls.append(HeldCall(node, name, qual, held))
+                    if not (
+                        isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"
+                    ):
+                        continue
+                    attr = node.attr
+                    if attr in self.lock_attrs:
+                        continue
+                    parent = self.info.parents.get(node)
+                    # ``self.m(...)`` on a real method is a call edge (handled
+                    # by the fixpoint), not a shared-state access
+                    if (
+                        isinstance(parent, ast.Call)
+                        and parent.func is node
+                        and attr in self.methods
+                    ):
+                        continue
+                    write = isinstance(node.ctx, (ast.Store, ast.Del))
+                    mutates = write
+                    if (
+                        isinstance(parent, ast.Subscript)
+                        and parent.value is node
+                        and isinstance(parent.ctx, (ast.Store, ast.Del))
+                    ):
+                        mutates = True
+                    if isinstance(parent, ast.Attribute) and parent.attr in MUTATOR_METHODS:
+                        gp = self.info.parents.get(parent)
+                        if isinstance(gp, ast.Call) and gp.func is parent:
+                            mutates = True
+                    self.accesses.setdefault(attr, []).append(
+                        AttrAccess(node, name, qual, getattr(node, "lineno", 0), write, mutates, held)
+                    )
+
+
 class ModuleInfo:
     """One parsed module plus the cross-rule pre-pass facts."""
 
@@ -142,6 +319,17 @@ class ModuleInfo:
         # presence alone marks a *jit factory*
         self.factories: Dict[str, DonationSpec] = {}
         self._pre_pass()
+
+        # module-level int constants (``STATE, SEQ, ... = range(8)``,
+        # ``FREE, WRITING, COMMITTED = 0, 1, 2``) — the vocabulary the seqlock
+        # rule resolves header-word subscripts against
+        self.int_consts: Dict[str, int] = {}
+        self._collect_int_consts()
+
+        # per-class lock/attribute facts (lazy-free: cheap enough eagerly)
+        self.classes: List[ClassInfo] = [
+            ClassInfo(self, node) for node in ast.walk(tree) if isinstance(node, ast.ClassDef)
+        ]
 
     # ------------------------------------------------------------- pre-pass --
 
@@ -191,7 +379,39 @@ class ModuleInfo:
                         for fdef in self._by_name.get(arg.id, []):
                             self.traced.add(fdef)
 
+    def _collect_int_consts(self) -> None:
+        for stmt in self.tree.body:
+            if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+                continue
+            target = stmt.targets[0]
+            value = stmt.value
+            if isinstance(target, ast.Name):
+                if isinstance(value, ast.Constant) and isinstance(value.value, int) \
+                        and not isinstance(value.value, bool):
+                    self.int_consts[target.id] = value.value
+            elif isinstance(target, ast.Tuple) and all(isinstance(e, ast.Name) for e in target.elts):
+                names = [e.id for e in target.elts]
+                if (
+                    isinstance(value, ast.Call)
+                    and last_part(dotted_name(value.func)) == "range"
+                    and len(value.args) == 1
+                    and isinstance(value.args[0], ast.Constant)
+                    and value.args[0].value == len(names)
+                ):
+                    for i, name in enumerate(names):
+                        self.int_consts[name] = i
+                elif isinstance(value, ast.Tuple) and len(value.elts) == len(names) and all(
+                    isinstance(e, ast.Constant) and isinstance(e.value, int) for e in value.elts
+                ):
+                    for name, e in zip(names, value.elts):
+                        self.int_consts[name] = e.value
+
     # -------------------------------------------------------------- queries --
+
+    def resolve_function(self, name: str) -> Optional[FuncNode]:
+        """The module's single def of ``name``, or None (absent/ambiguous)."""
+        defs = self._by_name.get(name, [])
+        return defs[0] if len(defs) == 1 else None
 
     def qualname_of(self, node: ast.AST) -> str:
         for fnode, qual in self.functions:
@@ -310,6 +530,35 @@ def write_baseline(path: str, findings: Sequence[Finding], notes: Optional[Dict[
         json.dump(doc, f, indent=1)
         f.write("\n")
     os.replace(tmp, path)
+
+
+def prune_baseline(path: str, keys: Sequence[str]) -> int:
+    """Drop ``keys`` from the baseline file in place (notes of surviving
+    entries untouched).  Returns how many entries were removed.  The
+    ``--baseline-gc`` primitive: stale suppressions describe findings that no
+    longer exist, and a suppression nobody needs is a finding nobody sees."""
+    if not keys:
+        return 0
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return 0
+    sup = doc.get("suppressions")
+    if not isinstance(sup, dict):
+        return 0
+    removed = 0
+    for key in keys:
+        if key in sup:
+            del sup[key]
+            removed += 1
+    if removed:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+    return removed
 
 
 def compare_to_baseline(

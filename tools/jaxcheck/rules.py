@@ -1,4 +1,6 @@
-"""The jaxcheck rule registry: JX01–JX05.
+"""The jaxcheck rule registry: JX01–JX12, three families.
+
+**Tracing (JX01–JX05)** — JAX/TPU hazards:
 
 | code | hazard                                                        |
 |------|---------------------------------------------------------------|
@@ -14,11 +16,37 @@
 | JX05 | retrace hazard — ``jax.jit`` inside a loop body, or an        |
 |      | immediately-invoked ``jax.jit(f)(...)`` wrapper               |
 
+**Concurrency/lifecycle (JX06–JX10)** — the threaded serving/actor-learner
+plane (the race class the PR 12 review caught by hand):
+
+| code | hazard                                                        |
+|------|---------------------------------------------------------------|
+| JX06 | lock discipline — an attribute guarded by ``with self._lock:``|
+|      | at the majority of its sites, touched lock-free elsewhere     |
+| JX07 | seqlock protocol — payload/meta stores after the publish      |
+|      | point, or readers that skip the seq re-check                  |
+| JX08 | thread lifecycle — a non-daemon thread started but never      |
+|      | joined on any exit path                                       |
+| JX09 | shm lifecycle — ``SharedMemory(create=True)`` without the     |
+|      | register-for-atexit-sweep / close-on-error discipline         |
+| JX10 | callback under lock — ``Future.set_result``/``set_exception`` |
+|      | or a user callback invoked while holding a lock               |
+
+**Sharding consistency (JX11–JX12)**:
+
+| code | hazard                                                        |
+|------|---------------------------------------------------------------|
+| JX11 | PartitionSpec axis name absent from the module's Mesh axes —  |
+|      | a typo'd axis silently replicates instead of sharding         |
+| JX12 | a donated jit argument returned without rebinding — the       |
+|      | params-stay-alive invariant (donating an arg the caller still |
+|      | aliases hands back a dead buffer)                             |
+
 Every rule deliberately under-approximates: it only fires on patterns it can
 prove locally (straight-line data flow inside one function, plus the
-jit-factory pre-pass in :mod:`tools.jaxcheck.core`), so a finding is worth
-reading.  Soundness is the runtime watchdog's job; this is the cheap,
-hardware-free first line.
+jit-factory / class-lock pre-passes in :mod:`tools.jaxcheck.core`), so a
+finding is worth reading.  Soundness is the runtime watchdog's job; this is
+the cheap, hardware-free first line.
 """
 
 from __future__ import annotations
@@ -39,6 +67,20 @@ from .core import (
     JIT_SUFFIXES,
     SHARD_MAP_SUFFIXES,
 )
+
+# rule family -> codes, the bench/SCENARIOS breakdown axis
+FAMILIES: Dict[str, Tuple[str, ...]] = {
+    "tracing": ("JX01", "JX02", "JX03", "JX04", "JX05"),
+    "concurrency": ("JX06", "JX07", "JX08", "JX09", "JX10"),
+    "sharding": ("JX11", "JX12"),
+}
+
+
+def family_of(code: str) -> str:
+    for family, codes in FAMILIES.items():
+        if code in codes:
+            return family
+    return "other"
 
 
 class Rule:
@@ -595,6 +637,565 @@ class RetraceHazard(Rule):
                         info, qual, parent,
                         "jax.jit(f)(...) builds and discards the wrapper per call, so nothing "
                         "is ever cached — bind `g = jax.jit(f)` once and call g",
+                    )
+
+
+# ---------------------------------------------------------------------- JX06 --
+
+
+@register
+class LockDiscipline(Rule):
+    """Infer which lock guards which attribute from the majority of access
+    sites, then flag the minority that touches it lock-free.  An attribute is
+    *guarded* when ≥2 non-``__init__`` sites hold a class lock, the guarded
+    sites outnumber the unguarded ones, and at least one site mutates it
+    (read-only config never fires).  Private helpers called exclusively under
+    the lock inherit the callers' lock context (the ``_refill_locked`` idiom),
+    so only genuinely unguarded touches survive."""
+
+    code = "JX06"
+    title = "lock discipline"
+
+    def run(self, info: ModuleInfo) -> Iterator[Finding]:
+        for cls in info.classes:
+            if not cls.lock_attrs:
+                continue
+            for attr, sites in sorted(cls.accesses.items()):
+                live = [s for s in sites if s.method != "__init__"]
+                guarded = [s for s in live if s.held]
+                unguarded = [s for s in live if not s.held]
+                if len(guarded) < 2 or len(guarded) <= len(unguarded):
+                    continue
+                if not any(s.mutates for s in live):
+                    continue
+                locks = sorted({lock for s in guarded for lock in s.held})
+                for s in unguarded:
+                    kind = "written" if s.mutates else "read"
+                    yield self.finding(
+                        info, s.method_qual, s.node,
+                        f"'{cls.name}.{attr}' is guarded by {'/'.join(locks)} at "
+                        f"{len(guarded)} sites but {kind} lock-free here — a racing "
+                        f"thread can observe (or clobber) a half-updated value",
+                    )
+
+
+# ---------------------------------------------------------------------- JX07 --
+
+
+@register
+class SeqlockProtocol(Rule):
+    """The ring/param-lane seqlock contract, statically.  Only modules that
+    define seq/state header-word constants (``SEQ``, ``STATE``, ``_SEQ``, …)
+    are in scope.  Writer: after the publish point — the second ``seq += 1``
+    or the state-word store of a COMMITTED-like constant — no payload or
+    header-word store may follow, or a racing reader admits a torn slab.
+    Reader: a function that reads a seq word and then a payload must re-read
+    the seq word *after* the payload copy, or a torn read is silently
+    accepted."""
+
+    code = "JX07"
+    title = "seqlock protocol"
+
+    def run(self, info: ModuleInfo) -> Iterator[Finding]:
+        seq_words = {n for n in info.int_consts if "SEQ" in n.upper()}
+        state_words = {n for n in info.int_consts if "STATE" in n.upper()}
+        commit_consts = {n for n in info.int_consts if "COMMIT" in n.upper()}
+        if not seq_words and not state_words:
+            return
+        header_words = set(info.int_consts)
+        for scope, qual in info.functions:
+            if isinstance(scope, (ast.Module, ast.Lambda)):
+                continue
+            stmts = list(info.own_statements(scope))
+            yield from self._writer(info, qual, stmts, seq_words, state_words, commit_consts, header_words)
+            yield from self._reader(info, qual, stmts, seq_words, state_words, commit_consts)
+
+    # -- shared shape helpers -------------------------------------------------
+
+    def _index_names(self, sub: ast.Subscript) -> Set[str]:
+        idx = sub.slice
+        elts = idx.elts if isinstance(idx, ast.Tuple) else [idx]
+        return {e.id for e in elts if isinstance(e, ast.Name)}
+
+    def _store_targets(self, stmt: ast.stmt) -> List[ast.Subscript]:
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        return [t for t in targets if isinstance(t, ast.Subscript)]
+
+    def _is_payload_expr(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and "payload" in sub.attr.lower():
+                return True
+            if isinstance(sub, ast.Name) and "payload" in sub.id.lower():
+                return True
+        return False
+
+    # -- writer: nothing may follow the publish point -------------------------
+
+    def _writer(
+        self,
+        info: ModuleInfo,
+        qual: str,
+        stmts: List[ast.stmt],
+        seq_words: Set[str],
+        state_words: Set[str],
+        commit_consts: Set[str],
+        header_words: Set[str],
+    ) -> Iterator[Finding]:
+        publish_idx: Optional[int] = None
+        seq_incs = 0
+        for i, stmt in enumerate(stmts):
+            for target in self._store_targets(stmt):
+                names = self._index_names(target)
+                if isinstance(stmt, ast.AugAssign) and names & seq_words:
+                    seq_incs += 1
+                    if seq_incs == 2:
+                        publish_idx = i
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and names & state_words
+                    and isinstance(stmt.value, ast.Name)
+                    and stmt.value.id in commit_consts
+                ):
+                    publish_idx = i
+        if publish_idx is None:
+            return
+        for stmt in stmts[publish_idx + 1 :]:
+            for target in self._store_targets(stmt):
+                names = self._index_names(target)
+                if names & header_words or self._is_payload_expr(target):
+                    yield self.finding(
+                        info, qual, stmt,
+                        "payload/header store after the seqlock publish point (state flip "
+                        "or second seq increment) — a racing reader can admit this slab "
+                        "before the store lands; move every store before the publish",
+                    )
+                    return
+
+    # -- reader: the seq word must be re-read after the payload copy ----------
+
+    def _reader(
+        self,
+        info: ModuleInfo,
+        qual: str,
+        stmts: List[ast.stmt],
+        seq_words: Set[str],
+        state_words: Set[str],
+        commit_consts: Set[str],
+    ) -> Iterator[Finding]:
+        if not seq_words:
+            return
+        seq_read_positions: List[int] = []
+        payload_read_positions: List[int] = []
+        for i, stmt in enumerate(stmts):
+            stores = self._store_targets(stmt)
+            for target in stores:
+                names = self._index_names(target)
+                # a function that stores header words is a writer, not a reader
+                if names & (seq_words | state_words):
+                    return
+            store_set = set(map(id, stores))
+            for node in walk_exprs(stmt):
+                if isinstance(node, ast.Subscript) and id(node) not in store_set:
+                    if self._index_names(node) & seq_words:
+                        seq_read_positions.append(i)
+                if id(node) in store_set:
+                    continue
+                if isinstance(node, ast.Attribute) and "payload" in node.attr.lower():
+                    payload_read_positions.append(i)
+        if not seq_read_positions or not payload_read_positions:
+            return
+        if max(seq_read_positions) <= max(payload_read_positions):
+            yield self.finding(
+                info, qual, stmts[max(payload_read_positions)],
+                "seqlock read skips the seq re-check: the seq word is never re-read "
+                "after the payload copy, so a read racing a publish is accepted torn — "
+                "re-read the seq word and retry on mismatch",
+            )
+
+
+# ---------------------------------------------------------------------- JX08 --
+
+
+@register
+class ThreadLifecycle(Rule):
+    """A non-daemon thread that is started but never joined outlives every
+    exit path: interpreter shutdown blocks on it, and the work it owns (e.g.
+    in-flight futures) leaks.  Daemon threads with a visible ``join`` are the
+    house style; this flags the rest.  Also: a non-daemon thread captured in
+    a registry (``.append``/``.add``) in a module with neither a stop
+    ``Event`` nor any ``join`` has no shutdown protocol at all."""
+
+    code = "JX08"
+    title = "thread lifecycle"
+
+    def run(self, info: ModuleInfo) -> Iterator[Finding]:
+        joined: Set[str] = set()
+        daemon_names: Set[str] = set()
+        has_event = False
+        has_any_join = False
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                if node.func.attr == "join":
+                    has_any_join = True
+                    key = last_part(dotted_name(node.func.value))
+                    if key:
+                        joined.add(key)
+            if isinstance(node, ast.Call) and last_part(dotted_name(node.func)) == "Event":
+                has_event = True
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and t.attr == "daemon"
+                        and isinstance(node.value, ast.Constant)
+                        and node.value.value is True
+                    ):
+                        key = last_part(dotted_name(t.value))
+                        if key:
+                            daemon_names.add(key)
+
+        started: Set[str] = {
+            last_part(dotted_name(node.func.value))
+            for node in ast.walk(info.tree)
+            if isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "start"
+            and last_part(dotted_name(node.func.value))
+        }
+
+        for node in ast.walk(info.tree):
+            if not (isinstance(node, ast.Call) and last_part(dotted_name(node.func)) == "Thread"):
+                continue
+            scope = info.enclosing_function(node)
+            qual = info.qualname_of(scope)
+            daemon = any(
+                kw.arg == "daemon" and isinstance(kw.value, ast.Constant) and kw.value.value is True
+                for kw in node.keywords
+            )
+            parent = info.parents.get(node)
+            # ``Thread(...).start()`` chained inline: no handle, no join, ever
+            if isinstance(parent, ast.Attribute) and parent.attr == "start":
+                if not daemon:
+                    yield self.finding(
+                        info, qual, node,
+                        "non-daemon Thread started inline without keeping a handle — it can "
+                        "never be joined, so every exit path leaks it; keep the handle and "
+                        "join it (or pass daemon=True with a stop flag)",
+                    )
+                continue
+            # registry capture: ``threads.append(Thread(...))``
+            if (
+                isinstance(parent, ast.Call)
+                and isinstance(parent.func, ast.Attribute)
+                and parent.func.attr in {"append", "add"}
+            ):
+                if not daemon and not has_event and not has_any_join:
+                    yield self.finding(
+                        info, qual, node,
+                        "non-daemon Thread captured in a long-lived registry with no stop "
+                        "Event and no join anywhere in the module — there is no shutdown "
+                        "protocol for it",
+                    )
+                continue
+            key = None
+            if isinstance(parent, ast.Assign) and parent.targets:
+                t = parent.targets[0]
+                key = t.id if isinstance(t, ast.Name) else (t.attr if isinstance(t, ast.Attribute) else None)
+            if key is None or daemon or key in daemon_names:
+                continue
+            if key in started and key not in joined:
+                yield self.finding(
+                    info, qual, node,
+                    f"non-daemon Thread '{key}' is started but never joined on any exit "
+                    f"path — shutdown blocks on it and its in-flight work leaks; join it "
+                    f"in close()/finally (or pass daemon=True with a stop flag)",
+                )
+
+
+# ---------------------------------------------------------------------- JX09 --
+
+
+@register
+class ShmLifecycle(Rule):
+    """``SharedMemory(create=True)`` allocates a named segment that outlives
+    the process unless someone calls ``close()`` + ``unlink()`` on every exit
+    path.  The repo's discipline is the atexit leak sweep: every created
+    segment is handed to a ``register*`` guard immediately.  A creation that
+    is neither registered nor wrapped in a try whose handler/finally tears
+    down leaks ``/dev/shm`` entries for the next run to collide with."""
+
+    code = "JX09"
+    title = "shm lifecycle"
+
+    def run(self, info: ModuleInfo) -> Iterator[Finding]:
+        for scope, qual in info.functions:
+            stmts = list(info.own_statements(scope))
+            for i, stmt in enumerate(stmts):
+                for call in walk_exprs(stmt):
+                    if not (
+                        isinstance(call, ast.Call)
+                        and last_part(dotted_name(call.func)) == "SharedMemory"
+                    ):
+                        continue
+                    if not any(
+                        kw.arg == "create"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                        for kw in call.keywords
+                    ):
+                        continue
+                    name = None
+                    if isinstance(stmt, ast.Assign) and stmt.value is call:
+                        names = _assign_target_names(stmt)
+                        name = names[0] if names else None
+                    if name is not None and self._registered_later(stmts[i + 1 :], name):
+                        continue
+                    if self._try_guarded(info, stmt):
+                        continue
+                    yield self.finding(
+                        info, qual, call,
+                        "SharedMemory(create=True) without registering the segment for the "
+                        "atexit leak sweep or a try/except teardown — a crash on any path "
+                        "between here and close()+unlink() leaks the named segment",
+                    )
+
+    def _registered_later(self, rest: List[ast.stmt], name: str) -> bool:
+        for stmt in rest:
+            for call in walk_exprs(stmt):
+                if (
+                    isinstance(call, ast.Call)
+                    and "register" in last_part(dotted_name(call.func)).lower()
+                    and any(isinstance(a, ast.Name) and a.id == name for a in call.args)
+                ):
+                    return True
+        return False
+
+    def _try_guarded(self, info: ModuleInfo, stmt: ast.stmt) -> bool:
+        """Enclosed in a try whose handler or finally calls a ``close``."""
+        cur = info.parents.get(stmt)
+        while cur is not None and not isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if isinstance(cur, ast.Try):
+                teardown = list(cur.finalbody)
+                for handler in cur.handlers:
+                    teardown.extend(handler.body)
+                for t in teardown:
+                    for call in ast.walk(t):
+                        if isinstance(call, ast.Call) and "close" in last_part(
+                            dotted_name(call.func)
+                        ).lower():
+                            return True
+            cur = info.parents.get(cur)
+        return False
+
+
+# ---------------------------------------------------------------------- JX10 --
+
+
+@register
+class CallbackUnderLock(Rule):
+    """Completing a ``Future`` or invoking a user callback while holding a
+    lock runs arbitrary foreign code inside the critical section: a waiter
+    woken by ``set_result`` (or a callback that calls back into this object)
+    re-enters and deadlocks, and the lock's hold time is unbounded.  Collect
+    under the lock, call outside — the discipline every ``close()`` in the
+    serve tier already follows.  Methods that *indirectly* reach a callback
+    (``self._shed`` → ``self._on_shed``) are resolved one level deep."""
+
+    code = "JX10"
+    title = "callback under lock"
+
+    FUTURE_COMPLETIONS = {"set_result", "set_exception"}
+
+    def _is_callback_name(self, name: str) -> bool:
+        low = name.lower()
+        return (
+            low.startswith("on_")
+            or low.startswith("_on_")
+            or "callback" in low
+            or low in {"cb", "_cb", "hook", "_hook"}
+        )
+
+    def run(self, info: ModuleInfo) -> Iterator[Finding]:
+        for cls in info.classes:
+            if not cls.lock_attrs:
+                continue
+            # methods whose body reaches a callback or future completion
+            indirect: Set[str] = set()
+            for name, meth in cls.methods.items():
+                for node in ast.walk(meth):
+                    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                        if node.func.attr in self.FUTURE_COMPLETIONS:
+                            indirect.add(name)
+                        if (
+                            isinstance(node.func.value, ast.Name)
+                            and node.func.value.id == "self"
+                            and self._is_callback_name(node.func.attr)
+                        ):
+                            indirect.add(name)
+            for hc in cls.held_calls:
+                call = hc.node
+                if not isinstance(call.func, ast.Attribute):
+                    continue
+                attr = call.func.attr
+                receiver_is_self = (
+                    isinstance(call.func.value, ast.Name) and call.func.value.id == "self"
+                )
+                if attr in self.FUTURE_COMPLETIONS:
+                    yield self.finding(
+                        info, hc.method_qual, call,
+                        f".{attr}() while holding {'/'.join(sorted(hc.held))}: the woken "
+                        f"waiter (and any done-callback) runs inside the critical section "
+                        f"— collect under the lock, complete after releasing it",
+                    )
+                elif receiver_is_self and self._is_callback_name(attr):
+                    yield self.finding(
+                        info, hc.method_qual, call,
+                        f"user callback 'self.{attr}' invoked while holding "
+                        f"{'/'.join(sorted(hc.held))} — foreign code inside the critical "
+                        f"section can re-enter and deadlock; call it after releasing",
+                    )
+                elif receiver_is_self and attr in indirect and attr in cls.methods:
+                    yield self.finding(
+                        info, hc.method_qual, call,
+                        f"'self.{attr}()' reaches a callback/Future completion and is "
+                        f"called while holding {'/'.join(sorted(hc.held))} — the callback "
+                        f"runs inside the critical section; hoist the call out of the "
+                        f"locked region",
+                    )
+
+
+# ---------------------------------------------------------------------- JX11 --
+
+
+@register
+class PartitionSpecAxes(Rule):
+    """A ``PartitionSpec`` axis name that no ``Mesh`` in the module declares
+    does not error — it silently replicates the dimension, burning HBM and
+    bandwidth with zero functional signal.  Scope: modules that declare mesh
+    axes as literals (``Mesh(devs, ("data", "model"))`` or a literal
+    ``axis_names=``/``mesh_axes=`` kwarg); variable axis names never fire."""
+
+    code = "JX11"
+    title = "partition-spec axis name"
+
+    SPEC_SUFFIXES = {"PartitionSpec", "P"}
+
+    def run(self, info: ModuleInfo) -> Iterator[Finding]:
+        vocab: Set[str] = set()
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if last_part(dotted_name(node.func)) == "Mesh" and len(node.args) >= 2:
+                vocab |= _const_axis_names(node.args[1])
+            for kw in node.keywords:
+                if kw.arg in {"axis_names", "mesh_axes"}:
+                    vocab |= _const_axis_names(kw.value)
+        if not vocab:
+            return
+        for node in ast.walk(info.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and last_part(dotted_name(node.func)) in self.SPEC_SUFFIXES
+            ):
+                continue
+            scope = info.enclosing_function(node)
+            qual = info.qualname_of(scope)
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for elt in (arg.elts if isinstance(arg, (ast.Tuple, ast.List)) else [arg]):
+                    if (
+                        isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, str)
+                        and elt.value not in vocab
+                    ):
+                        yield self.finding(
+                            info, qual, node,
+                            f"PartitionSpec axis '{elt.value}' is not among the mesh axes "
+                            f"declared in this module ({', '.join(sorted(vocab))}) — a "
+                            f"typo'd axis silently replicates instead of sharding",
+                        )
+
+
+def _const_axis_names(node: ast.AST) -> Set[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {
+            e.value for e in node.elts if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        }
+    return set()
+
+
+# ---------------------------------------------------------------------- JX12 --
+
+
+@register
+class DonatedArgReturnedUnaliased(Rule):
+    """A jit donates an argument the wrapped function returns *without ever
+    rebinding*: the caller gets its own (now dead) input buffer back.  This
+    is the PPO params-stay-alive invariant — the host player aliases the
+    params buffers, so params may only ride ``donate_argnums`` when the train
+    fn rebinds them with the updated pytree before returning.  Resolves the
+    jitted callee through one ``shard_map`` wrapper."""
+
+    code = "JX12"
+    title = "donated arg returned un-aliased"
+
+    def run(self, info: ModuleInfo) -> Iterator[Finding]:
+        shard_wraps: Dict[str, str] = {}
+        for node in ast.walk(info.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and last_part(dotted_name(node.value.func)) in SHARD_MAP_SUFFIXES
+                and node.value.args
+                and isinstance(node.value.args[0], ast.Name)
+            ):
+                for t in _assign_target_names(node):
+                    shard_wraps[t] = node.value.args[0].id
+        for node in ast.walk(info.tree):
+            if not is_jit_call(node):
+                continue
+            spec = jit_donation(node)
+            if not spec:
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Name):
+                continue
+            fname = shard_wraps.get(node.args[0].id, node.args[0].id)
+            fn = info.resolve_function(fname)
+            if fn is None:
+                continue
+            params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+            donated = {params[i] for i in spec.argnums if i < len(params)}
+            donated |= spec.argnames & set(params)
+            if not donated:
+                continue
+            bound = {
+                n.id
+                for n in ast.walk(fn)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+            }
+            returned: Set[str] = set()
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Return) and sub.value is not None:
+                    returned |= {
+                        n.id
+                        for n in ast.walk(sub.value)
+                        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                    }
+            scope = info.enclosing_function(node)
+            qual = info.qualname_of(scope)
+            for p in sorted(donated):
+                if p in returned and p not in bound:
+                    yield self.finding(
+                        info, qual, node,
+                        f"'{fname}' donates '{p}' but returns it without ever rebinding — "
+                        f"the caller gets a dead buffer back (and any alias it holds dies "
+                        f"with it); rebind '{p}' with the updated value before returning, "
+                        f"or drop it from donate_argnums",
                     )
 
 

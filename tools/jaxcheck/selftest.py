@@ -110,6 +110,186 @@ FIXTURES: Dict[str, Tuple[str, str]] = {
             return [g(x) for x in xs]
         """,
     ),
+    "JX06": (
+        """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def pop(self):
+                with self._lock:
+                    return self._items.pop()
+
+            def release_all(self):
+                self._items.clear()
+        """,
+        """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def pop(self):
+                with self._lock:
+                    return self._items.pop()
+
+            def release_all(self):
+                with self._lock:
+                    self._items.clear()
+        """,
+    ),
+    "JX07": (
+        """
+        STATE, SEQ = 0, 1
+        FREE, WRITING, COMMITTED = 0, 1, 2
+
+        class Ring:
+            def commit(self, slot, data):
+                self._hdr[slot, STATE] = COMMITTED
+                self._payload[slot] = data
+        """,
+        """
+        STATE, SEQ = 0, 1
+        FREE, WRITING, COMMITTED = 0, 1, 2
+
+        class Ring:
+            def commit(self, slot, data):
+                self._payload[slot] = data
+                self._hdr[slot, STATE] = COMMITTED
+        """,
+    ),
+    "JX08": (
+        """
+        import threading
+
+        class Router:
+            def __init__(self):
+                self._scan = threading.Thread(target=self._loop, name="scan")
+                self._scan.start()
+
+            def _loop(self):
+                pass
+        """,
+        """
+        import threading
+
+        class Router:
+            def __init__(self):
+                self._scan = threading.Thread(target=self._loop, name="scan", daemon=True)
+                self._scan.start()
+
+            def _loop(self):
+                pass
+
+            def close(self):
+                self._scan.join(timeout=1.0)
+        """,
+    ),
+    "JX09": (
+        """
+        from multiprocessing import shared_memory
+
+        def make_block(nbytes):
+            block = shared_memory.SharedMemory(create=True, size=nbytes)
+            return block
+        """,
+        """
+        from multiprocessing import shared_memory
+
+        from leaks import register_owned_segment
+
+        def make_block(nbytes):
+            block = shared_memory.SharedMemory(create=True, size=nbytes)
+            register_owned_segment(block)
+            return block
+        """,
+    ),
+    "JX10": (
+        """
+        import threading
+
+        class WaitQueue:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._waiters = []
+
+            def fail_all(self, exc):
+                with self._lock:
+                    for fut in self._waiters:
+                        fut.set_exception(exc)
+                    self._waiters.clear()
+        """,
+        """
+        import threading
+
+        class WaitQueue:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._waiters = []
+
+            def fail_all(self, exc):
+                with self._lock:
+                    waiters = list(self._waiters)
+                    self._waiters.clear()
+                for fut in waiters:
+                    fut.set_exception(exc)
+        """,
+    ),
+    "JX11": (
+        """
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def make_specs(devices):
+            mesh = Mesh(devices, ("data", "model"))
+            spec = P("data", "modle")
+            return mesh, spec
+        """,
+        """
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def make_specs(devices):
+            mesh = Mesh(devices, ("data", "model"))
+            spec = P("data", "model")
+            return mesh, spec
+        """,
+    ),
+    "JX12": (
+        """
+        import jax
+
+        def step(params, batch):
+            grads = batch
+            return params, grads
+
+        def main(params, batch):
+            train = jax.jit(step, donate_argnums=(0,))
+            return train(params, batch)
+        """,
+        """
+        import jax
+
+        def step(params, batch):
+            params = params + batch
+            return params, batch
+
+        def main(params, batch):
+            train = jax.jit(step, donate_argnums=(0,))
+            return train(params, batch)
+        """,
+    ),
 }
 
 # the JX02 hot-loop mode only applies under algos/, so fixtures are analyzed
@@ -146,6 +326,79 @@ def main(step, params, batches):
         print(float(metrics[0]))
 """
 
+# a second JX07 pair exercising the READER side of the seqlock contract
+# (the FIXTURES pair covers the writer side)
+SEQLOCK_READER_POSITIVE = """
+STATE, SEQ = 0, 1
+
+class Lane:
+    def poll(self):
+        s1 = self._hdr[SEQ]
+        if s1 % 2 == 1:
+            return None
+        out = self._payload.copy()
+        return out
+"""
+
+SEQLOCK_READER_NEGATIVE = """
+STATE, SEQ = 0, 1
+
+class Lane:
+    def poll(self):
+        s1 = self._hdr[SEQ]
+        out = self._payload.copy()
+        s2 = self._hdr[SEQ]
+        if s1 != s2:
+            return None
+        return out
+"""
+
+# stripped reproduction of the PR 13 stale-incarnation clobber: a restarted
+# replica's stale incarnation completes a batch by clearing the whole
+# in-flight map lock-free, clobbering the fresh incarnation's work.  The
+# shipped fix (rid-keyed, ownership-checked pop under the lock) is the
+# negative.  JX06 must re-detect the exact shipped race class.
+PR13_CLOBBER_POSITIVE = """
+import threading
+
+class SlotPool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight = {}
+
+    def take_batch(self, rid, batch):
+        with self._lock:
+            self._inflight[rid] = batch
+
+    def outstanding(self):
+        with self._lock:
+            return len(self._inflight)
+
+    def complete_batch(self, rid):
+        self._inflight.clear()
+"""
+
+PR13_CLOBBER_NEGATIVE = """
+import threading
+
+class SlotPool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight = {}
+
+    def take_batch(self, rid, batch):
+        with self._lock:
+            self._inflight[rid] = batch
+
+    def outstanding(self):
+        with self._lock:
+            return len(self._inflight)
+
+    def complete_batch(self, rid):
+        with self._lock:
+            self._inflight.pop(rid, None)
+"""
+
 
 def _codes(source: str) -> set:
     findings = analyze_source(textwrap.dedent(source), FIXTURE_PATH)
@@ -167,8 +420,19 @@ def self_test() -> int:
         failures.append("JX02: hot-loop positive fixture did not fire")
     if "JX02" in _codes(HOT_LOOP_NEGATIVE):
         failures.append("JX02: hot-loop negative fixture fired after np.asarray fetch")
+    if "JX07" not in _codes(SEQLOCK_READER_POSITIVE):
+        failures.append("JX07: seqlock-reader positive fixture (missing seq re-check) did not fire")
+    if "JX07" in _codes(SEQLOCK_READER_NEGATIVE):
+        failures.append("JX07: seqlock-reader negative fixture (re-check present) fired")
+    if "JX06" not in _codes(PR13_CLOBBER_POSITIVE):
+        failures.append("JX06: PR 13 stale-incarnation-clobber repro did not fire")
+    if "JX06" in _codes(PR13_CLOBBER_NEGATIVE):
+        failures.append("JX06: fixed (rid-keyed, lock-held) clobber fixture fired")
     if failures:
         print("jaxcheck self-test FAILED:\n  " + "\n  ".join(failures), file=sys.stderr)
         return 1
-    print(f"jaxcheck self-test: ok ({len(FIXTURES)} rules × positive/negative/disable fixtures verified)")
+    print(
+        f"jaxcheck self-test: ok ({len(FIXTURES)} rules × positive/negative/disable fixtures, "
+        f"plus hot-loop, seqlock-reader, and PR 13 clobber-repro pairs verified)"
+    )
     return 0
