@@ -197,19 +197,34 @@ def _read_probe(path, workload):
 # the heartbeat fields, so no peak-FLOPS table is needed here.
 
 
+def telemetry_segments(path: str) -> list:
+    """A stream's on-disk segments, oldest first: size-capped rotation
+    renames the overflowing file to ``<path>.1`` (obs/telemetry.py
+    TelemetryWriter), so a soak run's early events — run_start, warmup
+    compiles, the first heartbeats — live in the ``.1`` segment."""
+    return [p for p in (path + ".1", path) if os.path.exists(p)]
+
+
 def read_telemetry(path: str) -> list:
-    """Parse a telemetry.jsonl into a list of event dicts. A torn final line
-    (run killed mid-flush) is dropped, not fatal."""
+    """Parse a telemetry stream into a list of event dicts, reading rotated
+    segments oldest-first (the old single-file reader silently dropped the
+    ``.1`` segment, i.e. the entire first half of any rotated soak run). A
+    torn final line (run killed mid-flush) is dropped, not fatal."""
+    paths = telemetry_segments(path)
+    if not paths:
+        # preserve the old contract: a nonexistent stream raises
+        raise FileNotFoundError(path)
     events = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                events.append(json.loads(line))
-            except ValueError:
-                continue
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue
     return events
 
 
@@ -217,10 +232,13 @@ def telemetry_summary(events_or_path) -> dict:
     """Aggregate a run's telemetry stream into the bench-facing numbers:
     SPS from the heartbeat windows, time-weighted MFU, per-span totals,
     compile/recompile counts, device-poll count and HBM peak."""
-    events = (
-        read_telemetry(events_or_path) if isinstance(events_or_path, str) else list(events_or_path)
-    )
-    summary: dict = {"events": len(events)}
+    summary: dict = {}
+    if isinstance(events_or_path, str):
+        events = read_telemetry(events_or_path)
+        summary["segments"] = len(telemetry_segments(events_or_path))
+    else:
+        events = list(events_or_path)
+    summary["events"] = len(events)
 
     heartbeats = [e for e in events if e.get("event") == "heartbeat"]
     env_steps = sum(e.get("window_env_steps", 0) for e in heartbeats)
@@ -529,6 +547,31 @@ def resilience_stats(events_or_path) -> dict:
             break
     out["totals"] = totals
     return out
+
+
+def _load_tool(name: str):
+    """Load a tools/ module by file path so this parent stays jax-free and
+    importable without the tools package on sys.path (same reason --regress
+    loads tools/regress.py this way)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"_sheeprl_tpu_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def trace_summary(paths: list) -> dict:
+    """Merge the given per-process trace/telemetry streams (tools/trace.py)
+    and return the critical-path attribution: the per-slab lag decomposition
+    (collect -> ring-wait -> train with slab-age p50/p95) and the per-request
+    latency decomposition (queue-wait -> assembly -> compute with hedge
+    dedup). Both sections are always present — empty runs report zero traces
+    rather than omitting the section."""
+    trace_mod = _load_tool("trace")
+    merged = trace_mod.merge(paths)
+    return trace_mod.summarize(merged)
 
 
 def _slo_goodput(stats: dict):
@@ -1315,6 +1358,16 @@ if __name__ == "__main__":
         "rows, per-replica rows, fleet rollup)",
     )
     parser.add_argument(
+        "--trace",
+        metavar="PATH",
+        nargs="+",
+        help="merge per-process trace/telemetry streams (tools/trace.py) and "
+        "print the critical-path attribution: per-slab lag decomposition "
+        "(collect -> ring-wait -> train, slab-age p50/p95) and per-request "
+        "latency decomposition (queue-wait -> assembly -> compute, hedge "
+        "dedup) — pass the run's telemetry_files set from RUNS.jsonl",
+    )
+    parser.add_argument(
         "--regress",
         action="store_true",
         help="regression gate: compare the newest run-registry record per "
@@ -1439,6 +1492,8 @@ if __name__ == "__main__":
         print(json.dumps(env_stats_summary(args.env_stats), indent=1))
     elif args.dispatch_stats:
         print(json.dumps(dispatch_stats(args.dispatch_stats)))
+    elif args.trace:
+        print(json.dumps(trace_summary(args.trace), indent=1))
     elif args.telemetry:
         print(json.dumps(telemetry_summary(args.telemetry)))
     elif args.workload:

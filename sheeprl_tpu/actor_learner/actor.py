@@ -63,6 +63,7 @@ def actor_main(conn, hb, actor_index: int, blob: bytes) -> None:
         envs_per_actor = int(spec["envs_per_actor"])
         rollout_steps = int(spec["rollout_steps"])
         faults = list(spec["faults"])  # wire dicts; empty after a restart
+        trace_dir = spec.get("trace_dir")  # None when the learner runs untelemetered
 
         import gymnasium as gym
         import jax
@@ -124,6 +125,21 @@ def actor_main(conn, hb, actor_index: int, blob: bytes) -> None:
         ring = TrajectoryRing.attach(spec["ring"])
         lane = ParamLane.attach(spec["lane"])
         layout = SlabLayout.from_wire(spec["layout"])
+
+        # standalone flush-per-event trace recorder: the actor has no
+        # telemetry hub and the crash drills kill it via os._exit (no atexit,
+        # no buffered flush), so every event must hit disk as it happens —
+        # that is what puts the actor-side half of a torn slab's trace on the
+        # merged timeline. Restarted generations append to the same file.
+        from sheeprl_tpu.obs.trace import configure_trace, new_trace_id, trace_event
+
+        traced = bool(trace_dir)
+        if traced:
+            configure_trace(
+                f"actor{actor_index}",
+                os.path.join(trace_dir, f"trace.actor{actor_index}.jsonl"),
+                generation=generation,
+            )
 
         hb[actor_index] = time.time()
         conn.send(("ready",))
@@ -251,6 +267,20 @@ def actor_main(conn, hb, actor_index: int, blob: bytes) -> None:
             flat["advantages"] = np.asarray(advantages).reshape(T * E, 1)
             flat["ep_stats"] = np.asarray([ep_ret_sum, ep_len_sum, ep_count], np.float32)
             collect_us = int((time.perf_counter() - t0) * 1e6)
+            # mint the slab's cross-process trace id and record the actor-side
+            # span BEFORE the ring write: a crash between write_meta and
+            # commit (the torn drill) must still leave this half of the chain
+            slab_tid = new_trace_id() if traced else 0
+            if slab_tid:
+                trace_event(
+                    "slab_collect",
+                    slab_tid,
+                    seq=slab_seq,
+                    actor=actor_index,
+                    param_version=param_version,
+                    collect_us=collect_us,
+                    env_steps=T * E,
+                )
 
             # acquire an owned slot (spin with heartbeats while the learner
             # drains a full ring — backpressure, not an error)
@@ -278,6 +308,8 @@ def actor_main(conn, hb, actor_index: int, blob: bytes) -> None:
                 n_rows=T * E,
                 collect_us=collect_us,
                 env_steps=T * E,
+                trace_id=slab_tid,
+                commit_t_us=int(time.time() * 1e6),
             )
             if any(f["kind"] == "actor_crash_mid_write" and f["at_slab"] == local_slab for f in faults):
                 # the torn write: payload + meta are in place, the commit
@@ -285,6 +317,8 @@ def actor_main(conn, hb, actor_index: int, blob: bytes) -> None:
                 # a SIGKILL-like death is what the reader must survive.
                 os._exit(13)
             ring.commit(slot)
+            if slab_tid:
+                trace_event("slab_commit", slab_tid, slot=slot, seq=slab_seq)
             slab_seq += 1
             local_slab += 1
             hb[actor_index] = time.time()
@@ -297,6 +331,12 @@ def actor_main(conn, hb, actor_index: int, blob: bytes) -> None:
             pass
         os._exit(1)
     finally:
+        try:
+            from sheeprl_tpu.obs.trace import shutdown_trace
+
+            shutdown_trace()
+        except Exception:
+            pass
         for closer in (ring, lane, envs):
             if closer is not None:
                 try:

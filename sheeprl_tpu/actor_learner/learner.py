@@ -46,13 +46,16 @@ from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.obs import (
     telemetry_actor_restart,
     telemetry_advance,
+    telemetry_child_file,
     telemetry_register_flops,
     telemetry_run_metrics,
     telemetry_slab,
+    telemetry_slab_lag,
     telemetry_torn_slabs,
     telemetry_train_window,
 )
 from sheeprl_tpu.obs.telemetry import get_telemetry
+from sheeprl_tpu.obs.trace import set_trace_role, trace_event
 from sheeprl_tpu.parallel.fabric import _ParamStreamer, put_tree, resolve_player_device, resolve_train_device
 from sheeprl_tpu.parallel.submesh import probe_spaces
 from sheeprl_tpu.resilience import RunResilience
@@ -90,6 +93,12 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
 
     resil = RunResilience(fabric, cfg, log_dir)
     alcfg: ActorLearnerConfig = actor_learner_config_from_cfg(cfg)
+    # name this process's track on the merged cross-process timeline; actors
+    # hand their standalone recorders their own roles (actor<i>)
+    set_trace_role("learner")
+    # actors get a trace dir only when the run is telemetered — their
+    # flush-per-event recorders exist to be merged with telemetry.jsonl
+    trace_dir = log_dir if get_telemetry() is not None else None
 
     num_envs = int(cfg.env.num_envs)
     rollout_steps = int(cfg.algo.rollout_steps)
@@ -185,6 +194,7 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
                 "ring": ring.spec(),
                 "lane": lane.spec(),
                 "layout": layout.to_wire(),
+                "trace_dir": trace_dir,
                 # seq-disjoint generations keep the fold_in action streams
                 # unique across restarts
                 "start_seq": generation * (1 << 20),
@@ -193,8 +203,14 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
 
     version = 0
     lane.publish(np.asarray(streamer.begin(params)), version)
+    trace_event("param_publish", version=version)
 
     supervisor = ActorSupervisor(alcfg, ring, make_blob, on_restart=telemetry_actor_restart)
+    if trace_dir is not None:
+        # declare the child trace files up front so the registry record names
+        # the run's full file set even if an actor dies before its first slab
+        for i in range(alcfg.num_actors):
+            telemetry_child_file(os.path.join(trace_dir, f"trace.actor{i}.jsonl"))
 
     # --------------------------------------------------------------- counters
     start_update = (state["update"] + 1) if state else 1
@@ -286,6 +302,10 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
         if total > torn_seen:
             telemetry_torn_slabs(total - torn_seen, source="ring")
             torn_seen = total
+        # terminate each victim's causal chain on the merged timeline: its
+        # trace ends at `torn`, never at `slab_train`
+        for tid in ring.drain_torn_trace_ids():
+            trace_event("torn", tid, source="ring")
 
     def maybe_heartbeat(final: bool = False) -> None:
         nonlocal last_log, last_train, win_env_s, win_env_steps, win_train_s, win_wait_s
@@ -340,6 +360,7 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
                 stall_until = 0.0
                 if published_version < version:
                     lane.publish(np.asarray(streamer.begin(params)), version)
+                    trace_event("param_publish", version=version, after_stall=True)
                     published_version = version
 
             meta = None
@@ -364,12 +385,37 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
             staleness = version - meta.param_version
             ok = admit(meta.param_version, version, alcfg.max_staleness)
             telemetry_slab(staleness=staleness, occupancy=ring.occupancy(), admitted=ok)
+            # commit→admit ring wait from the slab header's epoch-µs commit
+            # stamp (same host, so the epoch clocks agree)
+            ring_wait_us = (
+                max(0, int(time.time() * 1e6) - meta.commit_t_us) if meta.commit_t_us else 0
+            )
             if not ok:
                 # count, drop, free the slot — the owning actor refills it
                 # against a fresher version
                 dropped_stale += 1
+                if meta.trace_id:
+                    trace_event(
+                        "slab_drop_stale",
+                        meta.trace_id,
+                        actor=meta.actor_id,
+                        seq=meta.seq,
+                        param_version=meta.param_version,
+                        staleness=staleness,
+                    )
                 ring.release(meta.slot)
                 continue
+            if meta.trace_id:
+                trace_event(
+                    "slab_admit",
+                    meta.trace_id,
+                    slot=meta.slot,
+                    actor=meta.actor_id,
+                    seq=meta.seq,
+                    param_version=meta.param_version,
+                    staleness=staleness,
+                    ring_wait_us=ring_wait_us,
+                )
 
             if admitted == 0 and spawn_wait_s > 0:
                 # the first slab just landed: everything the learner waited
@@ -396,7 +442,8 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
                 np.float32(ent_coef),
             )
             metrics = np.asarray(metrics)
-            win_train_s += time.perf_counter() - t0
+            train_dt = time.perf_counter() - t0
+            win_train_s += train_dt
             telemetry_train_window(1, update_epochs * num_minibatches)
 
             if not resil.check_finite(metrics, update + 1):
@@ -408,6 +455,12 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
             policy_step += meta.n_rows
             win_env_s += meta.collect_us / 1e6
             win_env_steps += meta.env_steps
+            if meta.trace_id:
+                train_us = int(train_dt * 1e6)
+                trace_event("slab_train", meta.trace_id, train_us=train_us, update=update)
+                telemetry_slab_lag(
+                    collect_us=meta.collect_us, ring_wait_us=ring_wait_us, train_us=train_us
+                )
             if update == start_update:
                 telemetry_register_flops(
                     train_fn, params, opt_state, flat, train_key, np.float32(clip_coef), np.float32(ent_coef)
@@ -433,6 +486,7 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
                     os.kill(os.getpid(), signal.SIGTERM)
             if not stall_until:
                 lane.publish(np.asarray(streamer.begin(params)), version)
+                trace_event("param_publish", version=version)
                 published_version = version
             admitted += 1
 

@@ -36,9 +36,24 @@ import numpy as np
 
 from sheeprl_tpu.rollout.shm import attach_untracked, create_untracked, unregister_owned_segment
 
-# header word indices
-STATE, SEQ, PARAM_VERSION, ACTOR_ID, N_ROWS, COLLECT_US, ENV_STEPS, CHECKSUM = range(8)
-HEADER_WORDS = 8
+# header word indices — TRACE_ID/COMMIT_T_US are trace-plane context
+# (sheeprl_tpu.obs.trace): the slab's cross-process causal id and the epoch-µs
+# stamp taken just before commit, read back at learner admission to measure
+# the commit→admit ring wait. They sit BEFORE CHECKSUM so the meta checksum
+# slice (SEQ..COMMIT_T_US) covers them.
+(
+    STATE,
+    SEQ,
+    PARAM_VERSION,
+    ACTOR_ID,
+    N_ROWS,
+    COLLECT_US,
+    ENV_STEPS,
+    TRACE_ID,
+    COMMIT_T_US,
+    CHECKSUM,
+) = range(10)
+HEADER_WORDS = 10
 _HEADER_BYTES = HEADER_WORDS * 8
 
 # slot states
@@ -67,6 +82,8 @@ class SlabMeta:
     n_rows: int
     collect_us: int
     env_steps: int
+    trace_id: int = 0
+    commit_t_us: int = 0
 
 
 @dataclass
@@ -149,6 +166,10 @@ class TrajectoryRing:
         if self._owner:
             self._hdr[...] = 0  # all slots FREE
         self.torn_detected = 0  # reader-side: COMMITTED with a bad checksum
+        # trace ids of torn slabs (poll mismatch + reclaim sweep), drained by
+        # the learner into `torn` trace events so a victim's causal chain
+        # terminates visibly on the merged timeline
+        self.torn_trace_ids: List[int] = []
 
     # ------------------------------------------------------------------ wire
     def spec(self) -> RingSpec:
@@ -179,6 +200,8 @@ class TrajectoryRing:
         n_rows: int,
         collect_us: int,
         env_steps: int,
+        trace_id: int = 0,
+        commit_t_us: int = 0,
     ) -> None:
         """Meta + checksum; the slot is still ``WRITING`` after this — a death
         here is exactly the torn write the reader must skip."""
@@ -189,6 +212,8 @@ class TrajectoryRing:
         hdr[N_ROWS] = n_rows
         hdr[COLLECT_US] = collect_us
         hdr[ENV_STEPS] = env_steps
+        hdr[TRACE_ID] = trace_id
+        hdr[COMMIT_T_US] = commit_t_us
         hdr[CHECKSUM] = _checksum(hdr[SEQ:CHECKSUM])
 
     def commit(self, slot: int) -> None:
@@ -207,6 +232,12 @@ class TrajectoryRing:
             return None
         if int(hdr[CHECKSUM]) != _checksum(hdr[SEQ:CHECKSUM]):
             self.torn_detected += 1
+            # best-effort victim attribution: the checksum failed, so the
+            # trace-id word may be stale — a nonzero value still names the
+            # newest trace that touched this slot
+            tid = int(hdr[TRACE_ID])
+            if tid:
+                self.torn_trace_ids.append(tid)
             hdr[STATE] = FREE
             return None
         return SlabMeta(
@@ -217,6 +248,8 @@ class TrajectoryRing:
             n_rows=int(hdr[N_ROWS]),
             collect_us=int(hdr[COLLECT_US]),
             env_steps=int(hdr[ENV_STEPS]),
+            trace_id=int(hdr[TRACE_ID]),
+            commit_t_us=int(hdr[COMMIT_T_US]),
         )
 
     def release(self, slot: int) -> None:
@@ -232,8 +265,22 @@ class TrajectoryRing:
             state = int(self._hdr[slot, STATE])
             if state == WRITING:
                 torn += 1
+                # crash-mid-write: if the meta words (incl. TRACE_ID) landed
+                # before the death, the checksum matches and the trace id is
+                # trustworthy — capture it so the torn trace terminates
+                # attributed instead of dangling
+                hdr = self._hdr[slot]
+                tid = int(hdr[TRACE_ID])
+                if tid and int(hdr[CHECKSUM]) == _checksum(hdr[SEQ:CHECKSUM]):
+                    self.torn_trace_ids.append(tid)
                 self._hdr[slot, STATE] = FREE
         return torn
+
+    def drain_torn_trace_ids(self) -> List[int]:
+        """Hand the accumulated torn-slab trace ids to the caller (learner)
+        exactly once each."""
+        ids, self.torn_trace_ids = self.torn_trace_ids, []
+        return ids
 
     def occupancy(self) -> float:
         """Fraction of slots holding a committed, unconsumed slab."""

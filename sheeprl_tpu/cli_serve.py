@@ -110,6 +110,9 @@ def serving(args: Optional[List[str]] = None) -> None:
     serve_cfg = serve_config_from_cfg(cfg)
     run_dir = os.path.dirname(cfg_path)
     configure_telemetry(cfg, log_dir=run_dir)
+    from sheeprl_tpu.obs import set_trace_role
+
+    set_trace_role("serve")  # trace-plane handshake carries the serving role
 
     state = load_checkpoint(ckpt_path)
     policy = build_served_policy(cfg, state)

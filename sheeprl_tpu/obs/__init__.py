@@ -32,6 +32,7 @@ from sheeprl_tpu.obs.telemetry import (
     shutdown_telemetry,
     telemetry_actor_restart,
     telemetry_advance,
+    telemetry_child_file,
     telemetry_ckpt_commit,
     telemetry_ckpt_skipped,
     telemetry_crash_checkpoint,
@@ -44,31 +45,54 @@ from sheeprl_tpu.obs.telemetry import (
     telemetry_nan_rollback,
     telemetry_preemption,
     telemetry_register_flops,
+    telemetry_request_path,
     telemetry_resume_fallback,
     telemetry_run_metrics,
     telemetry_serve_event,
     telemetry_serve_stats,
     telemetry_slab,
+    telemetry_slab_lag,
     telemetry_torn_slabs,
     telemetry_train_window,
     telemetry_worker_restart,
+)
+from sheeprl_tpu.obs.trace import (
+    TraceRecorder,
+    active_trace_ids,
+    clock_offset,
+    configure_trace,
+    get_trace,
+    new_trace_id,
+    set_trace_role,
+    shutdown_trace,
+    trace_event,
+    tracing_active,
 )
 
 __all__ = [
     "RunTelemetry",
     "TimerError",
+    "TraceRecorder",
     "TriggeredProfiler",
+    "active_trace_ids",
     "append_run_record",
     "build_run_record",
+    "clock_offset",
     "configure_telemetry",
+    "configure_trace",
     "get_telemetry",
+    "get_trace",
     "log_sps_and_heartbeat",
+    "new_trace_id",
     "read_run_records",
     "register_run",
+    "set_trace_role",
     "shutdown_telemetry",
+    "shutdown_trace",
     "span",
     "telemetry_actor_restart",
     "telemetry_advance",
+    "telemetry_child_file",
     "telemetry_ckpt_commit",
     "telemetry_ckpt_skipped",
     "telemetry_crash_checkpoint",
@@ -81,12 +105,16 @@ __all__ = [
     "telemetry_nan_rollback",
     "telemetry_preemption",
     "telemetry_register_flops",
+    "telemetry_request_path",
     "telemetry_resume_fallback",
     "telemetry_run_metrics",
     "telemetry_serve_event",
     "telemetry_serve_stats",
     "telemetry_slab",
+    "telemetry_slab_lag",
     "telemetry_torn_slabs",
     "telemetry_train_window",
     "telemetry_worker_restart",
+    "trace_event",
+    "tracing_active",
 ]

@@ -53,6 +53,16 @@ _FLUSH_EVERY_SECONDS = 5.0
 # intervals the window never fills; a runaway loop degrades to "first N"
 _ENV_STEP_RESERVOIR = 8192
 _FLIGHTREC_EVENTS = 256
+_TRACE_PATH_RESERVOIR = 8192
+
+
+def _pct(values: list, q: float) -> Optional[float]:
+    """Nearest-rank percentile over an unsorted sample (None when empty)."""
+    if not values:
+        return None
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
+    return float(ordered[idx])
 
 _active_telemetry: Optional["RunTelemetry"] = None
 
@@ -98,13 +108,16 @@ class TelemetryWriter:
     def _flush_locked(self) -> None:
         if self._buf:
             data = "\n".join(self._buf) + "\n"
+            # rotate BEFORE a write that would cross the cap (not after): the
+            # newest events — run_end, a crash's final flush — always land in
+            # the CURRENT segment, never stranded at the tail of ``.1``
+            if self.max_bytes > 0 and self._bytes > 0 and self._bytes + len(data) >= self.max_bytes:
+                self._rotate_locked()
             self._fh.write(data)
             self._buf.clear()
             self._bytes += len(data)
         self._fh.flush()
         self._last_flush = time.time()
-        if self.max_bytes > 0 and self._bytes >= self.max_bytes:
-            self._rotate_locked()
 
     def _rotate_locked(self) -> None:
         self._fh.close()
@@ -216,6 +229,18 @@ class RunTelemetry:
         # serve_stats snapshot; supervision/swap events are counted by kind
         self._serve_last_stats: Optional[Dict[str, Any]] = None
         self._serve_events: Dict[str, int] = {}
+        # trace-plane critical-path reservoirs (sheeprl_tpu.obs.trace): per-
+        # slab lag decomposition (collect -> ring-wait -> train, µs) and
+        # per-request latency decomposition (queue-wait -> batch-assembly ->
+        # compute, ms) — rolled up to p50/p95 in run_end/run_summary
+        self._slab_lags: list = []
+        self._req_paths: list = []
+        self._req_hedged = 0
+        self._req_rerouted = 0
+        # telemetry files of CHILD processes (actor trace recorders): the
+        # learner declares them so the registry record names the run's full
+        # file set and the trace merger never has to glob
+        self._child_files: list = []
         # run-registry rollup: cumulative heartbeat-window sums (run-average
         # SPS/duty cycle survive the per-window resets above) + the latest
         # aggregator scalars (final losses/returns for the run record)
@@ -434,12 +459,21 @@ class RunTelemetry:
         ring, path = self._flightrec, self.flightrec_path
         if ring is None or path is None:
             return None
+        from sheeprl_tpu.obs.trace import active_trace_ids, clock_offset, current_role
+
         payload = {
             "schema": 1,
             "trigger": trigger,
             "t": time.time(),
             "step": self.step,
             "process_index": self.process_index,
+            # process identity + active trace ids: a crash dump is an orphan
+            # artifact until the merger can place it on one process's track
+            # of the cross-process timeline (tools/trace.py)
+            "role": current_role(),
+            "pid": os.getpid(),
+            "clock_offset": clock_offset(),
+            "active_traces": active_trace_ids(),
             "ring_capacity": ring.maxlen,
             "events": list(ring),
         }
@@ -482,6 +516,86 @@ class RunTelemetry:
         if fleet:
             section["fleet"] = fleet
         return section
+
+    # -- trace-plane rollups -------------------------------------------------
+
+    def record_child_file(self, path: str) -> None:
+        """Declare a child process's telemetry/trace file (actor trace
+        recorders): the path lands in ``run_summary()['telemetry_files']`` so
+        the collector locates the run's full file set without globbing."""
+        p = str(path)
+        if p not in self._child_files:
+            self._child_files.append(p)
+
+    def record_slab_lag(self, *, collect_us: int, ring_wait_us: int, train_us: int) -> None:
+        """One admitted slab's critical-path decomposition, in microseconds:
+        actor collect wall time, commit→admission ring wait (epoch-aligned
+        via the slab header's commit stamp), and the learner train window.
+        Reservoir-sampled; rolled up as slab-age p50/p95 at run end."""
+        if len(self._slab_lags) < _TRACE_PATH_RESERVOIR:
+            self._slab_lags.append((int(collect_us), int(ring_wait_us), int(train_us)))
+
+    def record_request_path(
+        self,
+        *,
+        queue_wait_ms: float,
+        assembly_ms: float,
+        compute_ms: float,
+        hedged: bool = False,
+        rerouted: bool = False,
+    ) -> None:
+        """One completed request's critical-path decomposition, in
+        milliseconds: enqueue→dispatch queue wait, batch assembly (staging),
+        and compute. Hedged/re-routed requests are counted so the rollup can
+        attribute fault/hedge overhead."""
+        if len(self._req_paths) < _TRACE_PATH_RESERVOIR:
+            self._req_paths.append((float(queue_wait_ms), float(assembly_ms), float(compute_ms)))
+        if hedged:
+            self._req_hedged += 1
+        if rerouted:
+            self._req_rerouted += 1
+
+    def _slab_lag_section(self) -> Dict[str, Any]:
+        rows = self._slab_lags
+        if not rows:
+            return {}
+        ages = [(c + r + t) / 1e3 for c, r, t in rows]
+        collect = [c / 1e3 for c, _, _ in rows]
+        ring_wait = [r / 1e3 for _, r, _ in rows]
+        train = [t / 1e3 for _, _, t in rows]
+        return {
+            "samples": len(rows),
+            "age_p50_ms": _pct(ages, 0.50),
+            "age_p95_ms": _pct(ages, 0.95),
+            "collect_p50_ms": _pct(collect, 0.50),
+            "collect_p95_ms": _pct(collect, 0.95),
+            "ring_wait_p50_ms": _pct(ring_wait, 0.50),
+            "ring_wait_p95_ms": _pct(ring_wait, 0.95),
+            "train_p50_ms": _pct(train, 0.50),
+            "train_p95_ms": _pct(train, 0.95),
+        }
+
+    def _request_path_section(self) -> Dict[str, Any]:
+        rows = self._req_paths
+        if not rows and not (self._req_hedged or self._req_rerouted):
+            return {}
+        totals = [q + a + c for q, a, c in rows]
+        queue = [q for q, _, _ in rows]
+        assembly = [a for _, a, _ in rows]
+        compute = [c for _, _, c in rows]
+        return {
+            "samples": len(rows),
+            "p50_ms": _pct(totals, 0.50),
+            "p95_ms": _pct(totals, 0.95),
+            "queue_wait_p50_ms": _pct(queue, 0.50),
+            "queue_wait_p95_ms": _pct(queue, 0.95),
+            "assembly_p50_ms": _pct(assembly, 0.50),
+            "assembly_p95_ms": _pct(assembly, 0.95),
+            "compute_p50_ms": _pct(compute, 0.50),
+            "compute_p95_ms": _pct(compute, 0.95),
+            "hedged": self._req_hedged,
+            "rerouted": self._req_rerouted,
+        }
 
     def record_resume_fallback(self, path: str, error: str, **fields: Any) -> None:
         """``resume_from=auto`` rejected a candidate checkpoint (load failure
@@ -781,8 +895,18 @@ class RunTelemetry:
             summary["profile_captures"] = [dict(c) for c in captures]
         if self._final_metrics:
             summary["final_metrics"] = dict(self._final_metrics)
+        slab_lag = self._slab_lag_section()
+        if slab_lag:
+            summary["slab_lag"] = slab_lag
+        req_path = self._request_path_section()
+        if req_path:
+            summary["request_critical_path"] = req_path
         summary["telemetry_jsonl"] = self.writer.path
         summary["telemetry_segments"] = [os.path.basename(p) for p in self.writer.segments()]
+        # the run's FULL per-process file set (this process's segments,
+        # oldest first, plus declared child trace files) — the trace
+        # collector reads this instead of globbing the log dir
+        summary["telemetry_files"] = list(self.writer.segments()) + list(self._child_files)
         return summary
 
     # -- lifecycle -----------------------------------------------------------
@@ -790,6 +914,18 @@ class RunTelemetry:
     def start(self, run_info: Optional[Mapping[str, Any]] = None) -> None:
         self.watchdog.start()
         self.emit("run_start", **dict(run_info or {}))
+        # trace handshake at spawn: role/pid + the monotonic→epoch clock
+        # offset the cross-process merger (tools/trace.py) aligns this
+        # stream's t_mono stamps with
+        from sheeprl_tpu.obs.trace import clock_offset, current_role
+
+        self.emit(
+            "trace_handshake",
+            role=current_role(),
+            pid=os.getpid(),
+            clock_offset=clock_offset(),
+            t_mono=time.monotonic(),
+        )
         self.maybe_poll_devices(force=True)
 
     def close(self) -> None:
@@ -797,14 +933,22 @@ class RunTelemetry:
             # stop a capture straddling run end so the trace file is complete
             # BEFORE run_end reports it
             self.profile_captures = self.profiler.finish()
-        serve_fields: Dict[str, Any] = {}
+        extra_fields: Dict[str, Any] = {}
         # only serving runs grow a `serve` section: training-run run_end
         # consumers keep seeing exactly the fields they already parse
         if self._serve_last_stats is not None or self._serve_events:
-            serve_fields["serve"] = self._serve_section()
+            extra_fields["serve"] = self._serve_section()
+        # same for the trace-plane critical-path rollups: only runs that
+        # recorded slab/request decompositions carry them
+        slab_lag = self._slab_lag_section()
+        if slab_lag:
+            extra_fields["slab_lag"] = slab_lag
+        req_path = self._request_path_section()
+        if req_path:
+            extra_fields["request_critical_path"] = req_path
         self.emit(
             "run_end",
-            **serve_fields,
+            **extra_fields,
             compiles_total=self.watchdog.compiles,
             recompiles=self.watchdog.recompiles,
             device_polls=self._device_polls,
@@ -1075,6 +1219,44 @@ def telemetry_serve_event(kind: str, **fields: Any) -> None:
     tel = _active_telemetry
     if tel is not None:
         tel.record_serve_event(kind, **fields)
+
+
+def telemetry_child_file(path: str) -> None:
+    """Declare a child process's telemetry/trace file for the registry
+    record (see :meth:`RunTelemetry.record_child_file`); no-op when
+    telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_child_file(path)
+
+
+def telemetry_slab_lag(*, collect_us: int, ring_wait_us: int, train_us: int) -> None:
+    """Record one admitted slab's critical-path decomposition (see
+    :meth:`RunTelemetry.record_slab_lag`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_slab_lag(collect_us=collect_us, ring_wait_us=ring_wait_us, train_us=train_us)
+
+
+def telemetry_request_path(
+    *,
+    queue_wait_ms: float,
+    assembly_ms: float,
+    compute_ms: float,
+    hedged: bool = False,
+    rerouted: bool = False,
+) -> None:
+    """Record one completed request's critical-path decomposition (see
+    :meth:`RunTelemetry.record_request_path`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_request_path(
+            queue_wait_ms=queue_wait_ms,
+            assembly_ms=assembly_ms,
+            compute_ms=compute_ms,
+            hedged=hedged,
+            rerouted=rerouted,
+        )
 
 
 def telemetry_register_flops(jitted_fn: Any, *args: Any, scale: float = 1.0) -> None:

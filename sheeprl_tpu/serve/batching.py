@@ -37,7 +37,7 @@ _REQUEST_IDS = itertools.count()
 class Request:
     """One in-flight inference request: observation + deadline + Future."""
 
-    __slots__ = ("obs", "enqueue_t", "deadline_t", "future", "rid", "attempts")
+    __slots__ = ("obs", "enqueue_t", "deadline_t", "future", "rid", "attempts", "trace_id", "t_dispatch")
 
     def __init__(self, obs: Any, enqueue_t: float, deadline_t: float) -> None:
         self.obs = obs
@@ -46,6 +46,13 @@ class Request:
         self.future: Future = Future()
         self.rid = next(_REQUEST_IDS)
         self.attempts = 0  # inference attempts (re-queues after replica failures)
+        # trace-plane context (sheeprl_tpu.obs.trace): the cross-process
+        # causal id minted at router admission (0 = untraced) and the
+        # monotonic first-dispatch stamp — they live on the SHARED request
+        # object, which is what lets one causal chain survive hedging,
+        # re-route-at-front and requeue (every copy is the same object)
+        self.trace_id = 0
+        self.t_dispatch: Optional[float] = None
 
     def expired(self, now: float) -> bool:
         return now >= self.deadline_t

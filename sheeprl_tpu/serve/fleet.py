@@ -43,6 +43,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from sheeprl_tpu.obs.telemetry import telemetry_request_path
+from sheeprl_tpu.obs.trace import trace_event
 from sheeprl_tpu.resilience.manifest import CommittedCheckpoint, read_manifest
 from sheeprl_tpu.rollout.supervisor import RestartBudget
 from sheeprl_tpu.serve.config import ServeConfig
@@ -149,7 +151,9 @@ class FleetReplica(threading.Thread):
             params = self._params_for()
             rung = self.ladder.rung_for(len(batch))
             staged = self.pool.staged_batch(batch, rung)
+            t_staged = time.monotonic()
             outputs = self.ladder.run_staged(params, staged, rung, len(batch))
+            t_done = time.monotonic()
         except Exception as err:
             self.stats.failures += 1
             self.stats.consecutive_failures += 1
@@ -179,7 +183,36 @@ class FleetReplica(threading.Thread):
                     except Exception:
                         pass
             else:
-                safe_complete(req, out)
+                delivered = safe_complete(req, out)
+                if delivered and req.trace_id:
+                    # critical-path decomposition, measured at the replica
+                    # that actually delivered the result: queue-wait is
+                    # admission→this batch's start, assembly is the staging
+                    # row-gather + params placement, compute is the dispatch
+                    queue_wait_ms = (t0 - req.enqueue_t) * 1e3
+                    assembly_ms = (t_staged - t0) * 1e3
+                    compute_ms = (t_done - t_staged) * 1e3
+                    hedged = len(getattr(req, "placements", ())) > 1
+                    rerouted = getattr(req, "rerouted", 0) > 0
+                    trace_event(
+                        "request_done",
+                        req.trace_id,
+                        rid=req.rid,
+                        replica=self.index,
+                        batch=len(batch),
+                        queue_wait_ms=queue_wait_ms,
+                        assembly_ms=assembly_ms,
+                        compute_ms=compute_ms,
+                        hedged=hedged,
+                        rerouted=rerouted,
+                    )
+                    telemetry_request_path(
+                        queue_wait_ms=queue_wait_ms,
+                        assembly_ms=assembly_ms,
+                        compute_ms=compute_ms,
+                        hedged=hedged,
+                        rerouted=rerouted,
+                    )
         self.pool.complete_batch(batch)
         if self._on_batch is not None:
             try:
@@ -468,6 +501,7 @@ class FleetServer:
             return False
         slot.thread.kill()
         self._event("replica_killed", {"replica": index})
+        trace_event("replica_killed", replica=index)  # process-scoped (tid 0)
         return True
 
     # ------------------------------------------------------------------- swap

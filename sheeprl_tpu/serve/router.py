@@ -47,6 +47,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence
 
+from sheeprl_tpu.obs.trace import new_trace_id, trace_event, tracing_active
 from sheeprl_tpu.serve.batching import Request
 from sheeprl_tpu.serve.errors import Overloaded, ServerClosed
 from sheeprl_tpu.serve.fault_injection import ServeFaultSchedule
@@ -180,6 +181,24 @@ class Router:
         req = RoutedRequest(
             obs, now, now + float(deadline_s), idempotent=idempotent, priority=priority
         )
+        if tracing_active():
+            # the request id is per-process; the trace id is the cross-process
+            # causal handle — minted once here, it rides the shared request
+            # object through every hedge/re-route/requeue placement. Minted
+            # BEFORE the request enters _inflight: the hedge scan can place
+            # an inflight-but-unplaced request from its own thread, and a
+            # replica may dispatch and deliver that copy immediately — if the
+            # mint raced that window the delivery would see trace_id == 0 and
+            # the chain would dangle without its request_done
+            req.trace_id = new_trace_id()
+            trace_event(
+                "request_admit",
+                req.trace_id,
+                rid=req.rid,
+                priority=req.priority,
+                idempotent=req.idempotent,
+                deadline_ms=float(deadline_s) * 1e3,
+            )
         with self._lock:
             seq = self._route_seq
             self._route_seq += 1
@@ -190,6 +209,8 @@ class Router:
             # blackholed: admitted, tracked, but the assignment is swallowed;
             # the hedge scan is the rescue path for every one of these
             self.blackholed += 1
+            if req.trace_id:
+                trace_event("request_blackholed", req.trace_id, rid=req.rid)
             return req
         self._place(req, now)
         return req
@@ -202,6 +223,15 @@ class Router:
                     req.placements.append(target.index)
                     if target.kind == "cpu_spill":
                         self.spilled += 1
+                    if req.trace_id:
+                        trace_event(
+                            "request_route",
+                            req.trace_id,
+                            rid=req.rid,
+                            replica=target.index,
+                            attempt=len(req.placements),
+                            target_kind=target.kind,
+                        )
                     return True
             except ServerClosed:
                 continue
@@ -280,6 +310,13 @@ class Router:
                 with self._lock:
                     self._inflight.pop(req.rid, None)
                 self.expired += 1
+                if req.trace_id:
+                    trace_event(
+                        "request_expired",
+                        req.trace_id,
+                        rid=req.rid,
+                        waited_ms=(now - req.enqueue_t) * 1e3,
+                    )
                 continue
             if not req.placements and now >= self._blackhole_until:
                 # swallowed by a blackhole (or every pool was full): rescue
@@ -303,6 +340,15 @@ class Router:
                             "placements": list(req.placements),
                         },
                     )
+                    if req.trace_id:
+                        trace_event(
+                            "request_hedge",
+                            req.trace_id,
+                            rid=req.rid,
+                            replica=req.placements[-1],
+                            waited_ms=(now - req.enqueue_t) * 1e3,
+                            threshold_ms=threshold * 1e3,
+                        )
 
     # -------------------------------------------------------------- re-routing
     def reroute(self, index: int, pool: SlotPool, reason: str, *, inflight: str = "all") -> int:
@@ -350,6 +396,18 @@ class Router:
             "reroute",
             {"replica": index, "reason": reason, "requests": len(drained), "moved": moved},
         )
+        # one batched trace event per reroute (not one per request): the
+        # merger expands trace_ids so every victim's chain carries the
+        # re-route attribution without a hot-path write per request
+        tids = [r.trace_id for r in drained if getattr(r, "trace_id", 0)]
+        if tids:
+            trace_event(
+                "request_reroute",
+                replica=index,
+                reason=reason,
+                moved=moved,
+                trace_ids=tids,
+            )
         return moved
 
     def _ranked_targets_any(self) -> List[RouteTarget]:

@@ -43,6 +43,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from sheeprl_tpu.obs.trace import trace_event
 from sheeprl_tpu.serve.batching import Request
 from sheeprl_tpu.serve.errors import ServerClosed
 
@@ -145,6 +146,7 @@ class SlotPool:
         exceptionally here — by their own deadline — and never dispatched."""
         expired: List[Request] = []
         batch: List[Request] = []
+        dropped: List[Request] = []
         with self._cond:
             deadline = self._clock() + wait_timeout_s
             while not self._waiting and not self._closed:
@@ -159,14 +161,26 @@ class SlotPool:
                 req = self._waiting.popleft()
                 if req.future.done():  # hedge twin won, or already expired
                     self._unstage(req)
+                    dropped.append(req)
                     continue
                 (expired if req.expired(now) else batch).append(req)
             for req in expired:
                 self._unstage(req)
             for req in batch:
                 self._inflight[req.rid] = req
+                # first-dispatch stamp for the critical-path decomposition
+                # (queue wait = enqueue → here); a hedge twin keeps the
+                # winner's first stamp, shared via the request object
+                if req.t_dispatch is None:
+                    req.t_dispatch = now
             self._refill_locked()
         now = self._clock()
+        for req in dropped:
+            # a done-skipped request here is a cancelled hedge loser (an
+            # expired one was completed by its winner/expiry path already):
+            # mark the loser's copy on the timeline, outside the lock
+            if req.trace_id and not req.future.exception():
+                trace_event("request_hedge_drop", req.trace_id, rid=req.rid)
         for req in expired:
             req.fail_expired(now)
             if self._on_expired is not None:

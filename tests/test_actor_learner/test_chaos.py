@@ -84,6 +84,56 @@ def test_actor_crash_mid_write_drill(tmp_path, monkeypatch):
     assert rec.get("actor_restarts") == {"0": 1}
     assert find_checkpoints(tmp_path)
 
+    # -- merged end-to-end trace (acceptance): the registry record names
+    # every per-process stream (no globbing), and joining them yields one
+    # causal chain per admitted slab plus a torn-terminated victim chain
+    files = rec["telemetry_files"]
+    assert any(p.endswith("telemetry.jsonl") for p in files)
+    assert any("trace.actor0" in p for p in files)
+    assert all(os.path.isfile(p) for p in files), files
+
+    from tools import trace as trace_tool
+
+    merged = trace_tool.merge(files)
+    roles = {p["role"] for p in merged["processes"]}
+    assert "learner" in roles and any(r.startswith("actor") for r in roles)
+    summary = trace_tool.summarize(merged)
+    slabs = summary["slabs"]
+    # every admitted slab's chain is complete across the process boundary:
+    # collect+commit in the actor child, admit+train in the learner
+    assert slabs["complete_chains"] >= rec["slabs_admitted"]
+    assert slabs["terminals"].get("slab_train", 0) >= 1
+    # the crash victim: its chain keeps the actor-side slab_collect (the
+    # flush-per-event recorder survives os._exit) and terminates at `torn`
+    torn_chains = [
+        evs
+        for evs in merged["traces"].values()
+        if trace_tool.slab_terminal(evs) == "torn"
+    ]
+    assert len(torn_chains) >= 1
+    assert any(
+        trace_tool.trace_kinds(evs)[0] == "slab_collect" for evs in torn_chains
+    )
+    # lag decomposition present for the trained population
+    for key in ("age_ms", "collect_ms", "ring_wait_ms", "train_ms"):
+        assert "p50" in slabs[key] and "p95" in slabs[key]
+
+    # bench.py --trace prints the same decomposition from the jax-free parent
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--trace", *files],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    printed = json.loads(proc.stdout)
+    assert printed["slabs"]["complete_chains"] == slabs["complete_chains"]
+    assert "p95" in printed["slabs"]["age_ms"]
+
 
 def test_actor_hang_drill(tmp_path, monkeypatch):
     """A wedged (non-heartbeating) actor trips the supervision deadline and is

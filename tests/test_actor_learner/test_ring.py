@@ -190,6 +190,90 @@ def test_attach_shares_the_segment():
         ring.close()
 
 
+def test_trace_context_roundtrips_through_header():
+    """The trace-plane header words (TRACE_ID, COMMIT_T_US) survive the
+    write→poll round trip, are covered by the meta checksum, and default to
+    zero for writers that pass no trace context."""
+    layout = small_layout()
+    ring = TrajectoryRing(2, layout.nbytes)
+    try:
+        payload = {"state": np.ones((4, 3), np.float32), "actions": np.zeros((4, 2), np.float32)}
+        tid, commit_us = (1 << 62) + 12345, 1_700_000_000_000_000
+        assert ring.try_begin_write(0)
+        layout.pack_into(ring.payload_view(0), payload)
+        ring.write_meta(
+            0, seq=0, param_version=0, actor_id=0, n_rows=4, collect_us=1,
+            env_steps=4, trace_id=tid, commit_t_us=commit_us,
+        )
+        ring.commit(0)
+        meta = ring.poll(0)
+        assert meta is not None
+        assert meta.trace_id == tid and meta.commit_t_us == commit_us
+        ring.release(0)
+
+        # untraced writers (trace plane off) default both words to zero
+        write_slab(ring, layout, 1, seq=1, payload=payload)
+        meta = ring.poll(1)
+        assert meta is not None and meta.trace_id == 0 and meta.commit_t_us == 0
+        ring.release(1)
+
+        # the checksum slice covers the trace words: corrupting TRACE_ID
+        # after commit is a torn slab, never an admitted one with a bad id
+        assert ring.try_begin_write(0)
+        ring.write_meta(
+            0, seq=2, param_version=0, actor_id=0, n_rows=4, collect_us=1,
+            env_steps=4, trace_id=tid, commit_t_us=commit_us,
+        )
+        ring.commit(0)
+        from sheeprl_tpu.actor_learner.ring import TRACE_ID
+
+        ring._hdr[0, TRACE_ID] += 1
+        assert ring.poll(0) is None and ring.torn_detected == 1
+    finally:
+        ring.close()
+
+
+def test_torn_trace_ids_captured_and_drained_once():
+    """Victim attribution: a torn slab's trace id is captured on both torn
+    paths — poll (checksum mismatch, best-effort) and reclaim (crash after
+    write_meta, checksum-verified) — and drained exactly once."""
+    layout = small_layout()
+    ring = TrajectoryRing(2, layout.nbytes)
+    try:
+        payload = {"state": np.ones((4, 3), np.float32), "actions": np.zeros((4, 2), np.float32)}
+        # path 1: COMMITTED + corrupt meta word → poll captures the id
+        assert ring.try_begin_write(0)
+        layout.pack_into(ring.payload_view(0), payload)
+        ring.write_meta(
+            0, seq=0, param_version=0, actor_id=0, n_rows=4, collect_us=1,
+            env_steps=4, trace_id=101, commit_t_us=1,
+        )
+        ring.commit(0)
+        ring._hdr[0, PARAM_VERSION] += 1
+        assert ring.poll(0) is None
+
+        # path 2: crash between write_meta and commit → reclaim verifies the
+        # checksum before trusting the id
+        assert ring.try_begin_write(1)
+        layout.pack_into(ring.payload_view(1), payload)
+        ring.write_meta(
+            1, seq=1, param_version=0, actor_id=0, n_rows=4, collect_us=1,
+            env_steps=4, trace_id=202, commit_t_us=2,
+        )
+        assert ring.reclaim_actor_slots([1]) == 1
+
+        assert ring.drain_torn_trace_ids() == [101, 202]
+        assert ring.drain_torn_trace_ids() == []  # drained exactly once
+
+        # a crash BEFORE write_meta finished leaves no trustworthy id: the
+        # reclaim sweep must not attribute a stale/garbage word
+        assert ring.try_begin_write(0)
+        assert ring.reclaim_actor_slots([0]) == 1
+        assert ring.drain_torn_trace_ids() == []
+    finally:
+        ring.close()
+
+
 def test_occupancy_counts_committed_only():
     layout = small_layout()
     ring = TrajectoryRing(4, layout.nbytes)

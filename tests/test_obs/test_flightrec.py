@@ -65,6 +65,25 @@ def test_abnormal_exit_paths_dump_with_trigger_event_last(telemetry, tmp_path):
     assert dump["events"][-2]["event"] == "nan_rollback"
 
 
+def test_dump_carries_process_identity_and_active_traces(telemetry, tmp_path):
+    """A crash artifact must be placeable on the merged timeline: the dump
+    names who wrote it (role, pid, clock offset) and which causal chains were
+    in flight when the process died."""
+    from sheeprl_tpu.obs.trace import new_trace_id, set_trace_role, trace_event
+
+    set_trace_role("learner")
+    tids = [new_trace_id() for _ in range(3)]
+    for tid in tids:
+        trace_event("slab_admit", tid, ring_wait_us=10)
+    path = telemetry_dump_flight_record("manual")
+    with open(path) as f:
+        dump = json.load(f)
+    assert dump["role"] == "learner"
+    assert dump["pid"] == os.getpid()
+    assert isinstance(dump["clock_offset"], float)
+    assert dump["active_traces"] == tids  # newest last, ids intact
+
+
 def test_ring_disabled(tmp_path):
     cfg = {"metric": {"telemetry": {"enabled": True, "poll_interval": 0.0, "flightrec_events": 0}}}
     tel = configure_telemetry(cfg, log_dir=str(tmp_path))

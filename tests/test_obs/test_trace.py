@@ -1,0 +1,244 @@
+"""Trace plane (sheeprl_tpu.obs.trace) end-to-end with the merger
+(tools/trace.py): recorder durability, the telemetry-attached sink,
+cross-process joins over real stream files, rotated-segment merges, and the
+hedged-request id contract on a real Router + SlotPool pair."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.obs.telemetry import configure_telemetry, shutdown_telemetry
+from sheeprl_tpu.obs.trace import (
+    TraceRecorder,
+    clock_offset,
+    configure_trace,
+    get_trace,
+    new_trace_id,
+    set_trace_role,
+    shutdown_trace,
+    trace_event,
+    tracing_active,
+)
+from tools import trace as trace_tool
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace_state():
+    """Every test starts and ends with no recorder and no telemetry — the
+    trace plane's module state is per-process."""
+    shutdown_trace()
+    yield
+    shutdown_trace()
+    shutdown_telemetry()
+
+
+def read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ------------------------------------------------------------- recorder ----
+
+
+def test_recorder_flushes_every_event_without_close(tmp_path):
+    """The standalone sink is crash-durable: handshake + every event are on
+    disk immediately (actor children die via os._exit on the drills)."""
+    path = str(tmp_path / "trace.actor0.jsonl")
+    rec = configure_trace("actor0", path, actor=0)
+    assert tracing_active() and get_trace() is rec
+    tid = new_trace_id()
+    trace_event("slab_collect", tid, seq=0, collect_us=1234)
+    trace_event("slab_commit", tid, seq=0)
+
+    # NOT closed — read what an os._exit would leave behind
+    events = read_jsonl(path)
+    assert [e["event"] for e in events] == ["trace_handshake", "trace", "trace"]
+    hs = events[0]
+    assert hs["role"] == "actor0" and hs["actor"] == 0
+    assert isinstance(hs["pid"], int) and "clock_offset" in hs
+    assert abs(hs["clock_offset"] - clock_offset()) < 1.0
+    for ev in events[1:]:
+        assert ev["trace_id"] == tid and "t" in ev and "t_mono" in ev
+    assert rec.active_trace_ids() == [tid, tid]
+
+    # role rename re-handshakes on the same stream; the merger keeps the newest
+    set_trace_role("actor0-restarted")
+    events = read_jsonl(path)
+    assert events[-1]["event"] == "trace_handshake"
+    assert events[-1]["role"] == "actor0-restarted"
+
+
+def test_new_trace_id_nonzero_63bit_and_distinct():
+    ids = {new_trace_id() for _ in range(64)}
+    assert len(ids) == 64
+    for tid in ids:
+        assert 0 < tid < (1 << 63)
+
+
+def test_clock_offset_aligns_monotonic_to_epoch():
+    off = clock_offset()
+    assert abs((time.monotonic() + off) - time.time()) < 0.5
+
+
+def test_trace_event_is_noop_without_any_sink(tmp_path):
+    assert not tracing_active()
+    trace_event("slab_collect", new_trace_id())  # must not raise
+    assert list(tmp_path.iterdir()) == []
+
+
+# ---------------------------------------------------- telemetry-attached ----
+
+
+def test_telemetry_sink_handshakes_lazily_and_on_role_change(tmp_path):
+    cfg = {"metric": {"telemetry": {"enabled": True, "poll_interval": 0.0}}}
+    tel = configure_telemetry(cfg, log_dir=str(tmp_path))
+    assert tracing_active() and get_trace() is None  # telemetry sink, no recorder
+    tid = new_trace_id()
+    trace_event("slab_admit", tid, ring_wait_us=500)
+    set_trace_role("learner")  # re-handshake: the merger keeps the newest role
+    trace_event("slab_train", tid, train_us=900)
+    tel.writer.flush()
+
+    events = read_jsonl(tmp_path / "telemetry.jsonl")
+    handshakes = [e for e in events if e["event"] == "trace_handshake"]
+    traces = [e for e in events if e["event"] == "trace"]
+    assert len(handshakes) >= 2 and handshakes[-1]["role"] == "learner"
+    assert [e["kind"] for e in traces] == ["slab_admit", "slab_train"]
+    for ev in traces:
+        assert ev["trace_id"] == tid and "t_mono" in ev
+        assert "step" in ev and "process_index" in ev  # telemetry's own stamps
+
+    # the merger reads the telemetry stream directly — handshake applies
+    merged = trace_tool.merge([str(tmp_path / "telemetry.jsonl")])
+    assert trace_tool.trace_kinds(merged["traces"][tid]) == ["slab_admit", "slab_train"]
+    assert merged["processes"][0]["role"] == "learner"
+
+
+# --------------------------------------------------- cross-process joins ----
+
+
+def test_merge_joins_real_recorder_streams(tmp_path):
+    """2 actors + learner, real stream files: one causal chain per slab,
+    ordered by aligned time, terminals classified per trace."""
+    t_ok, t_torn = new_trace_id(), new_trace_id()
+    a0 = TraceRecorder("actor0", str(tmp_path / "trace.actor0.jsonl"))
+    a0.emit("slab_collect", t_ok, seq=0, collect_us=4000)
+    a0.emit("slab_commit", t_ok, seq=0)
+    a0.close()
+    a1 = TraceRecorder("actor1", str(tmp_path / "trace.actor1.jsonl"))
+    a1.emit("slab_collect", t_torn, seq=0, collect_us=5000)
+    # actor1 "dies" mid-write: no slab_commit ever lands
+    a1.close()
+    lrn = TraceRecorder("learner", str(tmp_path / "telemetry.jsonl"))
+    lrn.emit("slab_admit", t_ok, ring_wait_us=2000)
+    lrn.emit("slab_train", t_ok, train_us=3000)
+    lrn.emit("torn", t_torn, source="ring")
+    lrn.close()
+
+    merged = trace_tool.merge(
+        [str(tmp_path / p) for p in ("telemetry.jsonl", "trace.actor0.jsonl", "trace.actor1.jsonl")]
+    )
+    assert {p["role"] for p in merged["processes"]} == {"actor0", "actor1", "learner"}
+    assert trace_tool.trace_kinds(merged["traces"][t_ok]) == [
+        "slab_collect",
+        "slab_commit",
+        "slab_admit",
+        "slab_train",
+    ]
+    # the torn victim keeps its actor-side half and terminates at `torn`
+    assert trace_tool.trace_kinds(merged["traces"][t_torn]) == ["slab_collect", "torn"]
+
+    summary = trace_tool.summarize(merged)
+    assert summary["slabs"]["traces"] == 2
+    assert summary["slabs"]["complete_chains"] == 1
+    assert summary["slabs"]["terminals"] == {"slab_train": 1, "torn": 1}
+    assert summary["slabs"]["age_ms"]["p50"] == pytest.approx(9.0)
+
+
+def test_merge_reads_rotated_telemetry_segments(tmp_path):
+    """A rotated stream contributes BOTH segments (oldest first) — the bug
+    class where `.1` silently vanishes from analysis."""
+    cfg = {"metric": {"telemetry": {"enabled": True, "poll_interval": 0.0, "max_bytes": 1500}}}
+    tel = configure_telemetry(cfg, log_dir=str(tmp_path))
+    tids = []
+    while tel.writer.rotations < 1 and len(tids) < 200:
+        tid = new_trace_id()
+        tids.append(tid)
+        trace_event("unit_mark", tid, pad="x" * 64)
+        tel.writer.flush()
+    # a couple more so both segments hold trace events
+    for _ in range(3):
+        tid = new_trace_id()
+        tids.append(tid)
+        trace_event("unit_mark", tid, pad="x" * 64)
+    tel.writer.flush()
+    assert tel.writer.rotations >= 1
+
+    base = str(tmp_path / "telemetry.jsonl")
+    assert trace_tool.segments(base) == [base + ".1", base]
+    survivors = set()
+    for seg in trace_tool.segments(base):
+        for e in read_jsonl(seg):
+            if e.get("event") == "trace":
+                survivors.add(e["trace_id"])
+    assert len(survivors) > 3  # events on disk straddle the rotation boundary
+
+    merged = trace_tool.merge([base])  # base path only: .1 auto-included
+    assert set(merged["traces"]) == survivors
+    assert {p["stream"] for p in merged["processes"]} == set(trace_tool.segments(base))
+
+
+# ---------------------------------------------------------- hedge dedup ----
+
+
+def test_hedged_request_keeps_one_trace_id_across_twins(tmp_path):
+    """The trace id lives on the SHARED Request object: the hedge twin, the
+    loser's dropped copy and the winner all carry the same id, so the merged
+    trace is one causal chain with hedge + drop marked exactly once."""
+    from sheeprl_tpu.serve.router import Router
+    from sheeprl_tpu.serve.slots import SlotPool, safe_complete
+
+    configure_trace("serve", str(tmp_path / "trace.serve.jsonl"))
+    try:
+        pools = [SlotPool(capacity=4, backlog_bound=64) for _ in range(2)]
+        from sheeprl_tpu.serve.router import RouteTarget
+
+        router = Router(
+            targets=lambda: [RouteTarget(i, p, 1.0, "device") for i, p in enumerate(pools)],
+            max_pending=100,
+            slo_s=0.02,  # few samples -> hedge threshold = max(floor, slo)
+            hedge_scan_s=0.002,
+        ).start()
+        try:
+            req = router.submit(np.float32(7.0), 60.0)
+            assert req.trace_id != 0
+            deadline = time.monotonic() + 5.0
+            while req.hedges < 1 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert req.hedges == 1 and req.placements == [0, 1]
+            batch = pools[1].take_batch(1.0)
+            assert [r.rid for r in batch] == [req.rid]
+            assert safe_complete(batch[0], "served-by-1")
+            pools[1].complete_batch(batch)
+            assert req.future.result(timeout=1.0) == "served-by-1"
+            assert pools[0].take_batch(0.05) == []  # loser's copy dropped here
+        finally:
+            router.close()
+    finally:
+        shutdown_trace()
+
+    merged = trace_tool.merge([str(tmp_path / "trace.serve.jsonl")])
+    assert list(merged["traces"]) == [req.trace_id]  # ONE chain, no twin id
+    kinds = trace_tool.trace_kinds(merged["traces"][req.trace_id])
+    assert kinds[0] == "request_admit"
+    assert kinds.count("request_hedge") == 1
+    assert kinds.count("request_hedge_drop") == 1
+    routes = [e for e in merged["traces"][req.trace_id] if e["kind"] == "request_route"]
+    assert [e["replica"] for e in routes] == [0, 1]
+
+    summary = trace_tool.summarize(merged)
+    assert summary["requests"]["hedged"] == 1
+    assert summary["requests"]["hedge_drops"] == 1
+    assert "hedge_winner_dupes" not in summary["requests"]

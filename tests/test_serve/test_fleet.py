@@ -6,6 +6,10 @@ batch-priority traffic, elastic scale up/down — and the slow chaos ramp:
 kill a replica mid-ramp on a 4-replica fleet and hold the SLO on survivors.
 """
 
+import json
+import os
+import subprocess
+import sys
 import threading
 import time
 
@@ -18,6 +22,8 @@ from sheeprl_tpu.serve.errors import Overloaded
 from .conftest import expected_action, linear_obs
 
 pytestmark = [pytest.mark.serve, pytest.mark.fleet]
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _wait_until(predicate, timeout_s=5.0, interval_s=0.005):
@@ -73,38 +79,105 @@ def test_fleet_admission_bound_sheds_typed(make_fleet):
     assert server.router.shed == shed
 
 
-def test_kill_replica_mid_burst_zero_dropped(make_fleet):
+def test_kill_replica_mid_burst_zero_dropped(make_fleet, tmp_path):
     """The fast chaos drill: kill a replica while a burst is in flight —
     every admitted request completes (re-route-at-front), the fleet restarts
-    the dead replica, and the survivors keep serving."""
-    server, _, state = make_fleet(
-        fleet={"num_replicas": 2, "max_replicas": 2, "max_pending": 10_000}
+    the dead replica, and the survivors keep serving. Runs under the trace
+    plane: the merged timeline must show one complete causal chain per
+    request, the kill's stranded batch attributed re-routed, and the
+    queue-wait/assembly/compute decomposition via ``bench.py --trace``."""
+    from sheeprl_tpu.obs.trace import configure_trace, shutdown_trace
+
+    trace_path = str(tmp_path / "trace.serve.jsonl")
+    configure_trace("serve", trace_path)
+    try:
+        server, _, state = make_fleet(
+            fleet={"num_replicas": 2, "max_replicas": 2, "max_pending": 10_000},
+            # pin a batch in flight on replica 0 so the kill strands it —
+            # the re-route-at-front path fires deterministically
+            fault_injection={
+                "enabled": True,
+                "faults": [
+                    {"kind": "slow_inference", "replica": 0, "at_batch": 0, "duration_s": 0.15, "for_batches": 3}
+                ],
+            },
+        )
+        server.start()
+        results, errors = [], []
+
+        def client(n):
+            for i in range(n):
+                try:
+                    obs = linear_obs(state, value=float(i % 7))
+                    out = server.infer(obs, deadline_s=10.0)
+                    np.testing.assert_allclose(out, expected_action(state, obs), rtol=1e-5)
+                    results.append(out)
+                except Exception as err:  # noqa: BLE001 — drill collects everything
+                    errors.append(err)
+
+        threads = [threading.Thread(target=client, args=(30,)) for _ in range(4)]
+        for t in threads:
+            t.start()
+        # kill only once replica 0 actually holds a batch — the slow_inference
+        # fault pins it for 0.15s, so the kill lands inside that window and
+        # strands it; a fixed sleep races thread scheduling on loaded boxes
+        assert _wait_until(lambda: len(server.slots[0].pool._inflight) > 0)
+        assert server.kill_replica(0)
+        for t in threads:
+            t.join(20.0)
+        assert not errors and len(results) == 120
+        assert _wait_until(lambda: server.slots[0].alive)  # budgeted restart
+        snap = server.snapshot()
+        assert snap["failed"] == 0 and snap["restarts"] >= 1
+        assert snap["fleet"]["router"]["rerouted_requests"] >= 1  # stranded batch re-placed
+
+        # request_done is emitted by the delivering replica thread right
+        # after the future resolves — give the last few a beat to land
+        def done_count():
+            with open(trace_path) as f:
+                return sum(1 for line in f if '"request_done"' in line)
+
+        assert _wait_until(lambda: done_count() >= 120)
+    finally:
+        shutdown_trace()
+
+    # -- merged end-to-end trace: the drill's acceptance evidence -----------
+    from tools import trace as trace_tool
+
+    merged = trace_tool.merge([trace_path])
+    summary = trace_tool.summarize(merged)
+    req = summary["requests"]
+    assert req["traces"] == 120  # every admitted request minted one chain
+    assert req["terminals"] == {"request_done": 120}  # zero dangling/expired
+    assert req["rerouted"] >= 1  # the kill's victims carry request_reroute
+    assert "hedge_winner_dupes" not in req  # first-completion-wins held
+    for tid, evs in merged["traces"].items():
+        kinds = trace_tool.trace_kinds(evs)
+        assert kinds[0] == "request_admit", (tid, kinds)
+        assert kinds.count("request_done") == 1, (tid, kinds)
+    # the fault victim's chain: re-routed, then done on a survivor
+    victims = [
+        evs for evs in merged["traces"].values()
+        if any(e["kind"] == "request_reroute" for e in evs)
+    ]
+    assert victims
+    for evs in victims:
+        done = [e for e in evs if e["kind"] == "request_done"][0]
+        assert done["rerouted"] is True
+    # the kill itself lands on the untraced (process-scoped) timeline
+    assert any(e["kind"] == "replica_killed" for e in merged["untraced"])
+
+    # bench.py --trace prints the request latency decomposition
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--trace", trace_path],
+        capture_output=True,
+        text=True,
+        timeout=120,
     )
-    server.start()
-    results, errors = [], []
-
-    def client(n):
-        for i in range(n):
-            try:
-                obs = linear_obs(state, value=float(i % 7))
-                out = server.infer(obs, deadline_s=10.0)
-                np.testing.assert_allclose(out, expected_action(state, obs), rtol=1e-5)
-                results.append(out)
-            except Exception as err:  # noqa: BLE001 — drill collects everything
-                errors.append(err)
-
-    threads = [threading.Thread(target=client, args=(30,)) for _ in range(4)]
-    for t in threads:
-        t.start()
-    time.sleep(0.02)
-    assert server.kill_replica(0)
-    for t in threads:
-        t.join(20.0)
-    assert not errors and len(results) == 120
-    assert _wait_until(lambda: server.slots[0].alive)  # budgeted restart
-    snap = server.snapshot()
-    assert snap["failed"] == 0 and snap["restarts"] >= 1
-    assert snap["fleet"]["router"]["rerouted_requests"] >= 0  # counter present
+    assert proc.returncode == 0, proc.stderr
+    printed = json.loads(proc.stdout)
+    for key in ("total_ms", "queue_wait_ms", "assembly_ms", "compute_ms"):
+        assert "p50" in printed["requests"][key] and "p95" in printed["requests"][key]
 
 
 def test_budget_exhaustion_masks_and_fleet_serves_degraded(make_fleet):

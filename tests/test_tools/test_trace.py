@@ -1,0 +1,98 @@
+"""tools/trace.py as a tool: the --self-test gate (tier-1, same contract as
+jaxcheck's), the CLI surface (merge/summary/perfetto) over real fixture
+streams, and registry-driven stream discovery."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools import trace as trace_tool
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_self_test_passes_in_process():
+    assert trace_tool.self_test() == 0
+
+
+def test_self_test_gate_subprocess():
+    """The tier-1 gate the drills rely on: `python -m tools.trace --self-test`
+    exits 0 — the merger's clock-alignment, join, dedup and torn-terminal
+    contracts all hold."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.trace", "--self-test"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "ok" in proc.stdout
+
+
+@pytest.fixture()
+def fixture_streams(tmp_path):
+    """Two on-disk streams carrying one complete slab chain + one torn one."""
+    from sheeprl_tpu.obs.trace import TraceRecorder
+
+    t_ok, t_torn = 7001, 7002
+    actor = TraceRecorder("actor0", str(tmp_path / "trace.actor0.jsonl"))
+    actor.emit("slab_collect", t_ok, collect_us=4000)
+    actor.emit("slab_commit", t_ok)
+    actor.emit("slab_collect", t_torn, collect_us=9000)
+    actor.close()
+    learner = TraceRecorder("learner", str(tmp_path / "telemetry.jsonl"))
+    learner.emit("slab_admit", t_ok, ring_wait_us=2000)
+    learner.emit("slab_train", t_ok, train_us=3000)
+    learner.emit("torn", t_torn, source="ring")
+    learner.close()
+    return [str(tmp_path / "telemetry.jsonl"), str(tmp_path / "trace.actor0.jsonl")]
+
+
+def test_cli_merge_and_summary(fixture_streams, tmp_path, capsys):
+    out = str(tmp_path / "merged.json")
+    assert trace_tool.main(["merge", *fixture_streams, "--out", out]) == 0
+    with open(out) as f:
+        merged = json.load(f)
+    assert set(merged["traces"]) == {"7001", "7002"}  # JSON keys are strings
+    assert {p["role"] for p in merged["processes"]} == {"actor0", "learner"}
+
+    assert trace_tool.main(["summary", *fixture_streams]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["slabs"]["complete_chains"] == 1
+    assert summary["slabs"]["terminals"] == {"slab_train": 1, "torn": 1}
+    assert summary["slabs"]["ring_wait_ms"]["p50"] == pytest.approx(2.0)
+
+
+def test_cli_perfetto_export(fixture_streams, tmp_path):
+    out = str(tmp_path / "perfetto.json")
+    assert trace_tool.main(["perfetto", *fixture_streams, "--out", out]) == 0
+    with open(out) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    tracks = {e["args"]["name"] for e in events if e["ph"] == "M"}
+    assert len(tracks) == 2 and any("actor0" in t for t in tracks)
+    # measured phases become duration slices; the rest are instants
+    spans = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in spans} >= {"slab_collect", "slab_admit", "slab_train"}
+    assert all(e["dur"] > 0 for e in spans)
+
+
+def test_from_registry_resolves_declared_streams(fixture_streams, tmp_path, capsys):
+    """--from-registry uses the newest record's declared telemetry_files —
+    the no-globbing contract with obs.registry."""
+    runs = tmp_path / "RUNS.jsonl"
+    with open(runs, "w") as f:
+        f.write(json.dumps({"run_id": "old"}) + "\n")
+        f.write(json.dumps({"run_id": "new", "telemetry_files": fixture_streams}) + "\n")
+    assert trace_tool.main(["summary", "--from-registry", str(runs)]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["slabs"]["traces"] == 2
+
+    with open(runs, "w") as f:
+        f.write(json.dumps({"run_id": "bare"}) + "\n")
+    with pytest.raises(SystemExit):
+        trace_tool.registry_stream_paths(str(runs))
