@@ -379,6 +379,80 @@ def dispatch_stats(events_or_path) -> dict:
     return out
 
 
+def compile_stats(events_or_path) -> dict:
+    """Compile-economy rollup from a run's telemetry stream: where this
+    process's compiles came from and which cold paths skipped them. Counts
+    lowered variants (total / deliberate-by-reason / post-warm recompiles /
+    aot-load classified), the persistent trace-cache outcomes
+    (``compile_cache`` events, fabric.compilation_cache_dir) and the AOT
+    *executable* cache outcomes (``aot_cache`` events, ops/aotcache.py —
+    hits are whole compiles that never ran). Prefers run_end totals, falls
+    back to counting the event stream for a killed/still-running run."""
+    events = (
+        read_telemetry(events_or_path) if isinstance(events_or_path, str) else list(events_or_path)
+    )
+    compiles = [e for e in events if e.get("event") == "compile" and e.get("phase") == "lower"]
+    out: dict = {
+        "compiles": len(compiles),
+        "recompiles_post_warm": sum(1 for e in compiles if e.get("post_warm")),
+        "aot_load_classified": sum(1 for e in compiles if e.get("aot_load")),
+        "compile_time_s": round(
+            sum(
+                float(e.get("dur", 0.0) or 0.0)
+                for e in events
+                if e.get("event") == "compile"
+            ),
+            3,
+        ),
+    }
+    deliberate: dict = {}
+    for e in compiles:
+        reason = e.get("deliberate")
+        if reason:
+            deliberate[str(reason)] = deliberate.get(str(reason), 0) + 1
+    trace_cache = {
+        "hits": sum(1 for e in events if e.get("event") == "compile_cache" and e.get("hit")),
+        "misses": sum(1 for e in events if e.get("event") == "compile_cache" and not e.get("hit")),
+    }
+    aot: dict = {}
+    aot_tags: dict = {}
+    for e in events:
+        if e.get("event") != "aot_cache":
+            continue
+        action = str(e.get("action", "<unknown>"))
+        aot[action] = aot.get(action, 0) + 1
+        if action == "hit" and e.get("tag"):
+            aot_tags[str(e["tag"])] = aot_tags.get(str(e["tag"]), 0) + 1
+    for e in events:
+        if e.get("event") == "run_end":
+            # run_end totals cover windows the event scan above already saw,
+            # but survive stream rotation truncating early events
+            out["compiles"] = max(out["compiles"], int(e.get("compiles_total", 0) or 0))
+            out["recompiles_post_warm"] = max(
+                out["recompiles_post_warm"], int(e.get("recompiles", 0) or 0)
+            )
+            for reason, n in (e.get("deliberate_compiles") or {}).items():
+                deliberate[str(reason)] = max(deliberate.get(str(reason), 0), int(n))
+            trace_cache["hits"] = max(trace_cache["hits"], int(e.get("compile_cache_hits", 0) or 0))
+            trace_cache["misses"] = max(
+                trace_cache["misses"], int(e.get("compile_cache_misses", 0) or 0)
+            )
+            aot["hit"] = max(aot.get("hit", 0), int(e.get("aot_cache_hits", 0) or 0))
+            aot["miss"] = max(aot.get("miss", 0), int(e.get("aot_cache_misses", 0) or 0))
+            if e.get("aot_loads"):
+                out["aot_loads"] = dict(e["aot_loads"])
+            break
+    if deliberate:
+        out["deliberate_compiles"] = deliberate
+    if trace_cache["hits"] or trace_cache["misses"]:
+        out["trace_cache"] = trace_cache
+    if aot:
+        out["aot_cache"] = aot
+    if aot_tags:
+        out["aot_cache_hit_tags"] = aot_tags
+    return out
+
+
 def _percentile(sorted_values: list, q: float) -> float:
     """Linear-interpolation percentile over an already-sorted list (matches
     numpy's default method without importing numpy into the bench parent)."""
@@ -931,6 +1005,26 @@ def append_floor_runs(rec: dict, runs_path: str) -> int:
     return written
 
 
+def bench_serve_cold_start() -> dict:
+    """The benchmarks/serve_cold_start.py A/B as a bench workload: one
+    compile-path server boot on an empty AOT executable cache, then N cached
+    boots that deserialize the batch ladder. Stdlib-only here — every timed
+    boot is its own subprocess (the grandchildren import jax), so this child
+    stays as jax-free as the parent."""
+    import benchmarks.serve_cold_start as coldstart
+
+    return coldstart.measure(
+        repeats=int(os.environ.get("SHEEPRL_TPU_COLDSTART_REPEATS", "3")),
+        depth=int(os.environ.get("SHEEPRL_TPU_COLDSTART_DEPTH", "384")),
+        width=int(os.environ.get("SHEEPRL_TPU_COLDSTART_WIDTH", "64")),
+        rungs=tuple(
+            int(r)
+            for r in os.environ.get("SHEEPRL_TPU_COLDSTART_RUNGS", "1,2,4,8,16,32,64,128").split(",")
+            if r
+        ),
+    )
+
+
 def wait_for_backend(max_wait_s: float) -> bool:
     """Return True once the accelerator backend initializes (probed in a
     SUBPROCESS so a failed attempt cannot poison any process's backend
@@ -1089,6 +1183,7 @@ _WORKLOADS = {
     "ppo_fused": bench_ppo_fused,
     "ppo_actor_learner": bench_ppo_actor_learner,
     "ppo_floor": bench_ppo_floor,
+    "serve_cold_start": bench_serve_cold_start,
     "probe": lambda: link_probe(os.environ.get("SHEEPRL_TPU_BENCH_PROBE_TAG", "probe")),
 }
 
@@ -1349,6 +1444,14 @@ if __name__ == "__main__":
         "preemptions, auto-resume decisions) and exit",
     )
     parser.add_argument(
+        "--compile-stats",
+        metavar="PATH",
+        help="report the compile economy from a run's telemetry.jsonl "
+        "(lowered variants, deliberate-by-reason, post-warm recompiles, "
+        "trace-cache hit/miss, AOT executable-cache hit/miss/store/GC by "
+        "tag — a hit is a whole compile that never ran) and exit",
+    )
+    parser.add_argument(
         "--serve-stats",
         metavar="PATH",
         help="report policy-serving health from a serve session's telemetry.jsonl "
@@ -1387,6 +1490,16 @@ if __name__ == "__main__":
         "policy / jitted player / player+bookkeeping) in a subprocess, fold "
         "each stage into the run registry (kind=floor, variant=stage) for "
         "--regress gating, print the stage JSON",
+    )
+    parser.add_argument(
+        "--cold-start",
+        action="store_true",
+        help="run the benchmarks/serve_cold_start.py replica cold-start A/B "
+        "(compile-path boot on an empty AOT executable cache, then cached "
+        "boots that deserialize the batch ladder) in a subprocess, fold each "
+        "cached boot into the run registry (kind=serve, variant=cold_start, "
+        "metric cold_start_s lower-better) for --regress gating, print the "
+        "A/B JSON",
     )
     parser.add_argument(
         "--queue",
@@ -1468,6 +1581,17 @@ if __name__ == "__main__":
         written = append_floor_runs(rec, args.runs)
         print(json.dumps({**rec, "registry_records": written, "runs_path": args.runs}))
         sys.exit(0)
+    if args.cold_start:
+        # each timed boot is its own grandchild process; the fold is the
+        # stdlib-only append_runs from the benchmark module itself
+        import benchmarks.serve_cold_start as coldstart
+
+        rec = _spawn_workload("serve_cold_start", 3600)
+        if rec is None:
+            sys.exit(1)
+        written = coldstart.append_runs(rec, args.runs)
+        print(json.dumps({**rec, "registry_records": written, "runs_path": args.runs}))
+        sys.exit(0)
     if args.regress:
         # the gate is stdlib-only; load it by file path so this parent
         # process stays jax-free (same reason main() shells out workloads)
@@ -1484,6 +1608,8 @@ if __name__ == "__main__":
                 bench_pattern=args.bench_glob or None,
             )
         )
+    elif args.compile_stats:
+        print(json.dumps(compile_stats(args.compile_stats), indent=1))
     elif args.serve_stats:
         print(json.dumps(serve_stats(args.serve_stats), indent=1))
     elif args.resilience_stats:

@@ -428,6 +428,19 @@ def make_fused_train_fn(
         return (wm_p, a_p, c_p, t_p), aux
 
     model_axis = fabric.model_axis if carry_specs is not None else None
+    # fabric.aot_cache_dir persists the fused-window executable: the
+    # fingerprint digests the algo node + precision (every constant baked
+    # into the train graph — lr, tau, horizon, loss scales), so a resume
+    # with identical config deserializes in seconds while ANY algo tweak
+    # misses cleanly and recompiles
+    aot_cache = getattr(fabric, "aot_cache", None)
+    cache_fingerprint = None
+    if aot_cache is not None:
+        from sheeprl_tpu.ops.aotcache import config_fingerprint
+
+        cache_fingerprint = config_fingerprint(
+            {"algo": cfg.algo, "precision": str(getattr(fabric, "precision", ""))}
+        )
     return make_superstep_fn(
         train_body,
         gather,
@@ -439,6 +452,9 @@ def make_fused_train_fn(
         model_axis=model_axis,
         carry_specs=carry_specs,
         check_finite=check_finite,
+        aot_cache=aot_cache,
+        cache_tag="superstep.dreamer_v3",
+        cache_fingerprint=cache_fingerprint,
     )
 
 

@@ -157,9 +157,13 @@ def serving(args: Optional[List[str]] = None) -> None:
             f"gather={serve_cfg.gather_window_s * 1e3:.1f}ms "
             f"queue<={serve_cfg.max_queue} replicas={serve_cfg.num_replicas}"
         )
+    cache_note = ""
+    if getattr(server, "aot_cache", None) is not None:
+        st = server.aot_cache.stats()
+        cache_note = f" [aot cache: {st['hits']} deserialized / {st['misses']} compiled]"
     print(
         f"serving {policy.name} step={man['step']} from {ckpt_path}\n"
-        f"AOT ladder warmed in {time.perf_counter() - t0:.2f}s ({warm}); "
+        f"AOT ladder warmed in {time.perf_counter() - t0:.2f}s ({warm}){cache_note}; "
         f"slo={serve_cfg.slo_ms:.0f}ms {tier}"
     )
 

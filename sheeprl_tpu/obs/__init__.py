@@ -32,6 +32,8 @@ from sheeprl_tpu.obs.telemetry import (
     shutdown_telemetry,
     telemetry_actor_restart,
     telemetry_advance,
+    telemetry_aot_cache,
+    telemetry_aot_load,
     telemetry_child_file,
     telemetry_ckpt_commit,
     telemetry_ckpt_skipped,
@@ -92,6 +94,8 @@ __all__ = [
     "span",
     "telemetry_actor_restart",
     "telemetry_advance",
+    "telemetry_aot_cache",
+    "telemetry_aot_load",
     "telemetry_child_file",
     "telemetry_ckpt_commit",
     "telemetry_ckpt_skipped",

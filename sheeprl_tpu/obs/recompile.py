@@ -75,12 +75,19 @@ class CompileWatchdog:
         # deliberate (allowlisted) post-warmup compiles by reason — AOT cost
         # analysis, the serve batch ladder, hot-swap revalidation
         self.deliberate_compiles: Dict[str, int] = {}
+        # executable-cache loads by tag: work XLA does while deserializing a
+        # cached executable (ops/aotcache) is neither a compile nor a
+        # recompile — a third category, counted separately
+        self.aot_loads: Dict[str, int] = {}
         self.warm = False
         # compiles fire on the compiling thread (serve AOT on the server's
         # caller, revalidation on watcher threads), so the allowlist flag
         # must be thread-local: one thread's deliberate window must not
         # silence a real retrace racing on another thread
         self._deliberate = threading.local()
+        # same thread-locality argument for aot-load windows: the fleet
+        # deserializes per-replica ladders concurrently with live traffic
+        self._aot_load = threading.local()
         self._started = False
         self._handler = _NameCaptureHandler()
         self._logger = logging.getLogger(_PXLA_LOGGER)
@@ -145,6 +152,20 @@ class CompileWatchdog:
         finally:
             self._deliberate.reason = prev
 
+    @contextmanager
+    def aot_load(self, tag: str):
+        """Executable-cache load window: monitoring events fired on THIS
+        thread while a serialized executable deserializes are classified as
+        ``aot_load`` — neither a (re)compile nor a ``deliberate:`` compile.
+        A cache hit must leave ``compiles``/``recompiles`` untouched or the
+        'recompiles 0 after resume' acceptance signal would be noise."""
+        prev = getattr(self._aot_load, "tag", None)
+        self._aot_load.tag = str(tag)
+        try:
+            yield
+        finally:
+            self._aot_load.tag = prev
+
     def _on_plain_event(self, event: str, **kwargs: Any) -> None:
         """Persistent-compilation-cache outcome: one ``compile_cache`` event
         per backend-compile request, so a resumed run can show its retraces
@@ -170,6 +191,15 @@ class CompileWatchdog:
         else:
             return
         name = self._handler.last_name or "<unknown>"
+        aot_tag = getattr(self._aot_load, "tag", None)
+        if aot_tag is not None:
+            if phase == "lower":
+                self.aot_loads[aot_tag] = self.aot_loads.get(aot_tag, 0) + 1
+            try:
+                self._emit("compile", name=name, phase=phase, dur=duration, post_warm=False, aot_load=aot_tag)
+            except Exception:
+                pass
+            return
         reason = getattr(self._deliberate, "reason", None)
         post_warm = self.warm and reason is None
         if phase == "lower":

@@ -229,6 +229,13 @@ class RunTelemetry:
         # serve_stats snapshot; supervision/swap events are counted by kind
         self._serve_last_stats: Optional[Dict[str, Any]] = None
         self._serve_events: Dict[str, int] = {}
+        # AOT executable cache (sheeprl_tpu.ops.aotcache): deserialized-load
+        # hits vs compile fallbacks plus staged-store outcomes — one
+        # `aot_cache` event per action + run_end totals
+        self._aot_cache_hits = 0
+        self._aot_cache_misses = 0
+        self._aot_cache_stores = 0
+        self._aot_cache_errors = 0
         # trace-plane critical-path reservoirs (sheeprl_tpu.obs.trace): per-
         # slab lag decomposition (collect -> ring-wait -> train, µs) and
         # per-request latency decomposition (queue-wait -> batch-assembly ->
@@ -501,6 +508,25 @@ class RunTelemetry:
         ``rollback``): a ``serve_event`` line + run_end per-kind counters."""
         self._serve_events[kind] = self._serve_events.get(kind, 0) + 1
         self.emit("serve_event", kind=kind, **fields)
+        self.writer.flush()
+
+    def record_aot_cache(self, action: str, tag: str = "", **fields: Any) -> None:
+        """One executable-cache outcome (``hit`` / ``miss`` / ``store`` /
+        ``store_failed`` / ``corrupt_gc`` / ``torn_gc`` / ``prewarm``): an
+        ``aot_cache`` line + run_end totals. A ``hit`` means a cold path
+        skipped its compile; ``miss`` and the error actions mean it fell back
+        to the compile ladder (degraded, never failed)."""
+        if action == "hit":
+            self._aot_cache_hits += 1
+        elif action == "miss":
+            self._aot_cache_misses += 1
+        elif action == "store":
+            # "prewarm" is a rollup of the per-entry "store" events the
+            # gauntlet's sync commits already emitted — not counted twice
+            self._aot_cache_stores += 1
+        elif action in ("store_failed", "corrupt_gc"):
+            self._aot_cache_errors += 1
+        self.emit("aot_cache", action=action, tag=tag, **fields)
         self.writer.flush()
 
     def _serve_section(self) -> Dict[str, Any]:
@@ -852,6 +878,10 @@ class RunTelemetry:
             "preemptions": self._total_preemptions,
             "crash_checkpoints": self._total_crash_checkpoints,
             "resume_fallbacks": self._total_resume_fallbacks,
+            "aot_cache_hits": self._aot_cache_hits,
+            "aot_cache_misses": self._aot_cache_misses,
+            "aot_cache_stores": self._aot_cache_stores,
+            "aot_cache_errors": self._aot_cache_errors,
         }
         if self._cum_env_time > 0:
             summary["sps_env"] = self._cum_env_steps / self._cum_env_time
@@ -972,6 +1002,11 @@ class RunTelemetry:
             preemptions=self._total_preemptions,
             crash_checkpoints=self._total_crash_checkpoints,
             resume_fallbacks=self._total_resume_fallbacks,
+            aot_cache_hits=self._aot_cache_hits,
+            aot_cache_misses=self._aot_cache_misses,
+            aot_cache_stores=self._aot_cache_stores,
+            aot_cache_errors=self._aot_cache_errors,
+            aot_loads=dict(self.watchdog.aot_loads),
             deliberate_compiles=dict(self.watchdog.deliberate_compiles),
             profile_captures=[dict(c) for c in self.profile_captures],
             telemetry_rotations=self.writer.rotations,
@@ -1072,6 +1107,28 @@ def telemetry_deliberate_compiles(reason: str):
         yield
     else:
         with tel.watchdog.deliberate(reason):
+            yield
+
+
+def telemetry_aot_cache(action: str, tag: str = "", **fields: Any) -> None:
+    """Record an executable-cache outcome (see
+    :meth:`RunTelemetry.record_aot_cache`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_aot_cache(action, tag, **fields)
+
+
+@contextmanager
+def telemetry_aot_load(tag: str):
+    """Executable-cache deserialization window: compile-monitoring events on
+    this thread are classified as ``aot_load`` — neither recompiles nor
+    ``deliberate:`` compiles (see :meth:`CompileWatchdog.aot_load`). Yields
+    even when telemetry is off."""
+    tel = _active_telemetry
+    if tel is None:
+        yield
+    else:
+        with tel.watchdog.aot_load(tag):
             yield
 
 

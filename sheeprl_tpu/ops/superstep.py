@@ -143,6 +143,9 @@ def make_superstep_fn(
     model_axis: Optional[str] = None,
     carry_specs: Optional[Tuple[Any, Any]] = None,
     check_finite: bool = False,
+    aot_cache=None,
+    cache_tag: str = "superstep",
+    cache_fingerprint: Optional[str] = None,
 ):
     """Wrap one un-jitted gradient step into a donated ``jax.jit(lax.scan)``
     over ``num_steps`` steps.
@@ -196,6 +199,17 @@ def make_superstep_fn(
     (:func:`sheeprl_tpu.resilience.all_finite`), so the window still costs
     one dispatch — the host only pays the check when it fetches metrics it
     already wanted.
+
+    ``aot_cache`` (an :class:`~sheeprl_tpu.ops.aotcache.AotCache`) persists
+    the fused-window *executable*: the first call per input signature
+    deserializes it from the cache — or compiles once and stores it — so a
+    preemption-resume (``resume_from=auto`` after exit 77) skips the largest
+    single compile on its critical path. ``cache_tag`` names the entries and
+    ``cache_fingerprint`` must digest every config constant baked into the
+    train graph (:func:`~sheeprl_tpu.ops.aotcache.config_fingerprint` over
+    the algo node) — same shapes under a changed learning rate must miss.
+    The cache is strictly optional: any miss or corrupt entry degrades to
+    the compile the un-cached path would have paid anyway.
     """
     if num_steps <= 0:
         raise ValueError(f"'num_steps' ({num_steps}) must be greater than 0")
@@ -265,7 +279,7 @@ def make_superstep_fn(
             else replicated
         )
         param_shardings, aux_shardings = carry_shardings
-        return jax.jit(
+        jitted = jax.jit(
             superstep,
             in_shardings=(param_shardings, aux_shardings, replicated, ctx_shardings, replicated),
             out_shardings=(
@@ -275,6 +289,7 @@ def make_superstep_fn(
             ),
             donate_argnums=(1,),
         )
+        return _maybe_cached(jitted, aot_cache, cache_tag, cache_fingerprint, mesh, num_steps, check_finite)
 
     if mesh is not None:
         if data_axis is None or ctx_spec is None:
@@ -292,4 +307,24 @@ def make_superstep_fn(
     # donate only aux: params stay un-donated (concurrent readers — the async
     # param stream to the host player — may be in flight), and sample_ctx
     # holds the replay ring, which the env loop keeps writing after the window
-    return jax.jit(superstep, donate_argnums=(1,))
+    jitted = jax.jit(superstep, donate_argnums=(1,))
+    return _maybe_cached(jitted, aot_cache, cache_tag, cache_fingerprint, mesh, num_steps, check_finite)
+
+
+def _maybe_cached(jitted, aot_cache, cache_tag, cache_fingerprint, mesh, num_steps, check_finite):
+    """Wrap the jitted superstep in the executable cache when one is
+    configured (``fabric.aot_cache_dir``). Donation is unchanged: ``lower``
+    only inspects avals, and the resolved ``Compiled`` donates ``aux`` on
+    call exactly like the jitted original."""
+    if aot_cache is None:
+        return jitted
+    from sheeprl_tpu.ops.aotcache import AotCachedFunction
+
+    return AotCachedFunction(
+        jitted,
+        aot_cache,
+        tag=cache_tag,
+        fingerprint=cache_fingerprint,
+        mesh=mesh,
+        extra={"num_steps": int(num_steps), "check_finite": bool(check_finite)},
+    )

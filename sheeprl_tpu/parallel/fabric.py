@@ -150,6 +150,7 @@ class Fabric:
         num_processes: Optional[int] = None,
         process_id: Optional[int] = None,
         compilation_cache_dir: Optional[str] = None,
+        aot_cache_dir: Optional[str] = None,
     ) -> None:
         self._maybe_init_distributed(distributed_coordinator, num_processes, process_id)
         if accelerator not in ("auto", "tpu", "cpu", "gpu"):
@@ -161,6 +162,17 @@ class Fabric:
             except RuntimeError:
                 pass  # backend already initialized; devices below reflect it
         self.compilation_cache_dir = self._configure_compilation_cache(compilation_cache_dir)
+        # AOT *executable* cache (ops/aotcache, howto/aot_cache.md): one tier
+        # above the trace cache — the fused-superstep builders serialize
+        # whole compiled windows through it so a preemption-resume skips the
+        # compile entirely instead of just the retrace
+        self.aot_cache = None
+        self.aot_cache_dir = None
+        if aot_cache_dir:
+            from sheeprl_tpu.ops.aotcache import AotCache
+
+            self.aot_cache_dir = os.path.abspath(os.path.expanduser(str(aot_cache_dir)))
+            self.aot_cache = AotCache(self.aot_cache_dir)
         self.accelerator = accelerator
         self.num_nodes = num_nodes
         self.callbacks = list(callbacks or [])

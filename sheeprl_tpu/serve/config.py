@@ -118,6 +118,9 @@ class ServeConfig:
     monitor_interval_s: float = 0.05
     swap_poll_s: float = 0.0  # >0: watch the ckpt dir and hot-swap newer manifests
     stats_interval_s: float = 5.0  # serve_stats telemetry cadence
+    # AOT executable cache dir (howto/aot_cache.md): replica boots
+    # deserialize the batch ladder instead of compiling it; None disables
+    aot_cache_dir: Optional[str] = None
     faults: List[ServeFaultSpec] = field(default_factory=list)
     load: LoadConfig = field(default_factory=LoadConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
@@ -212,6 +215,7 @@ def serve_config_from_cfg(cfg: Mapping[str, Any]) -> ServeConfig:
         monitor_interval_s=float(_get(node, "monitor_interval_s", 0.05)),
         swap_poll_s=float(_get(node, "swap_poll_s", 0.0) or 0.0),
         stats_interval_s=float(_get(node, "stats_interval_s", 5.0)),
+        aot_cache_dir=(None if _get(node, "aot_cache_dir", None) is None else str(_get(node, "aot_cache_dir"))),
         faults=faults,
         load=load,
         fleet=fleet,

@@ -329,6 +329,7 @@ class FleetServer:
         self.slots: List[FleetSlot] = []
         self.router: Optional[Router] = None
         self.store: Optional[ModelStore] = None
+        self.aot_cache: Optional[Any] = None
         self._ladders: Dict[Any, CompiledLadder] = {}  # device -> compiled ladder
         self._monitor_thread: Optional[threading.Thread] = None
         self._swap_thread: Optional[threading.Thread] = None
@@ -351,6 +352,12 @@ class FleetServer:
             return self
         import jax
 
+        if self.config.aot_cache_dir:
+            from sheeprl_tpu.ops.aotcache import AotCache
+
+            # one cache shared by every per-device ladder (entries are keyed
+            # by device, so replicas never load a sibling's executable)
+            self.aot_cache = AotCache(self.config.aot_cache_dir)
         fleet = self.config.fleet
         devices = self._device_ring()
         spill_devices = self._spill_devices()
@@ -425,6 +432,10 @@ class FleetServer:
             slot.pool.close()
         if self._swap_thread is not None:
             self._swap_thread.join(1.0)
+        if self.aot_cache is not None:
+            # drain queued executable stores (writer thread joins) so the
+            # next spawn against this cache dir boots from cache
+            self.aot_cache.close()
 
     def __enter__(self) -> "FleetServer":
         return self.start()
@@ -531,6 +542,13 @@ class FleetServer:
         snap["slo_ms"] = self.config.slo_ms
         snap["batch_ladder"] = list(self.config.batch_ladder)
         snap["warmup_s"] = dict(self.warmup_s)
+        if self.aot_cache is not None:
+            snap["aot_cache"] = self.aot_cache.stats()
+            with self._lock:
+                ladders = dict(self._ladders)
+            snap["ladder_from_cache"] = {
+                str(dev): dict(ladder.from_cache) for dev, ladder in ladders.items()
+            }
         snap["queue_depth"] = self.router.pending_depth() if self.router else 0
         routable = [s for s in self.slots if s.active and not s.masked]
         snap["replicas_alive"] = sum(1 for s in routable if s.alive)
@@ -814,11 +832,18 @@ class FleetServer:
 
         with telemetry_deliberate_compiles("serve_batch_ladder"):
             if device is None:
-                ladder = CompiledLadder(self.policy, self.config.batch_ladder)
+                ladder = CompiledLadder(
+                    self.policy, self.config.batch_ladder, aot_cache=self.aot_cache
+                )
             else:
                 try:
                     with jax.default_device(device):
-                        ladder = CompiledLadder(self.policy, self.config.batch_ladder)
+                        ladder = CompiledLadder(
+                            self.policy,
+                            self.config.batch_ladder,
+                            aot_cache=self.aot_cache,
+                            device=device,
+                        )
                 except Exception:
                     ladder = self._ladder_for(None)
         with self._lock:

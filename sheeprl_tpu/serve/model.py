@@ -90,18 +90,76 @@ def stack_obs(obs_spec: Any, obs_list: Sequence[Any], batch: int) -> Any:
 
 
 class CompiledLadder:
-    """One AOT executable per batch rung, warmed eagerly at construction."""
+    """One AOT executable per batch rung, warmed eagerly at construction.
 
-    def __init__(self, policy: ServedPolicy, ladder: Sequence[int]) -> None:
+    With an :class:`~sheeprl_tpu.ops.aotcache.AotCache` each rung is first
+    looked up as a serialized executable (keyed by the params *structure*,
+    the rung's batched obs spec, and the topology — howto/aot_cache.md);
+    only misses pay the compile, and those are stored for the next boot.
+    ``device`` pins the key to a fleet replica's device: serialized
+    executables bake in their device assignment, so replicas must never
+    share entries across devices.
+    """
+
+    def __init__(
+        self,
+        policy: ServedPolicy,
+        ladder: Sequence[int],
+        *,
+        aot_cache: Optional[Any] = None,
+        device: Optional[Any] = None,
+    ) -> None:
         self.policy = policy
         self.rungs = sorted({int(b) for b in ladder})
         self.compile_s: Dict[int, float] = {}
+        self.from_cache: Dict[int, bool] = {}
         self._compiled: Dict[int, Any] = {}
+        self._aot_cache = aot_cache
+        self._keys: Dict[int, Any] = {}
         jitted = jax.jit(policy.apply)
         for b in self.rungs:
             t0 = time.perf_counter()
-            self._compiled[b] = jitted.lower(policy.params, _batched_spec(policy.obs_spec, b)).compile()
+            spec = _batched_spec(policy.obs_spec, b)
+            fn = None
+            if aot_cache is not None:
+                key = aot_cache.key(
+                    tag=f"serve_ladder.{policy.name}",
+                    avals=(policy.params, spec),
+                    params=policy.params,
+                    device=device,
+                    extra={"rung": b},
+                )
+                self._keys[b] = key
+                fn, hit = aot_cache.load_or_compile(
+                    key, lambda: jitted.lower(policy.params, spec).compile()
+                )
+                self.from_cache[b] = hit
+            else:
+                fn = jitted.lower(policy.params, spec).compile()
+                self.from_cache[b] = False
+            self._compiled[b] = fn
             self.compile_s[b] = time.perf_counter() - t0
+
+    def prewarm_cache(self) -> int:
+        """Persist any rung whose cache entry is missing on disk (committed
+        synchronously, so it is durable when this returns). Called by the
+        hot-swap gauntlet just before the version flip: an accepted
+        candidate is structurally identical to the serving params, so the
+        incoming digest maps to these same entries — the next replica
+        restart or scale-up deserializes instead of compiling. Returns the
+        number of entries written; never raises (a failed store is a
+        telemetry event and the swap proceeds)."""
+        if self._aot_cache is None:
+            return 0
+        written = 0
+        for b in self.rungs:
+            key = self._keys.get(b)
+            if key is None or self._aot_cache.has(key):
+                continue
+            self._aot_cache.store(key, self._compiled[b], sync=True)
+            if self._aot_cache.has(key):
+                written += 1
+        return written
 
     @property
     def max_batch(self) -> int:
@@ -235,6 +293,18 @@ class ModelStore:
                     return self._reject(candidate, "smoke inference produced non-finite outputs")
             except Exception as err:
                 return self._reject(candidate, f"smoke inference failed: {err!r}")
+
+        # pre-populate executable-cache entries for the incoming digest
+        # BEFORE the flip: the candidate passed the structure gauntlet, so
+        # its executables are exactly the serving ones — after this, any
+        # replica restart/scale-up under the new version boots from cache
+        prewarmed = self.ladder.prewarm_cache()
+        if prewarmed:
+            from sheeprl_tpu.obs import telemetry_aot_cache
+
+            telemetry_aot_cache(
+                "prewarm", f"serve_ladder.{self.policy.name}", entries=prewarmed, step=candidate.step
+            )
 
         with self._lock:
             self._previous = self._current

@@ -150,6 +150,7 @@ class PolicyServer:
         self.ladder: Optional[CompiledLadder] = None
         self.store: Optional[ModelStore] = None
         self.replicas: Optional[ReplicaSet] = None
+        self.aot_cache: Optional[Any] = None
         self._swap_thread: Optional[threading.Thread] = None
         self._closing = threading.Event()
         self._started = False
@@ -163,11 +164,16 @@ class PolicyServer:
             return self
         from sheeprl_tpu.obs import telemetry_deliberate_compiles
 
+        if self.config.aot_cache_dir:
+            from sheeprl_tpu.ops.aotcache import AotCache
+
+            self.aot_cache = AotCache(self.config.aot_cache_dir)
         # the batch-ladder AOT warmup IS compilation — allowlist it so a
         # serve session that configured telemetry (and is already warm from
-        # a shared-process drill) doesn't spray RecompileWarnings
+        # a shared-process drill) doesn't spray RecompileWarnings; with an
+        # executable cache, hits never lower and the window stays idle
         with telemetry_deliberate_compiles("serve_batch_ladder"):
-            self.ladder = CompiledLadder(self.policy, self.config.batch_ladder)
+            self.ladder = CompiledLadder(self.policy, self.config.batch_ladder, aot_cache=self.aot_cache)
         self.warmup_s = dict(self.ladder.compile_s)
         self.store = ModelStore(
             self.policy,
@@ -202,6 +208,10 @@ class PolicyServer:
             self.replicas.close()
         if self._swap_thread is not None:
             self._swap_thread.join(1.0)
+        if self.aot_cache is not None:
+            # drains queued executable stores (writer thread joins) so the
+            # next boot of this cache dir sees everything this one compiled
+            self.aot_cache.close()
 
     def __enter__(self) -> "PolicyServer":
         return self.start()
@@ -279,6 +289,9 @@ class PolicyServer:
         snap["slo_ms"] = self.config.slo_ms
         snap["batch_ladder"] = list(self.config.batch_ladder)
         snap["warmup_s"] = dict(self.warmup_s)
+        if self.ladder is not None and self.aot_cache is not None:
+            snap["ladder_from_cache"] = dict(self.ladder.from_cache)
+            snap["aot_cache"] = self.aot_cache.stats()
         if self.replicas is not None:
             snap["replicas_alive"] = self.replicas.alive_count
             snap["replicas_masked"] = self.replicas.masked_count

@@ -1,0 +1,223 @@
+"""AOT executable cache unit tests (ISSUE 17): serialize/deserialize round
+trip, every invalidation axis of the key schema (params structure, topology,
+jax version), corrupt/torn-entry GC mirroring the torn-manifest discipline,
+and the soft-failure contract (a broken cache degrades to compile, never
+raises into a cold path)."""
+
+import os
+import pickle
+import shutil
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import sheeprl_tpu.ops.aotcache as aotcache
+from sheeprl_tpu.ops.aotcache import (
+    CACHE_VERSION,
+    AotCache,
+    AotCachedFunction,
+    ENTRY_SUFFIX,
+    TMP_PREFIX,
+    avals_digest,
+    config_fingerprint,
+)
+
+
+@pytest.fixture(autouse=True)
+def _real_compiles():
+    """Disable the suite-wide XLA persistent trace cache (tests/conftest.py)
+    for these tests: a trace-cache HIT yields an executable whose serialized
+    payload cannot be loaded back (CPU backend, "Symbols not found"), which
+    the store-time verification in AotCache would rightly reject — but these
+    tests need real round trips, so compiles must be real."""
+    old = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    yield
+    jax.config.update("jax_enable_compilation_cache", old)
+
+
+def _jitted():
+    return jax.jit(lambda w, x: jnp.tanh(x @ w).sum(-1))
+
+
+def _args(width=8, batch=4):
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(width, width)), jnp.float32)
+    x = jnp.ones((batch, width), jnp.float32)
+    return w, x
+
+
+@pytest.fixture
+def cache(tmp_path):
+    c = AotCache(str(tmp_path / "aot"))
+    yield c
+    c.close()
+
+
+def test_round_trip_numerics_and_counters(cache):
+    w, x = _args()
+    key = cache.key(tag="unit", avals=(w, x))
+    fn, hit = cache.load_or_compile(key, lambda: _jitted().lower(w, x).compile(), sync_store=True)
+    assert not hit and cache.stats() == {"hits": 0, "misses": 1, "stores": 1, "errors": 0}
+    expect = np.asarray(fn(w, x))
+    assert cache.has(key) and cache.entry_path(key).endswith(ENTRY_SUFFIX)
+
+    # a fresh cache object over the same dir = a fresh process booting
+    reloaded = AotCache(cache.cache_dir)
+    try:
+        fn2, hit2 = reloaded.load_or_compile(key, lambda: pytest.fail("hit expected, compiled instead"))
+        assert hit2 and reloaded.stats()["hits"] == 1
+        np.testing.assert_allclose(np.asarray(fn2(w, x)), expect, rtol=0, atol=0)
+    finally:
+        reloaded.close()
+
+
+def test_cached_function_resume(tmp_path):
+    """AotCachedFunction across two cache instances — the preemption-resume
+    shape: run 1 compiles+stores, run 2 deserializes (from_cache True)."""
+    w, x = _args()
+    first = AotCache(str(tmp_path / "aot"))
+    try:
+        f1 = AotCachedFunction(_jitted(), first, tag="superstep.unit", fingerprint="cfg")
+        out1 = np.asarray(f1(w, x))
+        assert f1.from_cache == {avals_digest((w, x)): False}
+        first.flush()
+    finally:
+        first.close()
+
+    second = AotCache(str(tmp_path / "aot"))
+    try:
+        f2 = AotCachedFunction(_jitted(), second, tag="superstep.unit", fingerprint="cfg")
+        out2 = np.asarray(f2(w, x))
+        assert f2.from_cache == {avals_digest((w, x)): True}
+        assert second.stats() == {"hits": 1, "misses": 0, "stores": 0, "errors": 0}
+        np.testing.assert_allclose(out2, out1, rtol=0, atol=0)
+    finally:
+        second.close()
+
+
+def test_params_structure_invalidation(cache):
+    """Same structure + different values -> SAME key (hot-swap reuse); a
+    different structure (extra leaf) -> clean miss."""
+    w, x = _args()
+    params = {"agent": {"w": w}}
+    key = cache.key(tag="unit", avals=(x,), params=params)
+    swapped = cache.key(tag="unit", avals=(x,), params={"agent": {"w": w + 1.0}})
+    assert swapped.digest == key.digest
+    grown = cache.key(tag="unit", avals=(x,), params={"agent": {"w": w, "b": x}})
+    assert grown.digest != key.digest
+    assert not cache.has(grown)
+    assert cache.load(grown) is None and cache.stats()["misses"] == 1
+
+
+def test_topology_and_fingerprint_invalidation(cache):
+    w, x = _args()
+    base = cache.key(tag="unit", avals=(w, x))
+    # pinned replica device participates (executables bake in their device)
+    pinned = cache.key(tag="unit", avals=(w, x), device=jax.devices()[0])
+    assert pinned.digest != base.digest
+    # config fingerprint drift (a constant baked into the graph changed)
+    refit = cache.key(tag="unit", avals=(w, x), fingerprint=config_fingerprint({"lr": 3e-4}))
+    assert refit.digest != base.digest
+    # different input avals (a new batch rung)
+    wider = cache.key(tag="unit", avals=_args(batch=8))
+    assert wider.digest != base.digest
+
+
+def test_jax_version_bump_misses(cache, monkeypatch):
+    w, x = _args()
+    key = cache.key(tag="unit", avals=(w, x))
+    cache.store(key, _jitted().lower(w, x).compile(), sync=True)
+    assert cache.has(key)
+    monkeypatch.setattr(
+        aotcache, "_runtime_versions", lambda: {"jax": "99.99.99", "platform_version": "future"}
+    )
+    bumped = cache.key(tag="unit", avals=(w, x))
+    assert bumped.digest != key.digest
+    assert not cache.has(bumped)
+    assert cache.load(bumped) is None  # clean miss, old entry untouched
+    assert cache.has(key) and cache.stats()["errors"] == 0
+
+
+def test_corrupt_entry_gc(cache):
+    """Garbage bytes behind a valid entry name: load -> None, file removed,
+    errors counted — the torn-manifest contract for executables."""
+    w, x = _args()
+    key = cache.key(tag="unit", avals=(w, x))
+    with open(cache.entry_path(key), "wb") as f:
+        f.write(b"not a pickle")
+    assert cache.load(key) is None
+    assert not cache.has(key)
+    assert cache.stats()["errors"] == 1
+
+
+def test_foreign_entry_gc(cache):
+    """A structurally-valid entry whose embedded key disagrees with its file
+    name (copied/renamed across keys) is rejected and GC'd."""
+    w, x = _args()
+    key = cache.key(tag="unit", avals=(w, x))
+    cache.store(key, _jitted().lower(w, x).compile(), sync=True)
+    other = cache.key(tag="unit", avals=_args(batch=16))
+    shutil.copyfile(cache.entry_path(key), cache.entry_path(other))
+    assert cache.load(other) is None
+    assert not cache.has(other)
+    assert cache.has(key) and cache.stats()["errors"] == 1
+
+
+def test_version_bumped_entry_gc(cache):
+    """An entry from a future cache schema is skipped and GC'd, not parsed."""
+    w, x = _args()
+    key = cache.key(tag="unit", avals=(w, x))
+    with open(cache.entry_path(key), "wb") as f:
+        pickle.dump({"cache_version": CACHE_VERSION + 1, "key": key.parts}, f)
+    assert cache.load(key) is None
+    assert not cache.has(key) and cache.stats()["errors"] == 1
+
+
+def test_torn_staging_gc(tmp_path):
+    cache_dir = tmp_path / "aot"
+    cache_dir.mkdir()
+    torn = cache_dir / f"{TMP_PREFIX}dead-writer{ENTRY_SUFFIX}"
+    torn.write_bytes(b"partial")
+    cache = AotCache(str(cache_dir))  # init sweep is age-gated: young file survives
+    try:
+        assert torn.exists()
+        assert cache.torn_entries(max_age_s=0.0) == [str(torn)]
+        assert cache.gc_torn(max_age_s=0.0) == [str(torn)]
+        assert not torn.exists() and cache.torn_entries() == []
+    finally:
+        cache.close()
+
+
+def test_unloadable_payload_never_committed(cache, monkeypatch):
+    """Store-time verification: if the serialized payload cannot be loaded
+    back (the trace-cache-hit poison mode), the entry is NOT committed —
+    store_failed, no file, and the next boot simply compiles."""
+    import jax.experimental.serialize_executable as se
+
+    w, x = _args()
+    key = cache.key(tag="unit", avals=(w, x))
+    compiled = _jitted().lower(w, x).compile()
+
+    def unloadable(payload, in_tree, out_tree):
+        raise RuntimeError("Symbols not found: [ dot_add_fusion ]")
+
+    monkeypatch.setattr(se, "deserialize_and_load", unloadable)
+    cache.store(key, compiled, sync=True)
+    assert not cache.has(key)
+    assert cache.stats() == {"hits": 0, "misses": 0, "stores": 0, "errors": 1}
+    assert cache.torn_entries() == []  # staging file cleaned up too
+
+
+def test_store_failure_is_soft(cache, monkeypatch):
+    """A store that cannot serialize emits an event and counts an error —
+    it never raises into the compile path."""
+    w, x = _args()
+    key = cache.key(tag="unit", avals=(w, x))
+    cache.store(key, object(), sync=True)  # not a Compiled: serialize() raises inside
+    assert cache.stats()["errors"] == 1 and not cache.has(key)
+    # and the combined path still returns the freshly-compiled executable
+    fn, hit = cache.load_or_compile(key, lambda: _jitted().lower(w, x).compile())
+    assert not hit
+    assert np.asarray(fn(w, x)).shape == (4,)

@@ -77,6 +77,10 @@ METRICS: Dict[str, Tuple[bool, float]] = {
     "masked_slots": (False, 1.0),
     "nan_rollbacks": (False, 1.0),
     "recompiles": (False, 1.0),
+    # replica cold start (benchmarks/serve_cold_start.py --record): process
+    # spawn -> first request served on a warm AOT executable cache.
+    # Lower-better in the default 20% band, like the latency metrics.
+    "cold_start_s": (False, 0.0),
 }
 
 # (cell-key glob, metric, absolute lower bound). Floors are enforced on the
@@ -187,6 +191,7 @@ def record_metrics(rec: Dict[str, Any]) -> Dict[str, float]:
         "masked_slots",
         "nan_rollbacks",
         "recompiles",
+        "cold_start_s",
     ):
         value = rec.get(key)
         if isinstance(value, (int, float)):
