@@ -535,6 +535,89 @@ def env_stats_summary(events_or_path) -> dict:
     return out
 
 
+def net_stats_report(events_or_path) -> dict:
+    """Multi-host data-plane health from a run's telemetry stream
+    (sheeprl_tpu/net, howto/multihost.md): per-transport-endpoint counters
+    (frames/bytes sent+received, reconnects, checksum rejects, heartbeat
+    gaps, torn frames, stale slabs) from the run_end ``net`` section, the
+    sparse ``net_event`` lines (reconnect / disconnect / checksum_reject /
+    remote_timeout / transport_close, with their transport+peer fields), and
+    the cross-host clock-skew observations from ``net_handshake`` trace
+    events. Counter totals prefer run_end (they cover the trailing
+    unflushed window), falling back to summing the event stream for a
+    still-running run."""
+    events = (
+        read_telemetry(events_or_path) if isinstance(events_or_path, str) else list(events_or_path)
+    )
+    out: dict = {}
+
+    run_end_net = None
+    for e in events:
+        if e.get("event") == "run_end" and isinstance(e.get("net"), dict):
+            run_end_net = e["net"]
+            break
+
+    net_events = [e for e in events if e.get("event") == "net_event"]
+    by_kind: dict = {}
+    for e in net_events:
+        kind = str(e.get("kind", "?"))
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+    if run_end_net and isinstance(run_end_net.get("events"), dict):
+        # run_end counted every event, including any in the unflushed tail
+        by_kind = {str(k): int(v) for k, v in run_end_net["events"].items()}
+    if by_kind:
+        out["events"] = dict(sorted(by_kind.items()))
+    if net_events:
+        out["event_log"] = [
+            {
+                k: e.get(k)
+                for k in ("kind", "transport", "peer", "actor", "replica", "generation", "reason")
+                if e.get(k) is not None
+            }
+            for e in net_events
+        ]
+
+    transports = None
+    if run_end_net and isinstance(run_end_net.get("transports"), dict):
+        transports = run_end_net["transports"]
+    if transports:
+        out["transports"] = {name: dict(counters) for name, counters in sorted(transports.items())}
+        totals: dict = {}
+        for counters in transports.values():
+            for k, v in counters.items():
+                if isinstance(v, (int, float)):
+                    totals[k] = totals.get(k, 0) + v
+        out["totals"] = totals
+
+    handshakes = [
+        e
+        for e in events
+        if e.get("event") == "trace" and e.get("kind") == "net_handshake"
+    ]
+    if handshakes:
+        skews: dict = {}
+        for e in handshakes:
+            peer = str(e.get("peer", "?"))
+            if isinstance(e.get("skew_s"), (int, float)):
+                skews.setdefault(peer, []).append(float(e["skew_s"]))
+        out["handshakes"] = {
+            "count": len(handshakes),
+            "peers": sorted({str(e.get("peer", "?")) for e in handshakes}),
+        }
+        if skews:
+            out["handshakes"]["skew_s"] = {
+                peer: round(sorted(vals)[len(vals) // 2], 6) for peer, vals in sorted(skews.items())
+            }
+
+    if not out:
+        out["note"] = (
+            "no net telemetry in this stream (no run_end net section, net_event "
+            "or net_handshake lines). The data plane only reports when a TCP/shm "
+            "transport or remote replica was active — see howto/multihost.md."
+        )
+    return out
+
+
 def resilience_stats(events_or_path) -> dict:
     """Checkpoint/rollback health from a run's telemetry stream
     (sheeprl_tpu/resilience, howto/resilience.md): ``ckpt/snapshot`` (the only
@@ -1461,6 +1544,14 @@ if __name__ == "__main__":
         "rows, per-replica rows, fleet rollup)",
     )
     parser.add_argument(
+        "--net-stats",
+        metavar="PATH",
+        help="report multi-host data-plane health from a run's telemetry.jsonl "
+        "(per-transport frames/bytes/reconnects/checksum-rejects/heartbeat-gaps "
+        "from the run_end net section, the net_event log, and cross-host "
+        "handshake clock skews) and exit",
+    )
+    parser.add_argument(
         "--trace",
         metavar="PATH",
         nargs="+",
@@ -1616,6 +1707,8 @@ if __name__ == "__main__":
         print(json.dumps(resilience_stats(args.resilience_stats), indent=1))
     elif args.env_stats:
         print(json.dumps(env_stats_summary(args.env_stats), indent=1))
+    elif args.net_stats:
+        print(json.dumps(net_stats_report(args.net_stats), indent=1))
     elif args.dispatch_stats:
         print(json.dumps(dispatch_stats(args.dispatch_stats)))
     elif args.trace:

@@ -23,7 +23,8 @@ Protocol (pickled tuples over a duplex ``multiprocessing.Pipe``)::
                                         ("ready",)
     ("close",)                          ("bye",)
 
-Everything else — slabs out, params in — rides shared memory. Heartbeats go
+Everything else — slabs out, params in — rides the transport (shared memory
+same-host, length-prefixed TCP frames cross-host). Heartbeats go
 through the supervisor's lock-free double array after every env step, so the
 parent distinguishes a slow rollout from a wedged one exactly like the env
 pool does.
@@ -51,8 +52,7 @@ def actor_main(conn, hb, actor_index: int, blob: bytes) -> None:
 
     sanitize_worker_environ()
     envs = None
-    ring = None
-    lane = None
+    transport = None
     try:
         import cloudpickle
 
@@ -71,11 +71,11 @@ def actor_main(conn, hb, actor_index: int, blob: bytes) -> None:
 
         from functools import partial
 
-        from sheeprl_tpu.actor_learner.param_lane import ParamLane
-        from sheeprl_tpu.actor_learner.ring import SlabLayout, TrajectoryRing
+        from sheeprl_tpu.actor_learner.ring import SlabLayout
         from sheeprl_tpu.algos.ppo.agent import PPOPlayer, build_agent
         from sheeprl_tpu.algos.ppo.utils import prepare_obs
         from sheeprl_tpu.envs.factory import make_env
+        from sheeprl_tpu.net.transport import attach_actor_transport
         from sheeprl_tpu.ops.math import gae
         from sheeprl_tpu.parallel.fabric import Precision, _ParamStreamer
 
@@ -122,8 +122,9 @@ def actor_main(conn, hb, actor_index: int, blob: bytes) -> None:
         streamer = _ParamStreamer(params, cpu)
         gae_fn = jax.jit(partial(gae, gamma=float(cfg.algo.gamma), gae_lambda=float(cfg.algo.gae_lambda)))
 
-        ring = TrajectoryRing.attach(spec["ring"])
-        lane = ParamLane.attach(spec["lane"])
+        transport = attach_actor_transport(
+            spec["transport"], actor_id=actor_index, generation=generation, slots=slots
+        )
         layout = SlabLayout.from_wire(spec["layout"])
 
         # standalone flush-per-event trace recorder: the actor has no
@@ -147,7 +148,7 @@ def actor_main(conn, hb, actor_index: int, blob: bytes) -> None:
         # wait for the first publish so every slab carries a real version
         param_version = -1
         while param_version < 0:
-            got = lane.poll()
+            got = transport.poll_params()
             if got is not None:
                 param_version, flat = got
                 player.update_params(streamer.finish(flat))
@@ -181,7 +182,6 @@ def actor_main(conn, hb, actor_index: int, blob: bytes) -> None:
         }
         slab_seq = int(spec["start_seq"])
         local_slab = 0  # within-generation counter; faults key off it
-        slot_cursor = 0
         step_counter = 0
 
         while True:
@@ -192,8 +192,8 @@ def actor_main(conn, hb, actor_index: int, blob: bytes) -> None:
 
             # refresh params between rollouts (never mid-rollout: a slab is
             # collected against exactly one version)
-            if lane.version() > param_version:
-                got = lane.poll()
+            if transport.param_version() > param_version:
+                got = transport.poll_params()
                 if got is not None and got[0] > param_version:
                     param_version, flat = got
                     player.update_params(streamer.finish(flat))
@@ -282,26 +282,18 @@ def actor_main(conn, hb, actor_index: int, blob: bytes) -> None:
                     env_steps=T * E,
                 )
 
-            # acquire an owned slot (spin with heartbeats while the learner
-            # drains a full ring — backpressure, not an error)
-            slot = None
-            while slot is None:
-                for k in range(len(slots)):
-                    cand = slots[(slot_cursor + k) % len(slots)]
-                    if ring.try_begin_write(cand):
-                        slot = cand
-                        slot_cursor = (slot_cursor + k + 1) % len(slots)
-                        break
-                if slot is None:
-                    hb[actor_index] = time.time()
-                    if conn.poll(0.005):
-                        if conn.recv()[0] == "close":
-                            conn.send(("bye",))
-                            return
+            # acquire write capacity (spin with heartbeats while the learner
+            # drains a full ring / the credit window is empty — backpressure,
+            # not an error)
+            while not transport.try_begin_write():
+                hb[actor_index] = time.time()
+                if conn.poll(0.005):
+                    if conn.recv()[0] == "close":
+                        conn.send(("bye",))
+                        return
 
-            layout.pack_into(ring.payload_view(slot), flat)
-            ring.write_meta(
-                slot,
+            layout.pack_into(transport.payload_view(), flat)
+            transport.write_meta(
                 seq=slab_seq,
                 param_version=param_version,
                 actor_id=actor_index,
@@ -313,12 +305,14 @@ def actor_main(conn, hb, actor_index: int, blob: bytes) -> None:
             )
             if any(f["kind"] == "actor_crash_mid_write" and f["at_slab"] == local_slab for f in faults):
                 # the torn write: payload + meta are in place, the commit
-                # marker is NOT — and never will be. Skip atexit/finalizers;
-                # a SIGKILL-like death is what the reader must survive.
+                # marker is NOT — and never will be (tcp: half a frame hits
+                # the wire). Skip atexit/finalizers; a SIGKILL-like death is
+                # what the reader must survive.
+                transport.abort_torn()
                 os._exit(13)
-            ring.commit(slot)
+            transport.commit()
             if slab_tid:
-                trace_event("slab_commit", slab_tid, slot=slot, seq=slab_seq)
+                trace_event("slab_commit", slab_tid, seq=slab_seq)
             slab_seq += 1
             local_slab += 1
             hb[actor_index] = time.time()
@@ -337,7 +331,7 @@ def actor_main(conn, hb, actor_index: int, blob: bytes) -> None:
             shutdown_trace()
         except Exception:
             pass
-        for closer in (ring, lane, envs):
+        for closer in (transport, envs):
             if closer is not None:
                 try:
                     closer.close()

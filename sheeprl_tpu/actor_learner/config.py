@@ -24,6 +24,14 @@ class ActorLearnerConfig:
     num_actors: int = 2
     slots_per_actor: int = 2
     max_staleness: int = 1
+    # data-plane transport: "shm" (same-host shared memory, the default) or
+    # "tcp" (length-prefixed frames over a socket — actors may live on other
+    # hosts; see howto/multihost.md). bind_host/bind_port are the learner's
+    # listen address in tcp mode; port 0 picks an ephemeral port that rides
+    # to the actors inside the spawn blob.
+    transport: str = "shm"
+    bind_host: str = "127.0.0.1"
+    bind_port: int = 0
     poll_interval_s: float = 0.002
     step_timeout_s: float = 120.0
     spawn_timeout_s: float = 300.0
@@ -43,6 +51,10 @@ class ActorLearnerConfig:
             raise ValueError(f"actor_learner.slots_per_actor must be >= 1, got {self.slots_per_actor}")
         if self.max_staleness < 0:
             raise ValueError(f"actor_learner.max_staleness must be >= 0, got {self.max_staleness}")
+        if self.transport not in ("shm", "tcp"):
+            raise ValueError(
+                f"actor_learner.transport must be 'shm' or 'tcp', got {self.transport!r}"
+            )
 
     @property
     def heartbeat_grace(self) -> float:
@@ -75,6 +87,9 @@ def actor_learner_config_from_cfg(cfg: Mapping[str, Any]) -> ActorLearnerConfig:
         num_actors=int(_get(node, "num_actors", 2)),
         slots_per_actor=int(_get(node, "slots_per_actor", 2)),
         max_staleness=int(_get(node, "max_staleness", 1)),
+        transport=str(_get(node, "transport", "shm")),
+        bind_host=str(_get(node, "bind_host", "127.0.0.1")),
+        bind_port=int(_get(node, "bind_port", 0)),
         poll_interval_s=float(_get(node, "poll_interval_s", 0.002)),
         step_timeout_s=float(_get(node, "step_timeout_s", 120.0)),
         spawn_timeout_s=float(_get(node, "spawn_timeout_s", 300.0)),

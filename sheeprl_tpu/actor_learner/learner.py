@@ -36,9 +36,9 @@ import optax
 
 from sheeprl_tpu.actor_learner.config import ActorLearnerConfig, actor_learner_config_from_cfg, admit
 from sheeprl_tpu.actor_learner.fault_injection import LearnerFaultSchedule, actor_faults_for
-from sheeprl_tpu.actor_learner.param_lane import ParamLane
-from sheeprl_tpu.actor_learner.ring import SlabLayout, TrajectoryRing
+from sheeprl_tpu.actor_learner.ring import SlabLayout
 from sheeprl_tpu.actor_learner.supervisor import ActorSupervisor
+from sheeprl_tpu.net.transport import build_learner_transport
 from sheeprl_tpu.algos.ppo.agent import PPOPlayer, build_agent
 from sheeprl_tpu.algos.ppo.ppo import make_train_fn
 from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, test
@@ -167,10 +167,17 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
 
     # ------------------------------------------------------------- transport
     layout = build_slab_layout(observation_space, cnn_keys, mlp_keys, int(sum(actions_dim)), slab_rows)
-    ring = TrajectoryRing(alcfg.num_actors * alcfg.slots_per_actor, layout.nbytes)
     pack_device = train_device if train_device is not None else jax.local_devices()[0]
     streamer = _ParamStreamer(jax.device_get(params), pack_device)
-    lane = ParamLane(streamer.nbytes)
+    transport = build_learner_transport(
+        alcfg.transport,
+        payload_bytes=layout.nbytes,
+        num_slots=alcfg.num_actors * alcfg.slots_per_actor,
+        slots_per_actor=alcfg.slots_per_actor,
+        param_nbytes=streamer.nbytes,
+        host=alcfg.bind_host,
+        port=alcfg.bind_port,
+    )
 
     precision_name = fabric.precision.name
 
@@ -191,8 +198,7 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
                 "rollout_steps": rollout_steps,
                 "faults": faults,
                 "precision": precision_name,
-                "ring": ring.spec(),
-                "lane": lane.spec(),
+                "transport": transport.actor_wire(actor_index),
                 "layout": layout.to_wire(),
                 "trace_dir": trace_dir,
                 # seq-disjoint generations keep the fold_in action streams
@@ -202,10 +208,10 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
         )
 
     version = 0
-    lane.publish(np.asarray(streamer.begin(params)), version)
+    transport.publish_params(np.asarray(streamer.begin(params)), version)
     trace_event("param_publish", version=version)
 
-    supervisor = ActorSupervisor(alcfg, ring, make_blob, on_restart=telemetry_actor_restart)
+    supervisor = ActorSupervisor(alcfg, transport, make_blob, on_restart=telemetry_actor_restart)
     if trace_dir is not None:
         # declare the child trace files up front so the registry record names
         # the run's full file set even if an actor dies before its first slab
@@ -298,14 +304,14 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
 
     def sync_torn() -> None:
         nonlocal torn_seen
-        total = ring.torn_detected + supervisor.torn_reclaimed
+        total = transport.torn_detected + supervisor.torn_reclaimed
         if total > torn_seen:
-            telemetry_torn_slabs(total - torn_seen, source="ring")
+            telemetry_torn_slabs(total - torn_seen, source=transport.kind)
             torn_seen = total
         # terminate each victim's causal chain on the merged timeline: its
         # trace ends at `torn`, never at `slab_train`
-        for tid in ring.drain_torn_trace_ids():
-            trace_event("torn", tid, source="ring")
+        for tid in transport.drain_torn_trace_ids():
+            trace_event("torn", tid, source=transport.kind)
 
     def maybe_heartbeat(final: bool = False) -> None:
         nonlocal last_log, last_train, win_env_s, win_env_steps, win_train_s, win_wait_s
@@ -343,8 +349,6 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
 
     preempted = False
     probe = SteadyStateProbe()
-    num_slots = ring.num_slots
-    slot_cursor = 0
     try:
         supervisor.spawn_all()
         while update < num_updates:
@@ -359,18 +363,11 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
             if stall_until and time.monotonic() >= stall_until:
                 stall_until = 0.0
                 if published_version < version:
-                    lane.publish(np.asarray(streamer.begin(params)), version)
+                    transport.publish_params(np.asarray(streamer.begin(params)), version)
                     trace_event("param_publish", version=version, after_stall=True)
                     published_version = version
 
-            meta = None
-            for k in range(num_slots):
-                s = (slot_cursor + k) % num_slots
-                m = ring.poll(s)
-                if m is not None:
-                    slot_cursor = (s + 1) % num_slots
-                    meta = m
-                    break
+            meta = transport.poll()
             sync_torn()
             if meta is None:
                 t0 = time.perf_counter()
@@ -384,7 +381,7 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
 
             staleness = version - meta.param_version
             ok = admit(meta.param_version, version, alcfg.max_staleness)
-            telemetry_slab(staleness=staleness, occupancy=ring.occupancy(), admitted=ok)
+            telemetry_slab(staleness=staleness, occupancy=transport.occupancy(), admitted=ok)
             # commit→admit ring wait from the slab header's epoch-µs commit
             # stamp (same host, so the epoch clocks agree)
             ring_wait_us = (
@@ -403,7 +400,7 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
                         param_version=meta.param_version,
                         staleness=staleness,
                     )
-                ring.release(meta.slot)
+                transport.release(meta)
                 continue
             if meta.trace_id:
                 trace_event(
@@ -424,8 +421,8 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
                 if tel is not None:
                     tel.emit("spawn_wait", seconds=spawn_wait_s)
 
-            flat = layout.unpack(ring.payload_view(meta.slot))  # copies out
-            ring.release(meta.slot)
+            flat = layout.unpack(transport.payload(meta))  # copies out
+            transport.release(meta)
             ep_stats = flat.pop("ep_stats")
 
             telemetry_advance(policy_step)
@@ -485,7 +482,7 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
                 elif f.kind == "learner_kill":
                     os.kill(os.getpid(), signal.SIGTERM)
             if not stall_until:
-                lane.publish(np.asarray(streamer.begin(params)), version)
+                transport.publish_params(np.asarray(streamer.begin(params)), version)
                 trace_event("param_publish", version=version)
                 published_version = version
             admitted += 1
@@ -508,8 +505,7 @@ def run_actor_learner(fabric, cfg: Dict[str, Any], state: Optional[Dict[str, Any
         except Exception:
             pass
         sync_torn()
-        ring.close()
-        lane.close()
+        transport.close()
 
     probe.finish(policy_step, sync=lambda: jax.device_get(jax.tree.leaves(params)[0]))
     maybe_heartbeat(final=True)

@@ -6,13 +6,16 @@ deadlines, the same sanitized-environ spawn window.
 
 Differences from the env-pool supervisor:
 
-- actors are *push* producers (slabs ride the ring, not the pipe), so health
-  is checked by polling liveness+heartbeats (:meth:`check_health`) instead of
-  around a request/reply;
-- a restart first **reclaims the dead actor's ring slots** (the torn-write
-  check frees any slot stuck ``WRITING``) before respawning with a bumped
-  generation — the in-flight slab is abandoned by design and the fresh env
-  seeds are replayed deterministically from the generation counter;
+- actors are *push* producers (slabs ride the transport, not the pipe), so
+  health is checked by polling liveness+heartbeats (:meth:`check_health`)
+  instead of around a request/reply;
+- a restart first **reclaims the dead actor's transport capacity**
+  (:meth:`~sheeprl_tpu.net.transport.LearnerTransport.reclaim_actor`: shm
+  frees any ring slot stuck ``WRITING`` — the torn-write check — and tcp
+  bumps the generation floor + severs zombie connections) before respawning
+  with a bumped generation — the in-flight slab is abandoned by design and
+  the fresh env seeds are replayed deterministically from the generation
+  counter;
 - budget exhaustion raises :class:`ActorBudgetExhausted` (the run aborts with
   a distinct outcome) instead of masking: a masked env slot can serve zeros,
   a masked actor would silently shrink the training batch distribution.
@@ -21,10 +24,9 @@ Differences from the env-pool supervisor:
 from __future__ import annotations
 
 import time
-from typing import Callable, List, Optional
+from typing import TYPE_CHECKING, Callable, List, Optional
 
 from sheeprl_tpu.actor_learner.config import ActorLearnerConfig
-from sheeprl_tpu.actor_learner.ring import TrajectoryRing
 from sheeprl_tpu.rollout.supervisor import (
     RestartBudget,
     Supervisor,
@@ -33,6 +35,9 @@ from sheeprl_tpu.rollout.supervisor import (
     WorkerTimeout,
     _spawn_environ,
 )
+
+if TYPE_CHECKING:  # import cycle: net.transport wraps the ring this package owns
+    from sheeprl_tpu.net.transport import LearnerTransport
 
 
 class ActorBudgetExhausted(RuntimeError):
@@ -54,12 +59,12 @@ class ActorSupervisor(Supervisor):
     def __init__(
         self,
         config: ActorLearnerConfig,
-        ring: TrajectoryRing,
+        transport: "LearnerTransport",
         make_blob: Callable[[int, int], bytes],
         on_restart: Optional[Callable[[int, str, int], None]] = None,
     ) -> None:
         super().__init__(config, config.num_actors, on_restart=on_restart, on_mask=None)
-        self.ring = ring
+        self.transport = transport
         self.make_blob = make_blob
         self.generations: List[int] = [0] * config.num_actors
         self.handles: List[WorkerHandle] = [
@@ -89,7 +94,12 @@ class ActorSupervisor(Supervisor):
         self.heartbeats[handle.index] = time.time()
 
     def handshake(self, handle: WorkerHandle) -> None:  # type: ignore[override]
-        reply = self.wait_reply(handle, timeout=self.config.spawn_timeout_s)
+        # keep servicing the transport while blocked: a tcp actor's attach
+        # (dial + HELLO/ACK) happens BEFORE its ("ready",), so the learner
+        # must accept and answer during this wait or the boot deadlocks
+        reply = self.wait_reply(
+            handle, timeout=self.config.spawn_timeout_s, idle=self.transport.pump
+        )
         if reply[0] != "ready":
             raise WorkerDied(handle.index, f"bad handshake: {reply[0]!r}")
 
@@ -124,13 +134,15 @@ class ActorSupervisor(Supervisor):
 
     # --------------------------------------------------------------- restart
     def restart_actor(self, handle: WorkerHandle, reason: str) -> None:
-        """Kill + reclaim ring slots + backoff + respawn (bumped generation:
-        fresh deterministic env seeds, scripted faults NOT re-shipped)."""
+        """Kill + reclaim transport capacity + backoff + respawn (bumped
+        generation: fresh deterministic env seeds, scripted faults NOT
+        re-shipped)."""
         self.kill(handle)
         handle.restarts += 1
         # the abandoned in-flight slab: any WRITING slot of this actor is by
         # definition torn — free it so the ring never wedges on a dead writer
-        self.torn_reclaimed += self.ring.reclaim_actor_slots(handle.slots)
+        # (tcp: raise the generation floor so a zombie's late slab is stale)
+        self.torn_reclaimed += self.transport.reclaim_actor(handle.index, handle.slots)
         charge = handle.budget.charge() if handle.budget is not None else handle.restarts
         if self.on_restart is not None:
             self.on_restart(handle.index, reason, handle.restarts)

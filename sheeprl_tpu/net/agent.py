@@ -1,0 +1,382 @@
+"""Per-host replica agent: the remote end of the fleet's serving data plane.
+
+A :class:`ReplicaAgent` is the process that actually runs inference on a
+remote host. It owns a :class:`~sheeprl_tpu.serve.model.ServedPolicy` built
+from a committed checkpoint, compiles the AOT batch ladder once at boot, and
+then answers the fleet's :class:`~sheeprl_tpu.net.remote.RemoteReplica`
+over the shared frame protocol (:mod:`sheeprl_tpu.net.frame`):
+
+- ``HELLO`` → ``HELLO_ACK`` (JSON): the agent introduces its policy name and
+  rung set, echoes the peer's wall clock for the cross-host skew estimate,
+  and records a ``net_handshake`` trace event — the same seam the trace
+  merge uses to align actor→learner streams.
+- ``INFER`` (u64 batch id + pickled obs list) → ``RESULT`` (u64 batch id +
+  pickled per-request outputs). An inference exception travels back as a
+  ``RESULT`` with :data:`FLAG_ERROR` set and the repr as payload — the fleet
+  side counts it against its circuit breaker exactly like a local dispatch
+  failure, instead of tearing down the connection.
+- ``HEARTBEAT`` every ``hb_interval_s`` on every live connection, so the
+  fleet's hung-replica detector keeps seeing progress while a long dispatch
+  (or an idle link) produces no RESULT traffic.
+
+The agent is single-threaded and ``select``-pumped like the TCP learner
+transport — no background threads, so the static-analysis (jaxcheck) thread
+rules hold. Params are fixed at boot: hot-swap across hosts is out of scope
+for v0 (the fleet's swap machinery is same-process); restart the agent on a
+newer committed checkpoint instead (howto/multihost.md).
+
+``agent_child_main`` is the ``multiprocessing`` spawn entrypoint the drills
+use (blob-parameterised like the actor spawn path); ``main`` is the
+standalone CLI (``python -m sheeprl_tpu.net.agent --ckpt ...``) for real
+multi-host runs.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import select
+import socket
+import struct
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from sheeprl_tpu.net.frame import (
+    F_BYE,
+    F_HEARTBEAT,
+    F_HELLO,
+    F_HELLO_ACK,
+    F_INFER,
+    F_RESULT,
+    FrameDecoder,
+    ProtocolError,
+    encode_frame,
+)
+from sheeprl_tpu.net.stats import NetStats, net_stats
+
+# RESULT flag: payload is a pickled error repr, not outputs — the remote
+# dispatch failed but the connection (and the agent) are healthy
+FLAG_ERROR = 0x1
+
+_BATCH_ID = struct.Struct("<Q")
+
+
+def encode_batch_payload(batch_id: int, obj: Any) -> bytes:
+    """``INFER``/``RESULT`` payload: u64 LE batch id + pickled object."""
+    return _BATCH_ID.pack(batch_id) + pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def decode_batch_payload(payload: bytes) -> Tuple[int, Any]:
+    (batch_id,) = _BATCH_ID.unpack_from(payload)
+    return batch_id, pickle.loads(payload[_BATCH_ID.size :])
+
+
+class _AgentConn:
+    __slots__ = ("sock", "decoder", "peer")
+
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        self.decoder = FrameDecoder()
+        self.peer: Optional[str] = None  # set by HELLO
+
+
+class ReplicaAgent:
+    """One remote serving unit: listen socket + compiled ladder + pump loop.
+
+    Binding to port 0 picks an ephemeral port (``.port`` after construction)
+    — the localhost drills spawn the agent first and hand the bound address
+    to the fleet config, exactly like the TCP learner hands its port to the
+    actor spawn blob.
+    """
+
+    def __init__(
+        self,
+        policy: Any,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        rungs: Tuple[int, ...] = (1, 2, 4, 8),
+        hb_interval_s: float = 0.5,
+    ) -> None:
+        from sheeprl_tpu.serve.model import CompiledLadder
+
+        self.policy = policy
+        # compile before accepting: an acked HELLO means "ready to serve",
+        # mirroring warmup-precedes-routing on the local fleet
+        self.ladder = CompiledLadder(policy, list(rungs))
+        self.rungs = tuple(int(r) for r in rungs)
+        self.hb_interval_s = float(hb_interval_s)
+        self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind((host, int(port)))
+        self._listen.listen(8)
+        self._listen.setblocking(False)
+        self.host, self.port = self._listen.getsockname()[:2]
+        self.stats: NetStats = net_stats(f"tcp.agent.{self.port}")
+        self._conns: Dict[socket.socket, _AgentConn] = {}
+        self._last_hb = time.monotonic()
+        self.batches_served = 0
+        self.requests_served = 0
+        self._closed = False
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # ------------------------------------------------------------------- pump
+    def serve_forever(self, should_stop: Optional[Callable[[], bool]] = None) -> None:
+        while not self._closed and (should_stop is None or not should_stop()):
+            self.pump(0.05)
+
+    def pump(self, timeout: float = 0.0) -> None:
+        """One select cycle: heartbeats out, accepts, frames in."""
+        now = time.monotonic()
+        if self._conns and now - self._last_hb >= self.hb_interval_s:
+            self._last_hb = now
+            hb = encode_frame(F_HEARTBEAT, b"")
+            for sock in list(self._conns):
+                self._send(sock, hb, reason="heartbeat_send")
+        try:
+            readable, _, _ = select.select(
+                [self._listen, *self._conns], [], [], timeout
+            )
+        except (OSError, ValueError):
+            # a socket died between cycles; sweep it on the next recv
+            readable = list(self._conns)
+        for sock in readable:
+            if sock is self._listen:
+                self._accept()
+            else:
+                self._read(sock)
+
+    def _accept(self) -> None:
+        try:
+            sock, _addr = self._listen.accept()
+        except (BlockingIOError, OSError):
+            return
+        sock.setblocking(False)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._conns[sock] = _AgentConn(sock)
+
+    def _read(self, sock: socket.socket) -> None:
+        conn = self._conns.get(sock)
+        if conn is None:
+            return
+        try:
+            data = sock.recv(1 << 16)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._drop(sock, "recv_error")
+            return
+        if not data:
+            self._drop(sock, "peer_closed")
+            return
+        self.stats.bytes_recv += len(data)
+        before = conn.decoder.checksum_rejects
+        try:
+            frames = conn.decoder.feed(data)
+        except ProtocolError:
+            self._drop(sock, "protocol_error")
+            return
+        self.stats.checksum_rejects += conn.decoder.checksum_rejects - before
+        for ftype, flags, payload in frames:
+            self.stats.frames_recv += 1
+            self._handle(sock, conn, ftype, flags, payload)
+
+    # ---------------------------------------------------------------- frames
+    def _handle(
+        self, sock: socket.socket, conn: _AgentConn, ftype: int, flags: int, payload: bytes
+    ) -> None:
+        if ftype == F_HELLO:
+            self._handle_hello(sock, conn, payload)
+        elif ftype == F_INFER:
+            self._handle_infer(sock, payload)
+        elif ftype == F_BYE:
+            self._drop(sock, "bye")
+        # HEARTBEAT and unknown types: liveness only, nothing to do
+
+    def _handle_hello(self, sock: socket.socket, conn: _AgentConn, payload: bytes) -> None:
+        now_wall = time.time()
+        try:
+            hello = json.loads(payload.decode())
+        except Exception:
+            self._drop(sock, "bad_hello")
+            return
+        conn.peer = str(hello.get("role", "?"))
+        from sheeprl_tpu.obs.trace import trace_event
+
+        trace_event(
+            "net_handshake",
+            peer=conn.peer,
+            replica=hello.get("replica"),
+            generation=hello.get("generation"),
+            skew_s=now_wall - float(hello.get("t_wall", now_wall)),
+            transport="tcp.agent",
+        )
+        ack = {
+            "role": "agent",
+            "policy": self.policy.name,
+            "rungs": list(self.rungs),
+            "t_wall": now_wall,
+            "t_echo": hello.get("t_wall"),
+        }
+        self._send(sock, encode_frame(F_HELLO_ACK, json.dumps(ack).encode()), reason="ack_send")
+
+    def _handle_infer(self, sock: socket.socket, payload: bytes) -> None:
+        try:
+            batch_id, obs_list = decode_batch_payload(payload)
+        except Exception:
+            self._drop(sock, "bad_infer")
+            return
+        try:
+            import jax
+
+            outputs = self.ladder.run(self.policy.params, list(obs_list))
+            outputs = jax.device_get(outputs)  # host-side, picklable
+        except Exception as err:
+            reply = encode_frame(
+                F_RESULT, encode_batch_payload(batch_id, repr(err)), flags=FLAG_ERROR
+            )
+            self._send(sock, reply, reason="result_send")
+            return
+        self.batches_served += 1
+        self.requests_served += len(obs_list)
+        self._send(
+            sock, encode_frame(F_RESULT, encode_batch_payload(batch_id, outputs)),
+            reason="result_send",
+        )
+
+    # --------------------------------------------------------------- plumbing
+    def _send(self, sock: socket.socket, frame: bytes, *, reason: str) -> None:
+        try:
+            sock.sendall(frame)
+        except OSError:
+            self._drop(sock, reason)
+            return
+        self.stats.frames_sent += 1
+        self.stats.bytes_sent += len(frame)
+
+    def _drop(self, sock: socket.socket, reason: str) -> None:
+        conn = self._conns.pop(sock, None)
+        try:
+            sock.close()
+        except OSError:
+            pass
+        if conn is not None:
+            from sheeprl_tpu.net.transport import _net_event
+
+            _net_event(
+                "disconnect", transport="tcp.agent", peer=conn.peer, reason=reason
+            )
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        bye = encode_frame(F_BYE, b"")
+        for sock in list(self._conns):
+            try:
+                sock.sendall(bye)
+            except OSError:
+                pass
+            self._drop(sock, "agent_close")
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+
+
+def agent_child_main(conn: Any, blob: bytes) -> None:
+    """``multiprocessing`` spawn entrypoint (module-level: spawn pickles it
+    by name, like ``actor_main``). ``blob`` is a cloudpickled spec::
+
+        {"cfg": {...}, "state": {...},          # build_served_policy inputs
+         "host": "127.0.0.1", "port": 0,        # bind address (0 = ephemeral)
+         "rungs": [1, 2, 4, 8]}
+
+    Protocol on the pipe: child sends ``("ready", host, port)`` once serving,
+    parent sends ``("close",)`` to stop, child answers ``("bye",)``.
+    """
+    from sheeprl_tpu.rollout.worker import sanitize_worker_environ
+
+    sanitize_worker_environ()
+    agent: Optional[ReplicaAgent] = None
+    try:
+        import cloudpickle
+
+        spec: Dict[str, Any] = cloudpickle.loads(blob)
+        from sheeprl_tpu.serve.policy import build_served_policy
+
+        policy = build_served_policy(spec["cfg"], spec["state"])
+        agent = ReplicaAgent(
+            policy,
+            host=spec.get("host", "127.0.0.1"),
+            port=int(spec.get("port", 0)),
+            rungs=tuple(spec.get("rungs", (1, 2, 4, 8))),
+        )
+        conn.send(("ready", agent.host, agent.port))
+        while True:
+            if conn.poll(0):
+                msg = conn.recv()
+                if msg and msg[0] == "close":
+                    break
+            agent.pump(0.05)
+        conn.send(("bye", agent.batches_served, agent.requests_served))
+    except (EOFError, KeyboardInterrupt):
+        pass
+    except Exception as err:
+        try:
+            conn.send(("error", repr(err)))
+        except Exception:
+            pass
+    finally:
+        if agent is not None:
+            agent.close()
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Standalone agent: serve the newest committed checkpoint of a run.
+
+    ``python -m sheeprl_tpu.net.agent --ckpt-dir <run>/checkpoints \\
+        --host 0.0.0.0 --port 9431`` then point the fleet at it with
+    ``serve.fleet.remote_agents=[thathost:9431]`` (howto/multihost.md).
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(description=main.__doc__)
+    parser.add_argument("--ckpt-dir", required=True, help="checkpoint directory to serve from")
+    parser.add_argument("--algo", default="linear", help="policy builder name (cfg.algo.name)")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--rungs", type=int, nargs="+", default=[1, 2, 4, 8])
+    args = parser.parse_args(argv)
+
+    from sheeprl_tpu.serve.model import newest_committed
+    from sheeprl_tpu.serve.policy import build_served_policy
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+    ckpt = newest_committed(args.ckpt_dir)
+    if ckpt is None:
+        parser.error(f"no committed checkpoint under {args.ckpt_dir}")
+    state = load_checkpoint(ckpt.path)
+    policy = build_served_policy({"algo": {"name": args.algo}}, state)
+    agent = ReplicaAgent(
+        policy, host=args.host, port=args.port, rungs=tuple(args.rungs)
+    )
+    print(f"replica agent serving '{policy.name}' (step {ckpt.step}) on {agent.address}")
+    try:
+        agent.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        agent.close()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -1,0 +1,357 @@
+"""Fleet-side remote replica: a :class:`FleetReplica`-shaped thread whose
+"device" is a :class:`~sheeprl_tpu.net.agent.ReplicaAgent` on another host.
+
+The fleet adopts each ``serve.fleet.remote_agents`` endpoint as one
+:class:`~sheeprl_tpu.serve.fleet.FleetSlot` of kind ``remote``. The slot
+keeps everything the supervision doctrine needs local — the
+:class:`~sheeprl_tpu.serve.slots.SlotPool`, the restart budget, the batch
+counter, the stats — and this thread is just the incarnation that ferries
+batches over TCP instead of into a local dispatch:
+
+- ``take_batch`` → ``INFER`` frame (u64 batch id + pickled obs list) →
+  block for the matching ``RESULT`` within ``remote_timeout_s``, crediting
+  agent heartbeats to ``stats.beat()`` so a long remote dispatch is *slow*,
+  not *hung*.
+- delivery is byte-for-byte the local contract: hedge twins skipped,
+  expired requests shed, ``request_done`` trace event + request-path
+  telemetry with the same critical-path decomposition (``compute_ms`` here
+  includes the wire round-trip — the router's latency model sees the cost a
+  client actually pays).
+- an agent-side inference failure (``RESULT`` with ``FLAG_ERROR``) re-queues
+  the batch and counts against the local circuit breaker, exactly like a
+  local dispatch exception — the link stays up.
+- any transport failure (dial refused, mid-batch peer death, RESULT
+  timeout) kills the thread with ``exit_reason`` set. The batch stays in the
+  pool's in-flight window, so the fleet monitor's existing fault path
+  re-routes it at the front of a sibling (``inflight="all"`` — the thread is
+  dead) and schedules a budgeted restart, which for this kind *is* a
+  reconnect with a bumped generation. No new supervision machinery.
+
+Params never cross this link: the agent serves the checkpoint it loaded
+(hot-swap is same-process machinery; see :mod:`sheeprl_tpu.net.agent`).
+"""
+
+from __future__ import annotations
+
+import json
+import select
+import socket
+import threading
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+from sheeprl_tpu.net.agent import FLAG_ERROR, decode_batch_payload, encode_batch_payload
+from sheeprl_tpu.net.frame import (
+    F_BYE,
+    F_HEARTBEAT,
+    F_HELLO,
+    F_HELLO_ACK,
+    F_INFER,
+    F_RESULT,
+    FrameDecoder,
+    ProtocolError,
+    encode_frame,
+)
+from sheeprl_tpu.net.stats import NetStats, net_stats
+from sheeprl_tpu.net.transport import TransportError, _net_event
+
+
+def parse_addr(addr: str) -> Tuple[str, int]:
+    """``host:port`` → ``(host, port)`` (IPv4/hostname; the drills use
+    127.0.0.1)."""
+    host, sep, port = addr.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(f"remote agent address must be host:port, got {addr!r}")
+    return host, int(port)
+
+
+class RemoteReplica(threading.Thread):
+    """One fleet incarnation bound to a remote agent connection.
+
+    Mirrors :class:`~sheeprl_tpu.serve.fleet.FleetReplica`'s lifecycle
+    surface (``request_stop`` / ``kill`` / ``exit_reason`` / heartbeat via
+    ``stats.beat()``) so the slot supervision, the router and the chaos
+    drills treat both kinds identically.
+    """
+
+    def __init__(
+        self,
+        index: int,
+        *,
+        pool: Any,
+        addr: str,
+        stats: Any,
+        batch_counter: Any,
+        breaker_threshold: int,
+        timeout_s: float,
+        generation: int = 0,
+        connect_timeout_s: float = 10.0,
+        poll_timeout_s: float = 0.05,
+        on_batch: Optional[Callable[[int, float], None]] = None,
+        on_shed: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        super().__init__(name=f"fleet-remote-{index}", daemon=True)
+        self.index = index
+        self.pool = pool
+        self.addr = str(addr)
+        self.stats = stats
+        self._batch_counter = batch_counter
+        self.breaker_threshold = int(breaker_threshold)
+        self.timeout_s = float(timeout_s)
+        self.generation = int(generation)
+        self._connect_timeout_s = float(connect_timeout_s)
+        self._poll_timeout_s = float(poll_timeout_s)
+        self._on_batch = on_batch
+        self._on_shed = on_shed
+        self._stop_evt = threading.Event()
+        self._killed = threading.Event()
+        self.exit_reason: Optional[str] = None
+        self.sock: Optional[socket.socket] = None
+        self._decoder = FrameDecoder()
+        self.net: NetStats = net_stats(f"tcp.remote{index}")
+
+    def request_stop(self) -> None:
+        self._stop_evt.set()
+
+    def kill(self) -> None:
+        """Chaos entry point: die without completing in-flight futures —
+        identical contract to the local replica's kill."""
+        self._killed.set()
+        self._stop_evt.set()
+
+    # ------------------------------------------------------------------- loop
+    def run(self) -> None:  # pragma: no cover - exercised via the fleet drills
+        try:
+            self._connect()
+            self._loop()
+        except Exception as err:
+            self.exit_reason = f"crashed: {err!r}"
+        else:
+            self.exit_reason = "killed" if self._killed.is_set() else "stopped"
+        finally:
+            self._close_sock()
+
+    def _loop(self) -> None:
+        while not self._stop_evt.is_set() and not self.pool.closed:
+            self.stats.beat()
+            self._drain(0.0)  # agent heartbeats / BYE between batches
+            batch = self.pool.take_batch(self._poll_timeout_s)
+            if self._killed.is_set():
+                return  # batch (if any) stays in the in-flight window
+            if not batch:
+                continue
+            self._serve_batch(batch)
+
+    # ---------------------------------------------------------------- connect
+    def _connect(self) -> None:
+        host, port = parse_addr(self.addr)
+        try:
+            sock = socket.create_connection((host, port), timeout=self._connect_timeout_s)
+        except OSError as err:
+            raise TransportError(f"remote agent {self.addr} unreachable: {err}") from err
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.setblocking(False)
+        self.sock = sock
+        hello = {
+            "role": f"fleet{self.index}",
+            "replica": self.index,
+            "generation": self.generation,
+            "t_wall": time.time(),
+        }
+        self._send(encode_frame(F_HELLO, json.dumps(hello).encode()))
+        deadline = time.monotonic() + self._connect_timeout_s
+        ack_payload = self._await(F_HELLO_ACK, deadline)
+        now_wall = time.time()
+        try:
+            ack = json.loads(ack_payload.decode())
+        except Exception as err:
+            raise TransportError(f"remote agent {self.addr} sent a bad HELLO_ACK") from err
+        from sheeprl_tpu.obs.trace import trace_event
+
+        trace_event(
+            "net_handshake",
+            peer="agent",
+            replica=self.index,
+            generation=self.generation,
+            policy=ack.get("policy"),
+            skew_s=now_wall - float(ack.get("t_wall", now_wall)),
+            transport="tcp",
+        )
+        self.stats.beat()
+
+    # ------------------------------------------------------------------ serve
+    def _serve_batch(self, batch: List[Any]) -> None:
+        batch_id = next(self._batch_counter)
+        t0 = time.monotonic()
+        obs_list = [req.obs for req in batch]
+        self._send(encode_frame(F_INFER, encode_batch_payload(batch_id, obs_list)))
+        t_sent = time.monotonic()
+        flags, result = self._await_result(batch_id, t_sent + self.timeout_s)
+        t_done = time.monotonic()
+        if self._killed.is_set():
+            return  # die before delivery: futures stay pending → re-routed
+        if flags & FLAG_ERROR:
+            # remote dispatch failed, link healthy: local breaker semantics
+            self.stats.failures += 1
+            self.stats.consecutive_failures += 1
+            self.pool.requeue_failed(batch)
+            if self.stats.consecutive_failures >= self.breaker_threshold:
+                raise RuntimeError(
+                    f"circuit breaker open after {self.stats.consecutive_failures} "
+                    f"consecutive remote inference failures ({result})"
+                )
+            return
+        outputs = result
+        latency_s = t_done - t0
+        self.stats.consecutive_failures = 0
+        self.stats.batches += 1
+        self.stats.requests += len(batch)
+        self.stats.beat()
+        now = time.monotonic()
+        from sheeprl_tpu.obs.telemetry import telemetry_request_path
+        from sheeprl_tpu.obs.trace import trace_event
+        from sheeprl_tpu.serve.slots import safe_complete
+
+        for req, out in zip(batch, outputs):
+            if req.future.done():
+                continue  # hedge twin won
+            if req.expired(now):
+                req.fail_expired(now)
+                if self._on_shed is not None:
+                    try:
+                        self._on_shed("expired")
+                    except Exception:
+                        pass
+            else:
+                delivered = safe_complete(req, out)
+                if delivered and req.trace_id:
+                    # same decomposition as the local replica; compute_ms is
+                    # send→result and therefore includes the wire round-trip
+                    queue_wait_ms = (t0 - req.enqueue_t) * 1e3
+                    assembly_ms = (t_sent - t0) * 1e3
+                    compute_ms = (t_done - t_sent) * 1e3
+                    hedged = len(getattr(req, "placements", ())) > 1
+                    rerouted = getattr(req, "rerouted", 0) > 0
+                    trace_event(
+                        "request_done",
+                        req.trace_id,
+                        rid=req.rid,
+                        replica=self.index,
+                        remote=self.addr,
+                        batch=len(batch),
+                        queue_wait_ms=queue_wait_ms,
+                        assembly_ms=assembly_ms,
+                        compute_ms=compute_ms,
+                        hedged=hedged,
+                        rerouted=rerouted,
+                    )
+                    telemetry_request_path(
+                        queue_wait_ms=queue_wait_ms,
+                        assembly_ms=assembly_ms,
+                        compute_ms=compute_ms,
+                        hedged=hedged,
+                        rerouted=rerouted,
+                    )
+        self.pool.complete_batch(batch)
+        if self._on_batch is not None:
+            try:
+                self._on_batch(len(batch), latency_s)
+            except Exception:
+                pass
+
+    def _await_result(self, batch_id: int, deadline: float) -> Tuple[int, Any]:
+        """Block for ``RESULT(batch_id)``, crediting heartbeats as liveness.
+        Frames for other ids (a previous incarnation's late answer cannot
+        happen — each incarnation dials a fresh connection) are dropped."""
+        while True:
+            for ftype, flags, payload in self._drain(min(0.05, self._poll_timeout_s)):
+                if ftype == F_RESULT:
+                    got_id, obj = decode_batch_payload(payload)
+                    if got_id == batch_id:
+                        return flags, obj
+            if self._killed.is_set():
+                return 0, []  # caller returns immediately: no delivery
+            if time.monotonic() >= deadline:
+                self.net.heartbeat_gaps += 1
+                _net_event(
+                    "remote_timeout",
+                    transport=f"tcp.remote{self.index}",
+                    addr=self.addr,
+                    timeout_s=self.timeout_s,
+                )
+                raise TransportError(
+                    f"remote agent {self.addr}: no RESULT within {self.timeout_s}s"
+                )
+
+    # --------------------------------------------------------------- plumbing
+    def _send(self, frame: bytes) -> None:
+        assert self.sock is not None
+        try:
+            self.sock.setblocking(True)
+            self.sock.sendall(frame)
+            self.sock.setblocking(False)
+        except OSError as err:
+            raise TransportError(f"remote agent {self.addr}: send failed: {err}") from err
+        self.net.frames_sent += 1
+        self.net.bytes_sent += len(frame)
+
+    def _drain(self, timeout: float) -> List[Tuple[int, int, bytes]]:
+        """Read whatever is on the wire; heartbeats beat, BYE/peer-death
+        raise (the supervision path turns that into reroute + reconnect)."""
+        assert self.sock is not None
+        try:
+            readable, _, _ = select.select([self.sock], [], [], timeout)
+        except (OSError, ValueError) as err:
+            raise TransportError(f"remote agent {self.addr}: socket lost: {err}") from err
+        if not readable:
+            return []
+        try:
+            data = self.sock.recv(1 << 16)
+        except (BlockingIOError, InterruptedError):
+            return []
+        except OSError as err:
+            raise TransportError(f"remote agent {self.addr}: recv failed: {err}") from err
+        if not data:
+            _net_event("disconnect", transport=f"tcp.remote{self.index}", addr=self.addr)
+            raise TransportError(f"remote agent {self.addr} closed the connection")
+        self.net.bytes_recv += len(data)
+        before = self._decoder.checksum_rejects
+        try:
+            frames = self._decoder.feed(data)
+        except ProtocolError as err:
+            raise TransportError(f"remote agent {self.addr}: {err}") from err
+        self.net.checksum_rejects += self._decoder.checksum_rejects - before
+        out: List[Tuple[int, int, bytes]] = []
+        for ftype, flags, payload in frames:
+            self.net.frames_recv += 1
+            if ftype == F_HEARTBEAT:
+                self.stats.beat()
+            elif ftype == F_BYE:
+                raise TransportError(f"remote agent {self.addr} said BYE")
+            else:
+                out.append((ftype, flags, payload))
+        return out
+
+    def _await(self, want_ftype: int, deadline: float) -> bytes:
+        while True:
+            for ftype, _flags, payload in self._drain(0.05):
+                if ftype == want_ftype:
+                    return payload
+            if time.monotonic() >= deadline:
+                raise TransportError(
+                    f"remote agent {self.addr}: timed out waiting for frame {want_ftype}"
+                )
+
+    def _close_sock(self) -> None:
+        if self.sock is None:
+            return
+        try:
+            self.sock.setblocking(True)
+            self.sock.sendall(encode_frame(F_BYE, b""))
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.sock = None

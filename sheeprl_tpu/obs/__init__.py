@@ -45,6 +45,7 @@ from sheeprl_tpu.obs.telemetry import (
     telemetry_mark_warm,
     telemetry_masked_slot,
     telemetry_nan_rollback,
+    telemetry_net_event,
     telemetry_preemption,
     telemetry_register_flops,
     telemetry_request_path,
@@ -107,6 +108,7 @@ __all__ = [
     "telemetry_mark_warm",
     "telemetry_masked_slot",
     "telemetry_nan_rollback",
+    "telemetry_net_event",
     "telemetry_preemption",
     "telemetry_register_flops",
     "telemetry_request_path",

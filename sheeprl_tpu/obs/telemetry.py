@@ -229,6 +229,11 @@ class RunTelemetry:
         # serve_stats snapshot; supervision/swap events are counted by kind
         self._serve_last_stats: Optional[Dict[str, Any]] = None
         self._serve_events: Dict[str, int] = {}
+        # multi-host data plane (sheeprl_tpu.net): sparse transport events
+        # (reconnect, checksum_reject, heartbeat_gap, torn_frame) are counted
+        # by kind here; the dense per-frame/byte counters accumulate in
+        # net.stats and are snapshotted into the run_end `net` section
+        self._net_events: Dict[str, int] = {}
         # AOT executable cache (sheeprl_tpu.ops.aotcache): deserialized-load
         # hits vs compile fallbacks plus staged-store outcomes — one
         # `aot_cache` event per action + run_end totals
@@ -509,6 +514,40 @@ class RunTelemetry:
         self._serve_events[kind] = self._serve_events.get(kind, 0) + 1
         self.emit("serve_event", kind=kind, **fields)
         self.writer.flush()
+
+    def record_net_event(self, kind: str, **fields: Any) -> None:
+        """One data-plane transport event (``reconnect``, ``checksum_reject``,
+        ``heartbeat_gap``, ``torn_frame``, ``stale_slab``, ``disconnect``,
+        ``transport_close``): a ``net_event`` line + run_end per-kind
+        counters, mirroring the serve/rollout event pattern."""
+        self._net_events[kind] = self._net_events.get(kind, 0) + 1
+        self.emit("net_event", kind=kind, **fields)
+        self.writer.flush()
+
+    def _net_section(self) -> Dict[str, Any]:
+        """The run_end/run_summary ``net`` section: per-kind sparse event
+        counts plus every registered transport endpoint's frame/byte/reconnect
+        counters (``bench.py --net-stats`` reads this path)."""
+        section: Dict[str, Any] = {"events": dict(self._net_events)}
+        try:
+            from sheeprl_tpu.net.stats import net_stats_snapshot
+
+            counters = net_stats_snapshot()
+        except Exception:
+            counters = {}
+        if counters:
+            section["transports"] = counters
+        return section
+
+    def _net_active(self) -> bool:
+        if self._net_events:
+            return True
+        try:
+            from sheeprl_tpu.net.stats import net_stats_snapshot
+
+            return bool(net_stats_snapshot())
+        except Exception:
+            return False
 
     def record_aot_cache(self, action: str, tag: str = "", **fields: Any) -> None:
         """One executable-cache outcome (``hit`` / ``miss`` / ``store`` /
@@ -920,6 +959,8 @@ class RunTelemetry:
             summary["mfu"] = self._last_mfu
         if self._serve_last_stats is not None or self._serve_events:
             summary["serve"] = self._serve_section()
+        if self._net_active():
+            summary["net"] = self._net_section()
         captures = self.profile_captures or (self.profiler.captures if self.profiler is not None else [])
         if captures:
             summary["profile_captures"] = [dict(c) for c in captures]
@@ -968,6 +1009,9 @@ class RunTelemetry:
         # consumers keep seeing exactly the fields they already parse
         if self._serve_last_stats is not None or self._serve_events:
             extra_fields["serve"] = self._serve_section()
+        # likewise the `net` section: only runs that touched a transport
+        if self._net_active():
+            extra_fields["net"] = self._net_section()
         # same for the trace-plane critical-path rollups: only runs that
         # recorded slab/request decompositions carry them
         slab_lag = self._slab_lag_section()
@@ -1276,6 +1320,14 @@ def telemetry_serve_event(kind: str, **fields: Any) -> None:
     tel = _active_telemetry
     if tel is not None:
         tel.record_serve_event(kind, **fields)
+
+
+def telemetry_net_event(kind: str, **fields: Any) -> None:
+    """Record a data-plane transport event (see
+    :meth:`RunTelemetry.record_net_event`); no-op when telemetry is off."""
+    tel = _active_telemetry
+    if tel is not None:
+        tel.record_net_event(kind, **fields)
 
 
 def telemetry_child_file(path: str) -> None:

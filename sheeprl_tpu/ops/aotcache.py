@@ -63,6 +63,34 @@ def _canonical(obj: Any) -> str:
     return json.dumps(obj, sort_keys=True, separators=(",", ":"), default=str)
 
 
+# serializes toggles of the global trace-cache config in _compile_serializable
+_COMPILE_CONFIG_LOCK = threading.Lock()
+
+
+def _compile_serializable(compile_fn: Callable[[], Any]) -> Any:
+    """Run ``compile_fn`` with the persistent XLA trace cache disabled.
+
+    An executable whose compile *hits* that cache deserializes fine for
+    dispatch but does not survive ``serialize_executable`` — the payload
+    loads with "Symbols not found" (CPU backend), so :meth:`AotCache.store`'s
+    round-trip verification refuses it and the AOT tier silently never
+    populates. The trace cache buys nothing here anyway: this tier caches
+    the final executable, one level above it. Restored on exit so every
+    other compile in the process keeps the trace cache."""
+    with _COMPILE_CONFIG_LOCK:
+        try:
+            prev = jax.config.jax_compilation_cache_dir
+        except AttributeError:
+            return compile_fn()
+        if prev is None:
+            return compile_fn()
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+            return compile_fn()
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+
+
 def _leaf_aval(leaf: Any) -> Tuple[Any, ...]:
     """(shape, dtype, weak_type) of a leaf — arrays, ShapeDtypeStructs and
     Python scalars alike — without materializing anything on device."""
@@ -314,6 +342,10 @@ class AotCache:
             tmp = None
         except Exception as err:
             self.errors += 1
+            if os.environ.get("SHEEPRL_TPU_AOT_DEBUG"):
+                import traceback
+
+                traceback.print_exc()
             telemetry_aot_cache("store_failed", key.tag, digest=key.digest, error=repr(err))
             if tmp is not None and os.path.exists(tmp):
                 try:
@@ -337,7 +369,7 @@ class AotCache:
         fn = self.load(key)
         if fn is not None:
             return fn, True
-        compiled = compile_fn()
+        compiled = _compile_serializable(compile_fn)
         self.store(key, compiled, sync=sync_store)
         return compiled, False
 

@@ -152,7 +152,7 @@ class Fabric:
         compilation_cache_dir: Optional[str] = None,
         aot_cache_dir: Optional[str] = None,
     ) -> None:
-        self._maybe_init_distributed(distributed_coordinator, num_processes, process_id)
+        group_size = self._maybe_init_distributed(distributed_coordinator, num_processes, process_id)
         if accelerator not in ("auto", "tpu", "cpu", "gpu"):
             raise ValueError(f"unknown accelerator {accelerator!r}")
         if accelerator == "cpu":
@@ -161,7 +161,7 @@ class Fabric:
                 jax.config.update("jax_platforms", "cpu")
             except RuntimeError:
                 pass  # backend already initialized; devices below reflect it
-        self.compilation_cache_dir = self._configure_compilation_cache(compilation_cache_dir)
+        self.compilation_cache_dir = self._configure_compilation_cache(compilation_cache_dir, group_size)
         # AOT *executable* cache (ops/aotcache, howto/aot_cache.md): one tier
         # above the trace cache — the fused-superstep builders serialize
         # whole compiled windows through it so a preemption-resume skips the
@@ -201,16 +201,28 @@ class Fabric:
         self.data_axis = axes[0]
 
     @staticmethod
-    def _configure_compilation_cache(cache_dir: Optional[str]) -> Optional[str]:
+    def _configure_compilation_cache(cache_dir: Optional[str], group_size: int = 1) -> Optional[str]:
         """Point JAX's persistent compilation cache at
         ``fabric.compilation_cache_dir`` (default off) so restarts and
         resumes skip the multi-minute retrace of the train programs. The
         min-compile-time/min-entry-size gates are zeroed so even the small
         kernels (buffer writes, gathers) persist — the cache-outcome
-        telemetry (``compile_cache`` events) counts every request."""
+        telemetry (``compile_cache`` events) counts every request.
+
+        With ``group_size`` > 1 the cache is refused on the CPU backend and
+        suffixed per group size elsewhere: the trace cache keys on HLO +
+        device assignment but NOT on process topology, and a gloo
+        cross-process CPU executable does not even survive a warm-cache
+        reload of its own topology — both failure modes deserialize an
+        executable whose collectives no longer reach the group and compute
+        garbage without erroring."""
         if not cache_dir:
             return None
         path = os.path.abspath(os.path.expanduser(str(cache_dir)))
+        if group_size > 1:
+            if jax.default_backend() == "cpu":
+                return None
+            path = f"{path}-p{group_size}"
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         for flag, value in (
@@ -226,15 +238,16 @@ class Fabric:
     @staticmethod
     def _maybe_init_distributed(
         coordinator: Optional[str], num_processes: Optional[int], process_id: Optional[int]
-    ) -> None:
+    ) -> int:
         """DCN process-group bring-up (replaces TorchCollective.setup,
-        ppo_decoupled.py:645-649). No-op on a single host."""
+        ppo_decoupled.py:645-649). No-op on a single host. Returns the
+        process-group size (1 when not distributed)."""
         if coordinator is None and "SHEEPRL_TPU_COORDINATOR" in os.environ:
             coordinator = os.environ["SHEEPRL_TPU_COORDINATOR"]
             num_processes = int(os.environ["SHEEPRL_TPU_NUM_PROCESSES"]) if "SHEEPRL_TPU_NUM_PROCESSES" in os.environ else None
             process_id = int(os.environ["SHEEPRL_TPU_PROCESS_ID"]) if "SHEEPRL_TPU_PROCESS_ID" in os.environ else None
         if coordinator is None:
-            return
+            return 1
         # a configured coordinator with a missing/1 process count is a broken
         # launch, not a single-host run: every host would train independently
         # as process 0 with no cross-host reduction
@@ -243,6 +256,13 @@ class Fabric:
                 "distributed coordinator is set but num_processes/process_id are not — set "
                 "SHEEPRL_TPU_NUM_PROCESSES (> 1) and SHEEPRL_TPU_PROCESS_ID on every host"
             )
+        # CPU multi-process meshes need the gloo collectives client (the
+        # default CPU backend refuses cross-process computations outright);
+        # harmless on TPU hosts, where it only governs their cpu devices
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # knob not present in this jax version
         # NOTE: do not probe jax.process_count() here — it initializes the
         # backend, after which distributed init is impossible; initialize
         # eagerly and tolerate an already-connected process group
@@ -268,6 +288,20 @@ class Fabric:
                 f"{jax.process_count()} — initialize jax.distributed before any JAX computation "
                 "(or let Fabric do it by constructing it first)"
             )
+        # The persistent trace cache cannot round-trip a gloo cross-process
+        # CPU executable: a warm-cache run — even of the SAME topology that
+        # wrote the entry — deserializes an executable whose collectives no
+        # longer reach the group and computes garbage without erroring.
+        # Disable any env-configured cache for multi-process CPU groups
+        # (jax already copied the env value into config at import, so the
+        # config update is the one that matters). TPU groups keep theirs.
+        if jax.default_backend() == "cpu":
+            os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+            try:
+                jax.config.update("jax_compilation_cache_dir", None)
+            except Exception:
+                pass
+        return num_processes
 
     # ------------------------------------------------------------------ #
     # topology

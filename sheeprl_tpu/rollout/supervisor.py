@@ -28,7 +28,7 @@ from __future__ import annotations
 import contextlib
 import os
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from sheeprl_tpu.rollout.shm import ShmSpec
 from sheeprl_tpu.rollout.worker import sanitize_worker_environ, worker_main
@@ -219,16 +219,25 @@ class Supervisor:
         self.kill(handle)
 
     # ----------------------------------------------------------------- waits
-    def wait_reply(self, handle: WorkerHandle, timeout: Optional[float] = None) -> Tuple[Any, ...]:
+    def wait_reply(
+        self,
+        handle: WorkerHandle,
+        timeout: Optional[float] = None,
+        idle: Optional[Callable[[], None]] = None,
+    ) -> Tuple[Any, ...]:
         """Block until ``handle`` replies. The deadline is heartbeat-aware:
         it extends to ``last_heartbeat + timeout`` while the worker shows
         progress, so per-batch work scales with envs-per-worker without a
-        matching timeout bump."""
+        matching timeout bump. ``idle`` runs every poll cycle — the TCP
+        actor-learner transport uses it to keep servicing handshakes while
+        the supervisor blocks here."""
         timeout = self.config.step_timeout_s if timeout is None else float(timeout)
         grace = self.config.heartbeat_grace
         start = time.time()
         conn = handle.conn
         while True:
+            if idle is not None:
+                idle()
             if conn.poll(0.02):
                 try:
                     reply = conn.recv()

@@ -54,6 +54,11 @@ class FleetConfig:
     scale_up_depth: float = 4.0  # avg queued per active replica that adds one
     scale_down_depth: float = 0.5  # avg queued per active replica that retires one
     scale_patience: int = 3  # consecutive breaches before acting
+    # remote replicas: per-host agent processes (howto/multihost.md) the fleet
+    # adopts over TCP — "host:port" endpoints, one slot each. A remote slot is
+    # routed exactly like a device slot; its restarts are reconnects.
+    remote_agents: List[str] = field(default_factory=list)
+    remote_timeout_s: float = 10.0  # per-batch reply deadline on the agent link
 
     def __post_init__(self) -> None:
         if self.min_replicas < 1:
@@ -82,6 +87,10 @@ class FleetConfig:
             raise ValueError(
                 f"serve.fleet.backlog_per_replica must be >= 1, got {self.backlog_per_replica}"
             )
+        if self.remote_timeout_s <= 0:
+            raise ValueError(
+                f"serve.fleet.remote_timeout_s must be > 0, got {self.remote_timeout_s}"
+            )
 
     def resolved_max_pending(self, serve: "ServeConfig") -> int:
         """The fleet-wide admission bound: explicit, else every active
@@ -90,7 +99,7 @@ class FleetConfig:
         if self.max_pending is not None:
             return int(self.max_pending)
         per_replica = serve.max_batch + self.backlog_per_replica
-        return per_replica * (self.num_replicas + self.cpu_spill_replicas)
+        return per_replica * (self.num_replicas + self.cpu_spill_replicas + len(self.remote_agents))
 
 
 @dataclass
@@ -185,6 +194,8 @@ def serve_config_from_cfg(cfg: Mapping[str, Any]) -> ServeConfig:
         scale_up_depth=float(_get(fleet_node, "scale_up_depth", 4.0)),
         scale_down_depth=float(_get(fleet_node, "scale_down_depth", 0.5)),
         scale_patience=int(_get(fleet_node, "scale_patience", 3)),
+        remote_agents=[str(a) for a in (_get(fleet_node, "remote_agents") or [])],
+        remote_timeout_s=float(_get(fleet_node, "remote_timeout_s", 10.0)),
     )
     load_node = _get(node, "load") or {}
     load = LoadConfig(

@@ -58,6 +58,7 @@ from sheeprl_tpu.serve.slots import SlotPool, safe_complete
 
 DEVICE = "device"
 CPU_SPILL = "cpu_spill"
+REMOTE = "remote"  # per-host agent adopted over TCP (sheeprl_tpu.net.remote)
 
 
 class FleetReplica(threading.Thread):
@@ -258,6 +259,7 @@ class FleetSlot:
         self.index = index
         self.kind = kind
         self.device: Any = None
+        self.remote_addr: Optional[str] = None  # REMOTE slots: agent host:port
         self.pool = SlotPool(
             capacity=config.max_batch,
             backlog_bound=config.fleet.backlog_per_replica,
@@ -265,7 +267,7 @@ class FleetSlot:
         )
         self.batch_counter = itertools.count()
         self.budget = RestartBudget(config.max_restarts, config.restart_refund_s)
-        self.thread: Optional[FleetReplica] = None
+        self.thread: Optional[Any] = None  # FleetReplica | net.remote.RemoteReplica
         self.stats: Optional[ReplicaStats] = None
         self.ladder: Optional[CompiledLadder] = None
         self.active = False  # routable position (autoscaler toggles)
@@ -371,6 +373,18 @@ class FleetServer:
             )
             slot.device = spill_devices[j % len(spill_devices)] if spill_devices else None
             self.slots.append(slot)
+        for k, addr in enumerate(fleet.remote_agents):
+            # a per-host agent adopted as one slot: the pool/budget/counter
+            # live HERE, so re-route-at-front and budgeted restarts (which
+            # for this kind are reconnects) run on unchanged machinery
+            slot = FleetSlot(
+                fleet.max_replicas + fleet.cpu_spill_replicas + k,
+                REMOTE,
+                self.config,
+                obs_spec=self.policy.obs_spec,
+            )
+            slot.remote_addr = str(addr)
+            self.slots.append(slot)
 
         base_ladder = self._ladder_for(None)
         self.warmup_s = dict(base_ladder.compile_s)
@@ -386,7 +400,8 @@ class FleetServer:
             if slot.kind == DEVICE and slot.index >= fleet.num_replicas:
                 continue  # standby: warms at activation
             slot.active = True
-            slot.ladder = self._ladder_for(slot.device)
+            if slot.kind != REMOTE:  # remote compute lives agent-side
+                slot.ladder = self._ladder_for(slot.device)
             self._spawn(slot)
 
         self.router = Router(
@@ -566,6 +581,9 @@ class FleetServer:
                 1 for s in self.slots if s.kind == DEVICE and s.active and not s.masked
             ),
             "cpu_spill_replicas": sum(1 for s in self.slots if s.kind == CPU_SPILL and s.active),
+            "remote_replicas": sum(
+                1 for s in self.slots if s.kind == REMOTE and s.active and not s.masked
+            ),
             "scale_ups": self.scale_ups,
             "scale_downs": self.scale_downs,
             "router": self.router.snapshot() if self.router else {},
@@ -574,6 +592,7 @@ class FleetServer:
                     "index": s.index,
                     "kind": s.kind,
                     "device": str(s.device) if s.device is not None else None,
+                    "remote": s.remote_addr,
                     "active": s.active,
                     "alive": s.alive,
                     "masked": s.masked,
@@ -802,6 +821,27 @@ class FleetServer:
                     self.config.monitor_interval_s, 0.05
                 )
                 return
+        if slot.kind == REMOTE:
+            from sheeprl_tpu.net.remote import RemoteReplica
+
+            slot.stats = ReplicaStats()
+            # generation rides the restart count: the agent's handshake trace
+            # distinguishes a reconnect from a first attach, mirroring the
+            # actor transport's generation bump
+            slot.thread = RemoteReplica(
+                slot.index,
+                pool=slot.pool,
+                addr=slot.remote_addr,
+                stats=slot.stats,
+                batch_counter=slot.batch_counter,
+                breaker_threshold=self.config.breaker_threshold,
+                timeout_s=self.config.fleet.remote_timeout_s,
+                generation=slot.restarts,
+                on_batch=self.stats.record_batch,
+                on_shed=self.stats.record_shed,
+            )
+            slot.thread.start()
+            return
         if slot.ladder is None:
             slot.ladder = self._ladder_for(slot.device)
         slot.stats = ReplicaStats()

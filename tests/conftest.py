@@ -61,6 +61,19 @@ def run_multi_process(code: str, argv=(), cwd=None, extra_env=None, timeout=540,
     import subprocess
     import sys
 
+    # the gloo CPU collectives client must be selected before the worker's
+    # jax.distributed.initialize — without it the CPU backend refuses
+    # cross-process computations. Prepended here so every multi-process
+    # worker snippet gets it (the production path sets the same knob in
+    # Fabric._maybe_init_distributed).
+    code = (
+        "import jax as _jax_boot\n"
+        "try:\n"
+        '    _jax_boot.config.update("jax_cpu_collectives_implementation", "gloo")\n'
+        "except Exception:\n"
+        "    pass\n"
+    ) + code
+
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -75,6 +88,14 @@ def run_multi_process(code: str, argv=(), cwd=None, extra_env=None, timeout=540,
             env.pop("SHEEPRL_TPU_PROCESS_ID", None)
             env["JAX_PLATFORMS"] = "cpu"
             env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={device_count}"
+            # the persistent trace cache is unusable in gloo worker groups:
+            # it neither keys on process topology (a single-process run of
+            # the same global program poisons it) nor round-trips a gloo
+            # executable from a warm cache of the SAME topology — either way
+            # the deserialized collectives silently compute garbage. Fabric
+            # drops it too (_maybe_init_distributed); stripping it here also
+            # covers workers that call jax.distributed.initialize directly.
+            env.pop("JAX_COMPILATION_CACHE_DIR", None)
             env["TEST_COORD"] = f"127.0.0.1:{port}"
             env["TEST_NPROC"] = str(nproc)
             env["TEST_PID"] = str(pid)

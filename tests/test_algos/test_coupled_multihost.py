@@ -9,6 +9,8 @@ multi-host prefetch pipeline), runs the shard_map'd train step with its grad
 pmean over the 4-device mesh, and writes its rank's checkpoint shard.
 """
 
+import pytest
+
 from tests.conftest import find_checkpoints, run_multi_process
 
 RUNNER = """
@@ -56,6 +58,7 @@ def test_ppo_coupled_two_process(tmp_path):
     assert len(ckpts) >= 1, "coupled multi-host PPO wrote no checkpoint"
 
 
+@pytest.mark.slow
 def test_dreamer_v3_coupled_two_process(tmp_path):
     args = [
         "exp=dreamer_v3",

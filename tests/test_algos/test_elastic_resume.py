@@ -11,6 +11,7 @@ resume. These tests drive DV3 end to end on the virtual CPU mesh: shrink
 """
 
 import os
+import pytest
 
 from sheeprl_tpu.cli import run
 from sheeprl_tpu.utils.checkpoint import load_checkpoint
@@ -50,6 +51,7 @@ def _save_then_resume(tmp_path, save_overrides, resume_overrides):
     return saved, resumed
 
 
+@pytest.mark.slow
 def test_dv3_save_on_8_resume_on_4(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     saved, resumed = _save_then_resume(tmp_path, ["fabric.devices=8"], ["fabric.devices=4"])
@@ -58,6 +60,7 @@ def test_dv3_save_on_8_resume_on_4(tmp_path, monkeypatch):
     assert resumed["batch_size"] == 8
 
 
+@pytest.mark.slow
 def test_dv3_save_on_4_resume_on_8(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     saved, resumed = _save_then_resume(
@@ -67,6 +70,7 @@ def test_dv3_save_on_4_resume_on_8(tmp_path, monkeypatch):
     assert resumed["batch_size"] == 8
 
 
+@pytest.mark.slow
 def test_dv3_model_axis_checkpoint_resumes_on_dp_mesh(tmp_path, monkeypatch):
     """Topology change ACROSS mesh kinds: a checkpoint trained with param
     sharding on a (data=2, model=4) mesh resumes on a plain 8-wide DP mesh —

@@ -83,6 +83,7 @@ def test_dreamer_v3_fused_host_buffer_pregathers(tmp_path, monkeypatch):
     assert find_checkpoints(tmp_path)
 
 
+@pytest.mark.slow
 def test_dreamer_v3_fused_multi_device_single_dispatch_per_window(tmp_path, monkeypatch, recwarn):
     """ISSUE acceptance: on a pure data-parallel mesh the fused path no
     longer falls back — the whole K-step scan runs under shard_map over the

@@ -54,6 +54,7 @@ def test_p2e_dv2_exploration(tmp_path, monkeypatch, env_id):
     assert find_checkpoints(tmp_path)
 
 
+@pytest.mark.slow
 def test_p2e_dv2_exploration_to_finetuning_roundtrip(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     run(expl_args(tmp_path))

@@ -10,6 +10,8 @@ multi-process path that the in-process 8-device mesh tests cannot reach.
 
 import os
 
+import pytest
+
 from tests.conftest import find_checkpoints, run_multi_process, run_two_process
 
 RUNNER = """
@@ -97,6 +99,7 @@ def test_ppo_decoupled_three_process_two_trainers(tmp_path):
     assert find_checkpoints(tmp_path), "no checkpoint written by the 3-process run"
 
 
+@pytest.mark.slow
 def test_ppo_decoupled_resume(tmp_path):
     """Checkpoint mid-run (update 2 of 4), then resume from it and finish:
     the decoupled topology restores params, optimizer state, counters and

@@ -4,6 +4,7 @@ Pixel + vector continuous control with the autoencoder path on the dummy
 continuous env."""
 
 import os
+import pytest
 
 from sheeprl_tpu.cli import run
 
@@ -70,6 +71,7 @@ def test_sac_ae_resume_and_evaluate(tmp_path, monkeypatch):
     evaluation([f"checkpoint_path={ckpt}"])
 
 
+@pytest.mark.slow
 def test_sac_ae_device_buffer_frame_stack(tmp_path, monkeypatch):
     # HBM ring with raw frame-stacked pixel storage + on-device stack fold
     monkeypatch.chdir(tmp_path)

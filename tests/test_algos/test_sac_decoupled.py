@@ -4,6 +4,8 @@ trains on its own mesh and ships the actor back."""
 
 import os
 
+import pytest
+
 from tests.conftest import find_checkpoints, run_two_process
 
 RUNNER = """
@@ -47,6 +49,7 @@ def test_sac_decoupled_two_process(tmp_path):
     assert find_checkpoints(tmp_path), "player did not write a checkpoint from the trainer state"
 
 
+@pytest.mark.slow
 def test_sac_decoupled_resume(tmp_path):
     """Decoupled SAC restores agent, optimizers, replay buffer and counters
     from a player-written checkpoint (round-2 VERDICT: resume was refused)."""

@@ -1,0 +1,105 @@
+"""Framing edge cases (satellite: TCP framing): partial reads, corrupt-CRC
+frames skipped without poisoning the stream, protocol violations severing the
+connection, and the partial-frame report the torn-write classifier reads."""
+
+import struct
+
+import pytest
+
+from sheeprl_tpu.net.frame import (
+    F_HEARTBEAT,
+    F_HELLO,
+    F_SLAB,
+    MAGIC,
+    PREAMBLE_BYTES,
+    PROTO_VERSION,
+    FrameDecoder,
+    ProtocolError,
+    encode_frame,
+)
+
+pytestmark = pytest.mark.net
+
+
+def test_roundtrip_single_and_coalesced():
+    d = FrameDecoder()
+    a = encode_frame(F_HELLO, b"hello-payload")
+    b = encode_frame(F_SLAB, b"\x00" * 100, flags=3)
+    c = encode_frame(F_HEARTBEAT)  # empty payload
+    # one feed carrying three coalesced frames (Nagle's reality)
+    frames = d.feed(a + b + c)
+    assert [(t, f, p) for t, f, p in frames] == [
+        (F_HELLO, 0, b"hello-payload"),
+        (F_SLAB, 3, b"\x00" * 100),
+        (F_HEARTBEAT, 0, b""),
+    ]
+    assert d.buffered == 0
+    assert d.partial() is None
+
+
+def test_partial_reads_byte_by_byte():
+    """A frame dribbling in one byte at a time decodes exactly once, at the
+    final byte — the mid-read states never yield anything."""
+    d = FrameDecoder()
+    frame = encode_frame(F_SLAB, bytes(range(64)))
+    for byte in frame[:-1]:
+        assert d.feed(bytes([byte])) == []
+    (got,) = d.feed(frame[-1:])
+    assert got == (F_SLAB, 0, bytes(range(64)))
+
+
+def test_partial_report_stages():
+    """`partial()` is the torn-write classifier's evidence: it must say
+    *whether* a frame was in flight and how much of it landed."""
+    d = FrameDecoder()
+    assert d.partial() is None  # idle stream
+    frame = encode_frame(F_SLAB, b"x" * 200)
+    # preamble incomplete: a frame is in flight but its type is unknowable
+    d.feed(frame[: PREAMBLE_BYTES - 4])
+    ftype, length, got = d.partial()
+    assert ftype == -1
+    # mid-payload: type + declared length known, payload partially landed
+    d2 = FrameDecoder()
+    d2.feed(frame[: PREAMBLE_BYTES + 50])
+    ftype, length, got = d2.partial()
+    assert ftype == F_SLAB and length == 200 and len(got) == 50
+
+
+def test_corrupt_crc_skipped_stream_survives():
+    """A bit-flipped frame is dropped and counted; the NEXT frame on the same
+    stream still decodes — one torn slab must never poison the connection."""
+    d = FrameDecoder()
+    bad = bytearray(encode_frame(F_SLAB, b"a" * 50))
+    bad[PREAMBLE_BYTES + 10] ^= 0xFF  # flip a payload bit: CRC mismatch
+    good = encode_frame(F_SLAB, b"b" * 50)
+    frames = d.feed(bytes(bad) + good)
+    assert frames == [(F_SLAB, 0, b"b" * 50)]
+    assert d.checksum_rejects == 1
+    assert d.partial() is None
+
+
+def test_bad_magic_is_protocol_error():
+    d = FrameDecoder()
+    with pytest.raises(ProtocolError):
+        d.feed(b"JUNKJUNKJUNKJUNKJUNK")
+
+
+def test_bad_version_is_protocol_error():
+    frame = bytearray(encode_frame(F_HELLO, b"x"))
+    frame[4] = PROTO_VERSION + 1
+    with pytest.raises(ProtocolError):
+        FrameDecoder().feed(bytes(frame))
+
+
+def test_absurd_length_is_protocol_error():
+    """A declared length past MAX_PAYLOAD_BYTES is a corrupted or hostile
+    preamble — drop the connection, don't try to buffer 4 GiB."""
+    preamble = struct.pack("<4sBBHII", MAGIC, PROTO_VERSION, F_SLAB, 0, 0xFFFFFFFF, 0)
+    with pytest.raises(ProtocolError):
+        FrameDecoder().feed(preamble)
+
+
+def test_empty_feed_is_noop():
+    d = FrameDecoder()
+    assert d.feed(b"") == []
+    assert d.buffered == 0

@@ -1,0 +1,133 @@
+"""Localhost-TCP actor→learner drills (tentpole acceptance): the decoupled
+PPO entrypoint with ``algo.actor_learner.transport=tcp`` spawns a real actor
+process that dials the learner over 127.0.0.1 and trains to completion with
+zero torn slabs trained on and zero admitted slabs dropped. The crash drill
+re-runs the canonical mid-write death: over TCP the victim is half a frame on
+the wire, classified torn by the learner, restart charged, run completes."""
+
+import json
+import os
+
+import pytest
+
+from sheeprl_tpu.cli import run
+
+pytestmark = [pytest.mark.actor_learner, pytest.mark.net]
+
+
+def tcp_args(tmp_path):
+    return [
+        "exp=ppo_decoupled",
+        "dry_run=True",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "algo.rollout_steps=32",
+        "algo.per_rank_batch_size=8",
+        "algo.update_epochs=2",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.encoder.cnn_features_dim=16",
+        "algo.encoder.mlp_features_dim=8",
+        "env.num_envs=2",
+        "algo.run_test=False",
+        "checkpoint.save_last=True",
+        "metric.log_level=1",
+        "metric.telemetry.enabled=True",
+        "metric.telemetry.poll_interval=0.0",
+        "algo.actor_learner.num_actors=1",
+        "algo.actor_learner.slots_per_actor=2",
+        "algo.actor_learner.transport=tcp",
+        f"log_base_dir={tmp_path}/logs",
+    ]
+
+
+def read_runs(path):
+    return [json.loads(line) for line in open(path) if line.strip()]
+
+
+def read_telemetry(tmp_path):
+    jsonls = []
+    for root, _, files in os.walk(tmp_path):
+        jsonls += [os.path.join(root, f) for f in files if f == "telemetry.jsonl"]
+    assert len(jsonls) == 1
+    return [json.loads(line) for line in open(jsonls[0]) if line.strip()]
+
+
+def test_ppo_over_localhost_tcp(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    runs = tmp_path / "RUNS.jsonl"
+    run(tcp_args(tmp_path) + [f"metric.telemetry.runs_jsonl={runs}"])
+
+    (rec,) = read_runs(runs)
+    assert rec["outcome"] == "completed"
+    assert rec["variant"] == "actor_learner"
+    # the zero-torn / zero-dropped-admitted invariants, over the wire
+    assert rec.get("slabs_admitted", 0) >= 1
+    assert rec.get("torn_slabs", 0) == 0
+    assert rec.get("dropped_stale_slabs", 0) == 0
+
+    # no shm segments were ever created: the data plane was sockets
+    from sheeprl_tpu.rollout.shm import _OWNED_SEGMENTS
+
+    assert not _OWNED_SEGMENTS
+
+    events = read_telemetry(tmp_path)
+    (run_end,) = [e for e in events if e["event"] == "run_end"]
+    # run_end carries the per-transport counter rollup (satellite: net-stats)
+    net = run_end.get("net")
+    assert net, "tcp run_end must carry a net section"
+    transports = net["transports"]
+    assert "tcp.learner" in transports
+    stats = transports["tcp.learner"]
+    assert stats["frames_recv"] >= rec["slabs_admitted"]
+    assert stats["checksum_rejects"] == 0
+    assert stats["bytes_recv"] > 0
+
+    # the cross-host trace seam: the handshake recorded a skew estimate
+    trace_files = [p for p in rec["telemetry_files"] if "trace." in os.path.basename(p)]
+    assert trace_files
+    all_events = []
+    for p in rec["telemetry_files"]:
+        with open(p) as fh:
+            all_events += [json.loads(l) for l in fh if l.strip()]
+    handshakes = [e for e in all_events if e.get("kind") == "net_handshake"]
+    assert handshakes and all("skew_s" in e for e in handshakes)
+
+
+def test_tcp_actor_crash_mid_write_drill(tmp_path, monkeypatch):
+    """Mid-write death over TCP: half a slab frame on the wire. The learner
+    classifies it torn (never admitted), the supervisor charges one restart,
+    and the respawned generation completes the run."""
+    monkeypatch.chdir(tmp_path)
+    runs = tmp_path / "RUNS.jsonl"
+    run(
+        tcp_args(tmp_path)
+        + [
+            "algo.actor_learner.fault_injection.enabled=True",
+            "algo.actor_learner.fault_injection.faults=[{kind: actor_crash_mid_write, actor: 0, at_slab: 0}]",
+            f"metric.telemetry.runs_jsonl={runs}",
+        ]
+    )
+    (rec,) = read_runs(runs)
+    assert rec["outcome"] == "completed"
+    assert rec.get("torn_slabs", 0) >= 1  # detected, never trained on
+    assert rec.get("slabs_admitted", 0) >= 1
+    assert rec.get("actor_restarts") == {"0": 1}
+
+    events = read_telemetry(tmp_path)
+    (run_end,) = [e for e in events if e["event"] == "run_end"]
+    stats = run_end["net"]["transports"]["tcp.learner"]
+    # the torn classification is visible in the transport counters too
+    assert stats["torn_frames"] + stats["checksum_rejects"] >= 1
+    # net_event stream mirrors the serve/rollout pattern
+    net_events = [e for e in events if e["event"] == "net_event"]
+    assert any(e.get("kind") in ("torn_frame", "disconnect") for e in net_events)
+
+    # the victim's causal chain terminates at `torn` on the merged timeline
+    from tools import trace as trace_tool
+
+    merged = trace_tool.merge(rec["telemetry_files"])
+    torn_chains = [
+        evs for evs in merged["traces"].values() if trace_tool.slab_terminal(evs) == "torn"
+    ]
+    assert len(torn_chains) >= 1

@@ -1,0 +1,292 @@
+"""TCP transport unit drills over real localhost sockets, single process.
+
+The learner end is single-threaded and pumped inline, so the actor end dials
+from a helper thread while the test thread pumps ``poll()`` — the same
+interleaving the two-process drills exercise, without the process spawns.
+
+Edge cases covered (satellite: TCP framing):
+
+- credit flow control == ring backpressure (``try_begin_write`` False at 0)
+- mid-frame peer death classified torn, with trace-id attribution when the
+  slab header fully landed
+- a checksum-corrupt frame is rejected without poisoning the stream: the
+  next slab on the same connection is admitted
+- reconnect-with-generation-bump never re-admits a stale slab from a zombie
+  connection
+"""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.net.frame import (
+    F_HELLO,
+    F_HELLO_ACK,
+    F_SLAB,
+    FrameDecoder,
+    encode_frame,
+)
+from sheeprl_tpu.net.stats import reset_net_stats
+from sheeprl_tpu.net.transport import (
+    TcpLearnerTransport,
+    attach_actor_transport,
+)
+
+pytestmark = pytest.mark.net
+
+PAYLOAD = 256  # big enough that half a slab frame includes the full header
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stats():
+    reset_net_stats()
+    yield
+    reset_net_stats()
+
+
+@pytest.fixture
+def learner():
+    lt = TcpLearnerTransport(
+        payload_bytes=PAYLOAD, num_slots=4, slots_per_actor=2, param_nbytes=32
+    )
+    yield lt
+    lt.close()
+
+
+def dial(lt, actor_id=0, generation=0):
+    """Connect an actor end while pumping the single-threaded learner end."""
+    box = {}
+
+    def _dial():
+        try:
+            box["at"] = attach_actor_transport(
+                lt.actor_wire(actor_id),
+                actor_id=actor_id,
+                generation=generation,
+                slots=[0, 1],
+            )
+        except Exception as err:  # surfaced by the caller
+            box["err"] = err
+
+    t = threading.Thread(target=_dial, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while t.is_alive() and time.monotonic() < deadline:
+        lt.poll()
+        time.sleep(0.002)
+    t.join(timeout=1)
+    if "err" in box:
+        raise box["err"]
+    return box["at"]
+
+
+def pump_until(lt, pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = lt.poll()
+        if pred(got):
+            return got
+        time.sleep(0.002)
+    raise AssertionError("condition not reached while pumping learner transport")
+
+
+def write_slab(at, seq, fill=0, trace_id=0, param_version=0):
+    assert at.try_begin_write()
+    at.payload_view()[:] = fill
+    at.write_meta(
+        seq=seq,
+        param_version=param_version,
+        actor_id=at.actor_id,
+        n_rows=8,
+        collect_us=1000,
+        env_steps=8,
+        trace_id=trace_id,
+        commit_t_us=int(time.time() * 1e6),
+    )
+    at.commit()
+
+
+def test_handshake_credits_and_param_replay(learner):
+    # publish BEFORE any actor exists: the late joiner must still receive it
+    learner.publish_params(np.arange(32, dtype=np.uint8), 3)
+    at = dial(learner)
+    assert at.credits == 2
+    deadline = time.monotonic() + 5
+    while at.param_version() < 0 and time.monotonic() < deadline:
+        learner.poll()
+        time.sleep(0.002)
+    version, data = at.poll_params()
+    assert version == 3
+    assert list(data[:4]) == [0, 1, 2, 3]
+    at.close()
+
+
+def test_slab_roundtrip_meta_fidelity(learner):
+    at = dial(learner)
+    write_slab(at, seq=11, fill=7, trace_id=424242, param_version=5)
+    meta = pump_until(learner, lambda m: m is not None)
+    assert (meta.seq, meta.param_version, meta.actor_id) == (11, 5, 0)
+    assert (meta.trace_id, meta.n_rows, meta.env_steps) == (424242, 8, 8)
+    assert meta.collect_us == 1000 and meta.commit_t_us > 0
+    assert np.all(learner.payload(meta) == 7)
+    learner.release(meta)
+    assert learner.torn_detected == 0
+    at.close()
+
+
+def test_credit_exhaustion_is_backpressure(learner):
+    at = dial(learner)
+    write_slab(at, seq=0)
+    write_slab(at, seq=1)
+    assert at.credits == 0
+    assert not at.try_begin_write()  # blocked, not an error
+    m0 = pump_until(learner, lambda m: m is not None)
+    learner.release(m0)  # SLAB_ACK returns the credit
+    deadline = time.monotonic() + 5
+    while not at.try_begin_write():
+        assert time.monotonic() < deadline, "credit never returned"
+        learner.poll()
+        time.sleep(0.002)
+    assert at.credits == 1  # begin_write holds a claim on the returned credit
+    at.close()
+
+
+def test_midframe_death_is_torn_with_trace_id(learner):
+    at = dial(learner)
+    write_slab(at, seq=0, trace_id=101)  # a cleanly committed slab first
+    assert at.try_begin_write()
+    at.payload_view()[:] = 9
+    at.write_meta(
+        seq=1, param_version=0, actor_id=0, n_rows=8, collect_us=1,
+        env_steps=8, trace_id=777, commit_t_us=1,
+    )
+    at.abort_torn()  # half the frame hits the wire...
+    at.sock.close()  # ...then the peer dies
+    meta = pump_until(learner, lambda m: m is not None)
+    assert meta.seq == 0  # committed is committed: the full frame is kept
+    pump_until(learner, lambda _: learner.torn_detected == 1)
+    # header landed whole inside the half-frame: the victim is attributable
+    assert learner.drain_torn_trace_ids() == [777]
+    assert learner.stats.torn_frames == 1
+
+
+def test_corrupt_frame_rejected_stream_survives(learner):
+    """Raw socket speaking the protocol: a bit-flipped slab frame is counted
+    as a checksum reject + torn, and the NEXT frame on the same connection is
+    admitted — one corrupt slab never poisons the link."""
+    sock = socket.create_connection((learner.host, learner.port), timeout=10)
+    decoder = FrameDecoder()
+    hello = {"role": "actor0", "actor_id": 0, "generation": 0, "t_wall": time.time()}
+    sock.sendall(encode_frame(F_HELLO, json.dumps(hello).encode()))
+    # pump the learner until the HELLO_ACK comes back
+    acked = []
+    deadline = time.monotonic() + 10
+    while not acked and time.monotonic() < deadline:
+        learner.poll()
+        sock.setblocking(False)
+        try:
+            data = sock.recv(1 << 16)
+            acked = [f for f in decoder.feed(data) if f[0] == F_HELLO_ACK]
+        except (BlockingIOError, InterruptedError):
+            pass
+        time.sleep(0.002)
+    assert acked
+    sock.setblocking(True)
+
+    hdr = np.zeros(10, dtype=np.int64)
+    from sheeprl_tpu.actor_learner.ring import CHECKSUM, COMMITTED, SEQ, STATE, _checksum
+
+    hdr[STATE] = COMMITTED
+    hdr[SEQ] = 1
+    hdr[4] = 8  # n_rows
+    hdr[CHECKSUM] = _checksum(hdr[SEQ:CHECKSUM])
+    good = encode_frame(F_SLAB, hdr.tobytes() + bytes(PAYLOAD))
+    corrupt = bytearray(good)
+    corrupt[-1] ^= 0xFF  # payload bit flip: frame CRC mismatch
+    sock.sendall(bytes(corrupt))
+    hdr[SEQ] = 2
+    hdr[CHECKSUM] = _checksum(hdr[SEQ:CHECKSUM])
+    sock.sendall(encode_frame(F_SLAB, hdr.tobytes() + bytes(PAYLOAD)))
+
+    meta = pump_until(learner, lambda m: m is not None)
+    assert meta.seq == 2  # the frame AFTER the corrupt one decoded cleanly
+    assert learner.stats.checksum_rejects == 1
+    assert learner.torn_detected == 1
+    sock.close()
+
+
+def test_header_mix_mismatch_is_torn(learner):
+    """Frame CRC intact but the slab-header mix wrong (recycled/corrupt meta):
+    the slab is torn + attributed, never admitted."""
+    sock = socket.create_connection((learner.host, learner.port), timeout=10)
+    hello = {"role": "actor0", "actor_id": 0, "generation": 0, "t_wall": time.time()}
+    sock.sendall(encode_frame(F_HELLO, json.dumps(hello).encode()))
+    from sheeprl_tpu.actor_learner.ring import CHECKSUM, COMMITTED, SEQ, STATE, TRACE_ID
+
+    hdr = np.zeros(10, dtype=np.int64)
+    hdr[STATE] = COMMITTED
+    hdr[SEQ] = 1
+    hdr[TRACE_ID] = 555
+    hdr[CHECKSUM] = 12345  # NOT the mix
+    sock.sendall(encode_frame(F_SLAB, hdr.tobytes() + bytes(PAYLOAD)))
+    pump_until(learner, lambda _: learner.torn_detected == 1)
+    assert learner.drain_torn_trace_ids() == [555]
+    assert learner.poll() is None
+    sock.close()
+
+
+def test_generation_bump_drops_stale_slab(learner):
+    """The zombie drill: gen-0 connection lingers, supervisor reclaims the
+    actor (floor bump), gen-1 reconnects. A slab the zombie then flushes must
+    be dropped as stale; the successor's slab is admitted."""
+    zombie = dial(learner, actor_id=0, generation=0)
+    learner.reclaim_actor(0, [0, 1])  # supervisor: actor 0 is dead to me
+    successor = dial(learner, actor_id=0, generation=1)
+    assert learner.stats.reconnects == 1
+
+    # the zombie flushes a slab on its (severed learner-side) connection:
+    # the send may fail outright — either way nothing is admitted
+    try:
+        write_slab(zombie, seq=99, trace_id=1)
+    except Exception:
+        pass
+
+    write_slab(successor, seq=100, trace_id=2)
+    meta = pump_until(learner, lambda m: m is not None)
+    assert meta.seq == 100 and meta.trace_id == 2
+    learner.release(meta)
+    assert learner.poll() is None  # the zombie's slab never surfaced
+    successor.close()
+
+
+def test_zombie_slab_on_live_connection_is_stale(learner):
+    """Even if the zombie's connection survives (reclaim raced the flush),
+    a slab arriving with a below-floor generation is counted stale and
+    dropped — re-admission is impossible by construction."""
+    zombie = dial(learner, actor_id=0, generation=0)
+    # successor HELLO raises the floor; zombie's conn is severed learner-side
+    # — so instead emulate the race: raise the floor directly, keep the conn
+    learner._generations[0] = 5
+    write_slab(zombie, seq=7, trace_id=3)
+    deadline = time.monotonic() + 5
+    while learner.stats.stale_slabs == 0 and time.monotonic() < deadline:
+        assert learner.poll() is None, "stale slab must never be admitted"
+        time.sleep(0.002)
+    assert learner.stats.stale_slabs == 1
+    zombie.close()
+
+
+def test_learner_close_says_bye(learner):
+    at = dial(learner)
+    learner.close()
+    from sheeprl_tpu.net.transport import TransportError
+
+    with pytest.raises(TransportError):
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            at.try_begin_write()  # pumps; sees F_BYE or the closed socket
+            time.sleep(0.002)

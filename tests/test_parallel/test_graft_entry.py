@@ -10,6 +10,7 @@ health.
 """
 
 import os
+import pytest
 import subprocess
 import sys
 
@@ -40,6 +41,7 @@ print("PARENT-NEVER-IMPORTED-JAX")
 """
 
 
+@pytest.mark.slow
 def test_dryrun_parent_never_imports_jax():
     env = dict(os.environ)
     env.pop("_SHEEPRL_TPU_DRYRUN_CHILD", None)

@@ -1,0 +1,80 @@
+"""ISSUE 18: the ``cpux8p2`` virtual two-process mesh training-parity cell.
+
+Two real ``jax.distributed`` CPU processes (4 virtual devices each) form one
+global 8-device ``(data=2, model=4)`` mesh — each process owns exactly one
+data row — and run the SAME deterministic two-window fused-superstep case as
+the single-process 2-D equivalence test (`run_2d_superstep_case`). Process 0
+dumps the all-gathered leaves; the parent compares them against a
+single-device run of the identical case, proving the fused superstep's
+numerics survive the jump from a single-process mesh to a multi-process one
+(gloo CPU collectives underneath, DCN on real hardware).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.conftest import run_multi_process
+
+pytestmark = pytest.mark.multichip
+
+
+def p2_superstep_case(out_path):
+    """Worker entry (one of two ``jax.distributed`` processes): build the
+    production Fabric with an explicit coordinator (the TEST_* contract from
+    ``run_multi_process``) so distributed bring-up — including the gloo CPU
+    collectives selection — goes through ``Fabric._maybe_init_distributed``
+    exactly as a real multi-host launch would."""
+    import jax
+
+    from sheeprl_tpu.parallel.fabric import Fabric
+    from tests.test_parallel.test_sharded_superstep import run_2d_superstep_case
+
+    fabric = Fabric(
+        devices=8,
+        precision="fp32",
+        mesh_axes=("data", "model"),
+        mesh_shape=(2, 4),
+        distributed_coordinator=os.environ["TEST_COORD"],
+        num_processes=int(os.environ["TEST_NPROC"]),
+        process_id=int(os.environ["TEST_PID"]),
+    )
+    assert fabric.num_processes == 2, fabric.num_processes
+    assert fabric.world_size == 8 and fabric.local_device_count == 4
+    # the (2, 4) mesh must put each process's 4 devices on one data row —
+    # the layout the batch-slice placement in the shared case relies on
+    for row in range(2):
+        owners = {d.process_index for d in fabric.mesh.devices[row].flat}
+        assert len(owners) == 1, f"data row {row} spans processes {owners}"
+    run_2d_superstep_case(fabric, True, out_path)
+    print("P2_CASE_OK", jax.process_index())
+
+
+WORKER = """
+import sys
+from tests.test_parallel.test_multiprocess_mesh import p2_superstep_case
+p2_superstep_case(sys.argv[1])
+"""
+
+
+def test_p2_mesh_superstep_matches_single_device(multichip_run, tmp_path):
+    """ISSUE-18 acceptance (`cpux8p2` parity): two K=4 superstep windows on a
+    2-process x 4-device `(data, model)` mesh produce the same params / Adam
+    state / EMA target / metrics as the single-device superstep — the
+    in-child asserts additionally prove the carries stayed model-sharded and
+    window 2 reused window 1's executable across the process boundary."""
+    p2_out = tmp_path / "p2.npz"
+    single_out = tmp_path / "single.npz"
+    outs = run_multi_process(WORKER, argv=(str(p2_out),), nproc=2, device_count=4)
+    assert all("P2_CASE_OK" in o for o in outs)
+    multichip_run(
+        "tests.test_parallel.test_sharded_superstep:superstep_equivalence_case_2d",
+        1,
+        "1",
+        str(single_out),
+    )
+    got, want = np.load(p2_out), np.load(single_out)
+    assert set(got.files) == set(want.files) and got.files
+    for name in got.files:
+        np.testing.assert_allclose(got[name], want[name], rtol=1e-5, atol=1e-6, err_msg=name)

@@ -98,13 +98,6 @@ def superstep_equivalence_case_2d(n_devices, out_path):
     the ISSUE-14 carry invariants in-process: the kernel AND its Adam moment
     twins stay model-axis sharded across windows, and window 2 reuses window
     1's executable (zero recompiles)."""
-    import jax
-    import jax.numpy as jnp
-    import optax
-    from jax.sharding import PartitionSpec as P
-
-    from sheeprl_tpu.ops.superstep import make_superstep_fn, periodic_target_ema, pregathered
-
     n_devices = int(n_devices)
     multi = n_devices > 1
     if multi:
@@ -116,6 +109,25 @@ def superstep_equivalence_case_2d(n_devices, out_path):
         )
     else:
         fabric = Fabric(devices=1, precision="fp32")
+    run_2d_superstep_case(fabric, multi, out_path)
+
+
+def run_2d_superstep_case(fabric, multi, out_path):
+    """The shared 2-D case body: deterministic inputs, two K=4 windows, leaf
+    dump. ``fabric`` may span multiple processes (the ISSUE-18 ``cpux8p2``
+    parity cell constructs a 2-process ``(2, 4)`` mesh and calls this with the
+    SAME case) — placement then goes through
+    ``jax.make_array_from_process_local_data`` (each process contributes its
+    data-row slice of the batch; params/carries are process-replicated) and
+    the final fetch all-gathers through a replicating identity jit, so the
+    npz leaves are global values regardless of topology."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sheeprl_tpu.ops.superstep import make_superstep_fn, periodic_target_ema, pregathered
+
     K, B, D, H = 4, 8, 8, 8
     rng = np.random.default_rng(11)
     xs = jnp.asarray(rng.normal(size=(K, B, D)).astype(np.float32))
@@ -165,7 +177,39 @@ def superstep_equivalence_case_2d(n_devices, out_path):
     superstep = make_superstep_fn(train_body, pregathered, K, pre_step=pre_step, **kwargs)
     ctx = (xs, ys)
     key = jax.random.PRNGKey(0)
-    if multi:
+    if multi and jax.process_count() > 1:
+        # multi-process placement: device_put cannot target devices owned by
+        # another process, so each process contributes its local block via
+        # make_array_from_process_local_data — the full copy for
+        # process-replicated leaves (params/carries/key), its own data-row
+        # slice of the batch axis for the ctx
+        def global_put(tree, shardings):
+            return jax.tree.map(
+                lambda x, s: jax.make_array_from_process_local_data(s, np.asarray(x)),
+                tree,
+                shardings,
+            )
+
+        params = global_put(params, fabric.carry_shardings(params))
+        aux = global_put(aux, fabric.carry_shardings(aux))
+        key = global_put(key, fabric.replicated)
+        mesh_devices = fabric.mesh.devices  # [data, model] grid
+        my_rows = [
+            r
+            for r in range(mesh_devices.shape[0])
+            if all(d.process_index == jax.process_index() for d in mesh_devices[r].flat)
+        ]
+        assert len(my_rows) == 1, f"expected one whole data row per process, got {my_rows}"
+        rows_per_proc = B // mesh_devices.shape[0]
+        lo = my_rows[0] * rows_per_proc
+        ctx_sharding = fabric.sharding(None, fabric.data_axis)
+        ctx = jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                ctx_sharding, np.asarray(x)[:, lo : lo + rows_per_proc]
+            ),
+            ctx,
+        )
+    elif multi:
         # every input enters window 1 committed exactly as the superstep
         # returns it, so window 2 must not key a second executable
         params = jax.device_put(params, fabric.carry_shardings(params))
@@ -174,7 +218,7 @@ def superstep_equivalence_case_2d(n_devices, out_path):
         key = fabric.replicate(key)
     all_metrics = []
     for window in range(2):
-        params, aux, key, metrics = superstep(params, aux, jnp.int32(window * K), ctx, key)
+        params, aux, key, metrics = superstep(params, aux, np.int32(window * K), ctx, key)
         all_metrics.append(metrics)
 
     if multi:
@@ -189,8 +233,15 @@ def superstep_equivalence_case_2d(n_devices, out_path):
         assert superstep._cache_size() == 1, (
             f"window 2 recompiled: {superstep._cache_size()} executables"
         )
-    leaves = jax.tree.leaves((params, aux, all_metrics))
-    np.savez(out_path, **{f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)})
+    out = (params, aux, all_metrics)
+    if multi and jax.process_count() > 1:
+        # np.asarray cannot fetch shards living on another process's devices:
+        # all-gather to fully-replicated first (a cross-process collective),
+        # after which every process holds the global value of every leaf
+        out = jax.jit(lambda t: t, out_shardings=NamedSharding(fabric.mesh, P()))(out)
+    leaves = jax.tree.leaves(out)
+    if jax.process_index() == 0:
+        np.savez(out_path, **{f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)})
 
 
 @pytest.mark.multichip

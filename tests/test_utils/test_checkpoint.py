@@ -90,6 +90,7 @@ def test_checkpoint_plain_replay_buffer_fixup(tmp_path):
     assert saved["truncated"][(saved._pos - 1) % saved.buffer_size].sum() == 2
 
 
+@pytest.mark.slow
 def test_dv3_orbax_resume_restores_buffer_and_counters(tmp_path, monkeypatch):
     """End to end: train tiny DV3 with the orbax backend + buffer checkpoint,
     resume, and verify the restored buffer contents and counters match the

@@ -32,6 +32,10 @@ worker_restarts     lower   rollout supervision totals (slack 1)
 masked_slots        lower   rollout supervision totals (slack 1)
 nan_rollbacks       lower   resilience totals (slack 1)
 recompiles          lower   compile watchdog totals (slack 1)
+net_checksum_rejects lower  run_end ``net.transports`` summed over endpoints
+net_torn_frames     lower   run_end ``net.transports`` summed over endpoints
+net_reconnects      lower   run_end ``net.transports`` sums (slack 1)
+net_heartbeat_gaps  lower   run_end ``net.transports`` sums (slack 1)
 ==================  ======  =====================================
 
 ``--bench`` additionally folds the repo's ``BENCH_r*.json`` driver records
@@ -81,6 +85,15 @@ METRICS: Dict[str, Tuple[bool, float]] = {
     # spawn -> first request served on a warm AOT executable cache.
     # Lower-better in the default 20% band, like the latency metrics.
     "cold_start_s": (False, 0.0),
+    # multi-host data plane (sheeprl_tpu/net): summed over every transport
+    # endpoint in the record's run_end `net.transports` section. The `*:p2`
+    # localhost-TCP drill cells (ISSUE 18) gate on these — a healthy drill
+    # has zero corrupt frames; reconnects get slack 1 because the chaos
+    # drill's budgeted restart IS a reconnect.
+    "net_checksum_rejects": (False, 0.0),
+    "net_torn_frames": (False, 0.0),
+    "net_reconnects": (False, 1.0),
+    "net_heartbeat_gaps": (False, 1.0),
 }
 
 # (cell-key glob, metric, absolute lower bound). Floors are enforced on the
@@ -207,6 +220,17 @@ def record_metrics(rec: Dict[str, Any]) -> Dict[str, float]:
     goodput = slo_goodput(stats)
     if goodput is not None:
         out["qps@p95"] = goodput
+    net = rec.get("net")
+    if isinstance(net, dict) and isinstance(net.get("transports"), dict):
+        sums: Dict[str, float] = {}
+        for counters in net["transports"].values():
+            if isinstance(counters, dict):
+                for k, v in counters.items():
+                    if isinstance(v, (int, float)):
+                        sums[k] = sums.get(k, 0.0) + float(v)
+        for short in ("checksum_rejects", "torn_frames", "reconnects", "heartbeat_gaps"):
+            if short in sums:
+                out[f"net_{short}"] = sums[short]
     return out
 
 
@@ -419,6 +443,23 @@ def self_test() -> int:
         return r
 
     records += [serve_rec(1, 400.0, 40.0), serve_rec(2, 410.0, 45.0), serve_rec(3, 405.0, 50.0)]
+
+    # ISSUE-18 p2 topology cells: a 2-process localhost-TCP drill gets its
+    # own `...p2:...` cell (never pooled with the p1 history) and gates the
+    # summed per-transport counters from the run_end net section
+    def p2_rec(t):
+        r = rec(t, "ppo_decoupled", 500.0, variant="actor_learner")
+        r["process_count"] = 2
+        r["net"] = {
+            "events": {"reconnect": 1},
+            "transports": {
+                "tcp.learner": {"checksum_rejects": 0, "torn_frames": 0, "reconnects": 1},
+                "tcp.actor0": {"checksum_rejects": 0, "torn_frames": 0, "reconnects": 0},
+            },
+        }
+        return r
+
+    records += [p2_rec(1), p2_rec(2), p2_rec(3)]
     # ISSUE-14 MFU floor: TPU mfu cells carry an absolute >=0.30 bar that
     # fires even on a first record; CPU virtual-mesh cells are never floored
     records += [
@@ -445,6 +486,15 @@ def self_test() -> int:
         failures.append(f"variant cell: want separate 3-run pass cell, got {fused}")
     if doc["cells"]["train:ppo:CartPole-v1:cpux1p1"]["runs"] != 4:
         failures.append("variant records leaked into the base cell history")
+    p2_cell = doc["cells"].get("train:ppo_decoupled:CartPole-v1:cpux1p2:actor_learner")
+    if (
+        p2_cell is None
+        or p2_cell["verdict"] != "pass"
+        or p2_cell["runs"] != 3
+        or "net_checksum_rejects" not in (p2_cell.get("metrics") or {})
+        or "net_reconnects" not in (p2_cell.get("metrics") or {})
+    ):
+        failures.append(f"p2 cell: want separate 3-run pass cell gating net counters, got {p2_cell}")
     fleet_cell = doc["cells"].get("serve:ppo:CartPole-v1:cpux1p1:fleet")
     if (
         fleet_cell is None

@@ -138,20 +138,67 @@ def _normalize(raw: Dict[str, Any], stream: str, role: str, pid: Any, offset: Op
     return ev
 
 
+def _wall_skew_corrections(
+    observations: Dict[Tuple[str, str], List[float]], root_order: Sequence[str]
+) -> Dict[str, float]:
+    """Per-role epoch-clock corrections from transport-handshake skew
+    observations.
+
+    ``observations[(a, b)]`` holds ``skew_s = a_wall - b_wall`` samples
+    measured when role ``a`` received role ``b``'s HELLO/ACK (carrying ``b``'s
+    ``t_wall`` stamp), so an event stamped ``t`` on ``b``'s clock happened at
+    ``t + skew_s`` on ``a``'s. Corrections are additive along a BFS from the
+    first present root in ``root_order`` (every connected component gets its
+    own root; the per-edge skew is the sample median, since one-way latency
+    inflates individual samples). Roles with no observations stay at 0.0."""
+    import statistics
+
+    adj: Dict[str, List[Tuple[str, float]]] = {}
+    for (a, b), vals in observations.items():
+        if a == b or not vals:
+            continue
+        s = float(statistics.median(vals))
+        adj.setdefault(a, []).append((b, s))  # correction(b) = correction(a) + s
+        adj.setdefault(b, []).append((a, -s))
+    corrections: Dict[str, float] = {}
+    roots = [r for r in root_order if r in adj] + sorted(adj)
+    for root in roots:
+        if root in corrections:
+            continue
+        corrections[root] = 0.0
+        queue = [root]
+        while queue:
+            a = queue.pop(0)
+            for b, s in adj.get(a, ()):
+                if b not in corrections:
+                    corrections[b] = corrections[a] + s
+                    queue.append(b)
+    return corrections
+
+
 def merge_streams(streams: Sequence[Tuple[str, Sequence[Dict[str, Any]]]]) -> Dict[str, Any]:
     """Join named per-process event streams into one causal view.
 
     Returns ``{"processes": [...], "traces": {trace_id: [events]}, "untraced":
-    [events]}`` with every event list sorted by the aligned epoch time."""
+    [events], "clock_skews": {role: skew_s}}`` with every event list sorted by
+    the aligned epoch time. Alignment is two-level: within a process,
+    ``t_mono + clock_offset`` (steady against epoch-clock steps); across
+    processes, ``net_handshake`` skew observations from the TCP transports
+    (each handshake carries the sender's wall stamp, so the receiver logs
+    ``skew_s = my_wall - peer_wall``) shift every peer stream onto the
+    learner/serve host's timeline — without this, a cross-host slab or
+    request chain decomposes against unrelated clocks."""
     processes: List[Dict[str, Any]] = []
-    traces: Dict[int, List[Dict[str, Any]]] = {}
-    untraced: List[Dict[str, Any]] = []
+    pending: List[Tuple[str, List[Tuple[Dict[str, Any], Any, int]]]] = []
+    skew_obs: Dict[Tuple[str, str], List[float]] = {}
+    first_role: Optional[str] = None
 
     for stream, events in streams:
         offset: Optional[float] = None
         role, pid = "proc", None
         proc_rec: Optional[Dict[str, Any]] = None
         count = 0
+        stream_events: List[Tuple[Dict[str, Any], Any, int]] = []
         for raw in events:
             etype = raw.get("event")
             if etype == "trace_handshake":
@@ -174,28 +221,58 @@ def merge_streams(streams: Sequence[Tuple[str, Sequence[Dict[str, Any]]]]) -> Di
             else:
                 t = float(raw.get("t", 0.0))
             ev = _normalize(raw, stream, role, pid, offset, t)
+            if (
+                ev.get("kind") == "net_handshake"
+                and ev.get("peer") is not None
+                and isinstance(ev.get("skew_s"), (int, float))
+            ):
+                skew_obs.setdefault((str(ev["role"]), str(ev["peer"])), []).append(float(ev["skew_s"]))
             tids = raw.get("trace_ids")
-            if tids:  # batched carrier (request_reroute): one event per victim
-                for tid in tids:
-                    traces.setdefault(int(tid), []).append(dict(ev))
-                continue
             tid = int(raw.get("trace_id", 0) or 0)
-            if tid:
-                traces.setdefault(tid, []).append(ev)
-            else:
-                untraced.append(ev)
+            stream_events.append((ev, tids, tid))
         if proc_rec is not None:
             proc_rec["trace_events"] = count
         elif events:
             # a stream with events but no handshake still shows up, flagged
-            processes.append(
-                {"stream": stream, "role": role, "pid": pid, "clock_offset": None, "trace_events": count}
-            )
+            proc_rec = {"stream": stream, "role": role, "pid": pid, "clock_offset": None, "trace_events": count}
+            processes.append(proc_rec)
+        stream_role = str(proc_rec["role"]) if proc_rec else role
+        if first_role is None and stream_events:
+            first_role = stream_role
+        pending.append((stream_role, stream_events))
+
+    root_order = ["learner", "serve", "fleet"] + ([first_role] if first_role else [])
+    corrections = _wall_skew_corrections(skew_obs, root_order)
+    for proc_rec in processes:
+        skew = corrections.get(str(proc_rec.get("role")))
+        if skew:
+            proc_rec["wall_skew_s"] = skew
+
+    traces: Dict[int, List[Dict[str, Any]]] = {}
+    untraced: List[Dict[str, Any]] = []
+    for stream_role, stream_events in pending:
+        correction = corrections.get(stream_role, 0.0)
+        for ev, tids, tid in stream_events:
+            if correction:
+                ev["t"] = ev["t"] + correction
+            if tids:  # batched carrier (request_reroute): one event per victim
+                for one in tids:
+                    traces.setdefault(int(one), []).append(dict(ev))
+                continue
+            if tid:
+                traces.setdefault(tid, []).append(ev)
+            else:
+                untraced.append(ev)
 
     for evs in traces.values():
         evs.sort(key=lambda e: e["t"])
     untraced.sort(key=lambda e: e["t"])
-    return {"processes": processes, "traces": traces, "untraced": untraced}
+    return {
+        "processes": processes,
+        "traces": traces,
+        "untraced": untraced,
+        "clock_skews": {k: v for k, v in corrections.items() if v},
+    }
 
 
 def merge(paths: Sequence[str]) -> Dict[str, Any]:
@@ -275,6 +352,8 @@ def summarize(merged: Dict[str, Any]) -> Dict[str, Any]:
         ],
         "traces": len(traces),
     }
+    if merged.get("clock_skews"):
+        out["clock_skews"] = dict(merged["clock_skews"])
 
     # -- slabs: collect -> ring-wait -> admission -> train ------------------
     slab_traces = {
@@ -510,6 +589,30 @@ def self_test() -> int:
     evs = merged["traces"][tid]
     check("skewed_clock_order", trace_kinds(evs) == ["slab_collect", "slab_admit"])
     check("skewed_clock_alignment", abs(evs[0]["t"] - 1002.0) < 1e-6)
+
+    # 2b. cross-HOST wall skew: the remote actor's whole epoch timeline runs
+    # +100s ahead (its clock_offset includes the skew — offsets only fix
+    # same-host epoch steps), so only the learner's net_handshake skew
+    # observation can pull its events back onto the learner's timeline
+    tid = 43
+    remote = [
+        _hs("actor0", 110, 1100.0, 1.0),
+        _ev("slab_collect", tid, "actor0", 110, 2.0, 1100.0),
+    ]
+    learner = [
+        _hs("learner", 111, 1000.0, 1.0),
+        _ev("net_handshake", 0, "learner", 111, 1.5, 1000.0, peer="actor0", skew_s=-100.0, transport="tcp"),
+        _ev("slab_admit", tid, "learner", 111, 5.0, 1000.0),
+    ]
+    merged = merge_streams([("remote.jsonl", remote), ("learner.jsonl", learner)])
+    evs = merged["traces"][tid]
+    check("wall_skew_order", trace_kinds(evs) == ["slab_collect", "slab_admit"])
+    check("wall_skew_alignment", abs(evs[0]["t"] - 1002.0) < 1e-6)
+    check("wall_skew_reported", abs(merged["clock_skews"].get("actor0", 0.0) + 100.0) < 1e-6)
+    check(
+        "wall_skew_on_process",
+        any(abs(p.get("wall_skew_s", 0.0) + 100.0) < 1e-6 for p in merged["processes"] if p["role"] == "actor0"),
+    )
 
     # 3. cross-process join: 2 actors + learner, one full chain per slab
     t1, t2 = 7, 8
