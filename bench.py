@@ -1606,7 +1606,45 @@ if __name__ == "__main__":
         "validation (tools/jaxcheck) in a subprocess, print a one-line "
         "summary, exit nonzero on any new finding or failed config cell",
     )
+    parser.add_argument(
+        "--sweep",
+        action="store_true",
+        help="executed scenario grid (tools/sweep.py): drain the curated "
+        "scenario cells through fake-backend smoke -> CPU learning-check "
+        "tiers (each cell is a subprocess CLI run), fold executed verdicts "
+        "into SCENARIOS.json as executed_cells/executed_summary, defer "
+        "chip-tier cells into benchmarks/QUEUE.json; exit nonzero on any "
+        "failed cell",
+    )
+    parser.add_argument(
+        "--sweep-only", metavar="GLOB", help="cell-key filter for --sweep (fnmatch)"
+    )
+    parser.add_argument(
+        "--sweep-budget-s",
+        type=float,
+        default=0.0,
+        help="wall-clock budget for --sweep; cells past it report skipped_budget (0 = unlimited)",
+    )
+    parser.add_argument(
+        "--sweep-stats",
+        action="store_true",
+        help="summarize executed scenario cells (tier reached, verdict, sps) "
+        "from SCENARIOS.json and exit (tools/sweep.py stats)",
+    )
     args = parser.parse_args()
+    if args.sweep or args.sweep_stats:
+        # the runner is stdlib-only (every cell runs as a subprocess), so the
+        # parent stays jax-free — same file-path load as --regress
+        sweep_mod = _load_tool("sweep")
+        if args.sweep_stats:
+            print(json.dumps(sweep_mod.stats(args.scenarios_out), indent=1))
+            sys.exit(0)
+        sweep_argv = ["--scenarios-out", args.scenarios_out]
+        if args.sweep_only:
+            sweep_argv += ["--only", args.sweep_only]
+        if args.sweep_budget_s:
+            sweep_argv += ["--budget-s", str(args.sweep_budget_s)]
+        sys.exit(sweep_mod.main(sweep_argv))
     if args.queue:
         backend = probe_backend()
         if args.queue == "list":

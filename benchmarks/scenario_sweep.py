@@ -1,0 +1,245 @@
+"""Batched domain-randomization throughput (ISSUE 19 tentpole part 2).
+
+Drives the EXACT fused on-policy program the trainer uses
+(``ops/rollout_scan.py``: policy forward + env stepping + GAE + the
+epochs x minibatches update in ONE donated jit) over a
+:class:`~sheeprl_tpu.envs.variants.ScenarioFamily` — every env slot is a
+*distinct* domain-randomized scenario instance, parameterized by one row
+of an ``[N, P]`` theta matrix that rides the ``data``-axis ``shard_map``
+alongside the env state. The measured number is aggregate env-steps/s
+across all scenario instances; the CPU bar is >=100k.
+
+Usage::
+
+    python benchmarks/scenario_sweep.py --envs 1024 --rollout-steps 64 \
+        --updates 10 --repeats 3 --record
+
+Writes one JSON line per repeat. ``--record`` appends each repeat to the
+run registry as a ``train:ppo:scenario_sweep:<backend>xDp1:fused_scenarios``
+cell (``sps_env``, higher-better) gated by ``tools/regress.py``; three
+repeats seed the cell past the gate's min-history so the very next run is
+regress-gated.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# repo root on sys.path: running this file by path puts benchmarks/ (not the
+# root) at sys.path[0]
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args() -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--base", default="CartPole-v1", help="base env id (needs a jittable twin)")
+    p.add_argument(
+        "--variants",
+        default="phys_size,phys_speed,phys_mass,sticky_actions,reward_delay,distractors",
+        help="comma-separated variant names (envs/variants.py VARIANT_ORDER)",
+    )
+    p.add_argument("--envs", type=int, default=8192, help="scenario instances (= env slots)")
+    p.add_argument("--rollout-steps", type=int, default=64)
+    p.add_argument("--updates", type=int, default=10, help="timed superstep dispatches per repeat")
+    p.add_argument("--repeats", type=int, default=1, help="timed repeats (one record each)")
+    p.add_argument("--minibatches", type=int, default=4)
+    p.add_argument("--update-epochs", type=int, default=1)
+    p.add_argument("--dense-units", type=int, default=32)
+    p.add_argument("--mlp-layers", type=int, default=1)
+    p.add_argument("--devices", type=int, default=1, help="data-axis device count (CPU: virtual)")
+    p.add_argument("--seed", type=int, default=5)
+    p.add_argument(
+        "--record",
+        nargs="?",
+        const="",
+        default=None,
+        metavar="RUNS_JSONL",
+        help="append an obs-registry record per repeat (regress scenario_sweep cell); "
+        "optional path overrides the default RUNS.jsonl",
+    )
+    return p.parse_args()
+
+
+def build(args):
+    """Family + agent + the fused superstep, mirroring ppo.py's fused path."""
+    from functools import partial
+
+    import gymnasium as gym
+    import jax
+    import numpy as np
+    import optax
+
+    from sheeprl_tpu.algos.ppo.agent import build_agent, rollout_step
+    from sheeprl_tpu.algos.ppo.ppo import make_local_train
+    from sheeprl_tpu.config.compose import compose
+    from sheeprl_tpu.envs.variants import make_scenario_family, sample_scenario_matrix
+    from sheeprl_tpu.ops.rollout_scan import (
+        ENV_STREAM_SALT,
+        init_env_carry,
+        make_onpolicy_superstep_fn,
+    )
+    from sheeprl_tpu.parallel.fabric import Fabric
+    from sheeprl_tpu.utils.utils import dotdict
+
+    names = tuple(n for n in args.variants.split(",") if n)
+    family = make_scenario_family(args.base, names)
+    if family is None:
+        raise SystemExit(f"no jittable twin for base env '{args.base}'")
+
+    n_local = args.rollout_steps * args.envs // args.devices
+    batch_size = n_local // args.minibatches
+    cfg = dotdict(
+        compose(
+            "config",
+            [
+                "exp=ppo",
+                "fabric.precision=fp32",
+                f"fabric.devices={args.devices}",
+                f"algo.rollout_steps={args.rollout_steps}",
+                f"algo.per_rank_batch_size={batch_size}",
+                f"algo.update_epochs={args.update_epochs}",
+                f"algo.dense_units={args.dense_units}",
+                f"algo.mlp_layers={args.mlp_layers}",
+                f"env.num_envs={args.envs}",
+            ],
+        )
+    )
+    fabric = Fabric(devices=args.devices, precision="fp32")
+    obs_space = gym.spaces.Dict(
+        {"state": gym.spaces.Box(-np.inf, np.inf, (family.obs_dim,), np.float32)}
+    )
+    actions_dim = (family.action_dim,) if not family.is_continuous else (family.action_dim,)
+    agent, params = build_agent(fabric, actions_dim, family.is_continuous, cfg, obs_space, None)
+    tx = optax.adam(3e-4)
+    opt_state = tx.init(params)
+
+    gamma, lam = float(cfg.algo.gamma), float(cfg.algo.gae_lambda)
+    superstep = make_onpolicy_superstep_fn(
+        family,
+        policy_fn=partial(rollout_step, agent),
+        value_fn=lambda p, o: agent.apply(p, o)[1],
+        local_train=make_local_train(fabric, agent, tx, cfg, ["state"], n_local, use_mesh=True),
+        obs_key="state",
+        rollout_steps=args.rollout_steps,
+        step_increment=args.envs,
+        gamma=gamma,
+        gae_lambda=lam,
+        mesh=fabric.mesh,
+        data_axis=fabric.data_axis,
+    )
+
+    thetas = sample_scenario_matrix(
+        jax.random.PRNGKey(args.seed), args.envs, family.variant_names
+    )
+    carry = init_env_carry(
+        family,
+        args.envs,
+        jax.random.fold_in(jax.random.PRNGKey(args.seed), ENV_STREAM_SALT),
+        thetas=thetas,
+    )
+    carry = jax.device_put(carry, fabric.batch_sharding)
+    return family, fabric, superstep, params, opt_state, carry
+
+
+def measure(args):
+    import jax
+    import numpy as np
+
+    family, fabric, superstep, params, opt_state, carry = build(args)
+    key = jax.device_put(jax.random.PRNGKey(args.seed), fabric.replicated)
+    player_key = jax.random.fold_in(jax.random.PRNGKey(args.seed), 1)
+
+    def dispatch(update, step):
+        nonlocal params, opt_state, carry, key
+        update_key = jax.random.fold_in(player_key, update)
+        params, opt_state, carry, key, metrics, _stats = superstep(
+            params, opt_state, carry, update_key, key, np.uint32(step), np.float32(0.2), np.float32(0.0)
+        )
+        return metrics
+
+    steps_per_update = args.rollout_steps * args.envs
+    t0 = time.perf_counter()
+    jax.block_until_ready(dispatch(0, 0))
+    compile_s = time.perf_counter() - t0
+
+    update, results = 1, []
+    for rep in range(args.repeats):
+        t0 = time.perf_counter()
+        for _ in range(args.updates):
+            metrics = dispatch(update, update * steps_per_update)
+            update += 1
+        jax.block_until_ready(metrics)
+        elapsed = time.perf_counter() - t0
+        results.append(
+            {
+                "env": "scenario_sweep",
+                "family": family.env_id,
+                "scenarios": args.envs,
+                "param_dim": family.param_dim,
+                "rollout_steps": args.rollout_steps,
+                "updates": args.updates,
+                "devices": fabric.world_size,
+                "backend": jax.default_backend(),
+                "compile_s": round(compile_s, 2),
+                "sps_env": round(args.updates * steps_per_update / elapsed, 1),
+                "repeat": rep,
+            }
+        )
+    return results
+
+
+def record_cell(rec: dict, runs_path: str | None) -> None:
+    """Append an obs-registry record so ``tools/regress.py`` gates the sweep
+    as ``train:ppo:scenario_sweep:<backend>xDp1:fused_scenarios``."""
+    import jax
+
+    from sheeprl_tpu.obs.registry import SCHEMA_VERSION, append_run_record, runs_jsonl_path
+
+    record = {
+        "schema": SCHEMA_VERSION,
+        "t": time.time(),
+        "kind": "train",
+        "algo": "ppo",
+        "env": "scenario_sweep",
+        "backend": jax.default_backend(),
+        "local_device_count": jax.local_device_count(),
+        "process_count": jax.process_count(),
+        "variant": "fused_scenarios",
+        "outcome": "completed",
+        "sps_env": rec["sps_env"],
+        "scenario_family": rec["family"],
+        "scenarios": rec["scenarios"],
+        "rollout_steps": rec["rollout_steps"],
+        "compile_s": rec["compile_s"],
+    }
+    path = runs_jsonl_path(None, runs_path)
+    if path is None:
+        print("run registry disabled (SHEEPRL_TPU_RUNS_JSONL empty); record dropped", flush=True)
+        return
+    append_run_record(record, path)
+    print(f"recorded scenario_sweep cell -> {path}", flush=True)
+
+
+def main() -> None:
+    args = parse_args()
+    if args.devices > 1 and "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}"
+        )
+    if args.rollout_steps * args.envs % (args.devices * args.minibatches):
+        raise SystemExit("rollout_steps*envs must divide by devices*minibatches")
+    for rec in measure(args):
+        print(json.dumps(rec), flush=True)
+        if args.record is not None:
+            record_cell(rec, args.record or None)
+
+
+if __name__ == "__main__":
+    main()

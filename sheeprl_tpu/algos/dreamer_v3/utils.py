@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Sequence
 
+from sheeprl_tpu.obs.telemetry import telemetry_deliberate_compiles
 import jax
 import numpy as np
 
@@ -55,6 +56,9 @@ def prepare_obs(
     return out
 
 
+# the eval rollout compiles fresh programs (eval batch shapes) after the
+# loop's warm point; that is a deliberate one-time compile, not a retrace
+@telemetry_deliberate_compiles("eval_rollout")
 def test(
     player: Any,
     fabric: Any,

@@ -50,6 +50,12 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.envs import build_vector_env, get_jittable_env
+from sheeprl_tpu.envs.variants import (
+    ScenarioFamily,
+    compose_variant_env_id,
+    make_scenario_family,
+    sample_scenario_matrix,
+)
 from sheeprl_tpu.obs import (
     log_sps_and_heartbeat,
     telemetry_advance,
@@ -173,17 +179,64 @@ def make_train_fn(fabric, agent, tx, cfg, obs_keys, n_local: int, host_device=No
     return jax.jit(train_fn, donate_argnums=(0, 1) if donate_params else (1,))
 
 
-def _resolve_fused_rollout_spec(
+def scenario_variant_cfg(cfg):
+    """Parse the ``env.variants.*`` node: ``(names, kwargs, ranges, seed)``.
+
+    ``names`` is the enabled-variant tuple (empty when the node is absent or
+    disabled), ``kwargs`` the static family knobs for
+    :func:`make_scenario_family`."""
+    node = cfg.env.get("variants", None) if hasattr(cfg.env, "get") else None
+    if node is None:
+        return (), {}, {}, None
+    names = tuple(str(n) for n in (node.get("enabled", None) or ()))
+    if not names:
+        return (), {}, {}, None
+    kwargs = {
+        "distractor_dims": int(node.get("distractor_dims", 4)),
+        "reward_max_delay": int(node.get("reward_max_delay", 4)),
+    }
+    ranges = {
+        str(k): (float(v[0]), float(v[1])) for k, v in dict(node.get("ranges", None) or {}).items()
+    }
+    seed = node.get("seed", None)
+    return names, kwargs, ranges, (None if seed is None else int(seed))
+
+
+def resolve_scenario_family(cfg) -> ScenarioFamily | None:
+    """The :class:`ScenarioFamily` for ``env.id`` + ``env.variants.enabled``,
+    or ``None`` when no variants are enabled or the base env has no jittable
+    twin (the fused feasibility gate then emits the breadcrumb)."""
+    names, kwargs, _, _ = scenario_variant_cfg(cfg)
+    if not names:
+        return None
+    return make_scenario_family(str(cfg.env.id), names, **kwargs)
+
+
+def scenario_theta_matrix(cfg, family: ScenarioFamily, num_envs: int) -> jax.Array:
+    """Sample the ``[num_envs, P]`` scenario matrix from ``env.variants``."""
+    _, _, ranges, seed = scenario_variant_cfg(cfg)
+    key = jax.random.PRNGKey(int(cfg.seed) if seed is None else seed)
+    return sample_scenario_matrix(key, num_envs, family.variant_names, ranges)
+
+
+def resolve_fused_rollout_spec(
     cfg, fabric, cnn_keys, mlp_keys, observation_space, is_continuous, is_multidiscrete, actions_dim
 ):
     """Feasibility gate for ``algo.fused_rollout``: return the jittable env
-    spec when the whole rollout can run in-graph, else emit one
-    ``fused_fallback`` telemetry event and return ``None`` (host loop)."""
+    spec (or :class:`ScenarioFamily` when ``env.variants`` are enabled) when
+    the whole rollout can run in-graph, else emit one ``fused_fallback``
+    telemetry event and return ``None`` (host loop)."""
     env_id = str(cfg.env.id)
+    variant_names, family_kwargs, _, _ = scenario_variant_cfg(cfg)
     spec = get_jittable_env(env_id)
     if spec is None:
-        fused_fallback("jittable_env", f"no jittable twin registered for env id '{env_id}'")
+        # name the full variant-composed id so sweep triage can grep which
+        # scenario (not just which base env) was skipped
+        missing = compose_variant_env_id(env_id, variant_names) if variant_names else env_id
+        fused_fallback("jittable_env", f"no jittable twin registered for env id '{missing}'")
         return None
+    if variant_names:
+        spec = make_scenario_family(env_id, variant_names, **family_kwargs)
     if fabric.num_processes > 1:
         fused_fallback("multi_process", "fused rollout is single-process (env state is process-local)")
         return None
@@ -258,6 +311,21 @@ def main(fabric, cfg: Dict[str, Any]):
         if is_continuous
         else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
     )
+
+    # scenario variants (env.variants.*) run through the fused rollout only;
+    # the `distractors` variant widens the observation, so the agent must be
+    # built against the family's obs_dim, not the base vector env's
+    # resolved unconditionally: enabled variants with the fused path off must
+    # hit the loud RuntimeError below, never silently train the base env
+    scenario_family = resolve_scenario_family(cfg)
+    obs_widened = False
+    if scenario_family is not None and not cnn_keys and len(mlp_keys) == 1:
+        k0 = mlp_keys[0]
+        if tuple(observation_space[k0].shape) != (scenario_family.obs_dim,):
+            spaces_d = dict(observation_space.spaces)
+            spaces_d[k0] = gym.spaces.Box(-np.inf, np.inf, (scenario_family.obs_dim,), np.float32)
+            observation_space = gym.spaces.Dict(spaces_d)
+            obs_widened = True
 
     agent, params = build_agent(
         fabric,
@@ -344,7 +412,7 @@ def main(fabric, cfg: Dict[str, Any]):
     reset_fused_fallback_warnings()
     fused_spec = None
     if fused_rollout:
-        fused_spec = _resolve_fused_rollout_spec(
+        fused_spec = resolve_fused_rollout_spec(
             cfg, fabric, cnn_keys, mlp_keys, observation_space, is_continuous, is_multidiscrete, actions_dim
         )
         if fused_spec is not None and train_device is None and num_envs % world_size != 0:
@@ -352,6 +420,15 @@ def main(fabric, cfg: Dict[str, Any]):
                 "env_shard", f"env.num_envs ({num_envs}) must be divisible by the device count ({world_size})"
             )
             fused_spec = None
+    if scenario_family is not None and fused_spec is None:
+        # the agent may be built against the widened scenario obs and the host
+        # loop cannot apply variants — fail loudly instead of silently
+        # training the un-randomized base env
+        raise RuntimeError(
+            "env.variants requires the fused rollout path; set "
+            "algo.fused_rollout=True (if it is set, the fused_fallback "
+            "telemetry event names the gate that failed)"
+        )
     # fused rollout subsumes overlap (there is no host collection to overlap)
     overlap_collection = overlap_collection and fused_spec is None
 
@@ -519,9 +596,19 @@ def main(fabric, cfg: Dict[str, Any]):
             def place_carry(carry):
                 return put_tree(carry, train_device)
 
+        # one scenario row per env for the run's lifetime: domain
+        # randomization persists across autoresets and update boundaries
+        thetas = (
+            scenario_theta_matrix(cfg, fused_spec, num_envs)
+            if isinstance(fused_spec, ScenarioFamily)
+            else None
+        )
         env_carry = place_carry(
             init_env_carry(
-                fused_spec, num_envs, jax.random.fold_in(jax.random.PRNGKey(int(cfg.seed)), ENV_STREAM_SALT)
+                fused_spec,
+                num_envs,
+                jax.random.fold_in(jax.random.PRNGKey(int(cfg.seed)), ENV_STREAM_SALT),
+                thetas=thetas,
             )
         )
         steps_per_dispatch = update_epochs * num_minibatches
@@ -557,7 +644,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 # fresh episodes: poisoned params may have driven the carried
                 # env state non-finite too
                 env_carry = place_carry(
-                    init_env_carry(fused_spec, num_envs, jax.random.fold_in(key, update))
+                    init_env_carry(fused_spec, num_envs, jax.random.fold_in(key, update), thetas=thetas)
                 )
                 continue
             train_step += world_size
@@ -582,10 +669,16 @@ def main(fabric, cfg: Dict[str, Any]):
                 ep_done = np.asarray(ep_stats["done"])
                 finished = np.nonzero(ep_done)
                 if finished[0].size:
-                    for r in np.asarray(ep_stats["ret"])[finished]:
+                    finished_rets = np.asarray(ep_stats["ret"])[finished]
+                    for r in finished_rets:
                         aggregator.update("Rewards/rew_avg", float(r))
                     for length in np.asarray(ep_stats["len"])[finished]:
                         aggregator.update("Game/ep_len_avg", float(length))
+                    # same per-episode evidence lines as the host loop — the
+                    # learning-check recipes (benchmarks/learning_checks.sh,
+                    # tools/sweep.py) grep these for the reward trend
+                    for i, r in zip(finished[-1], finished_rets):
+                        print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={float(r)}")
             update_loss_metrics(metrics)
             maybe_heartbeat(update == num_updates)
             anneal_coefs()
@@ -776,7 +869,12 @@ def main(fabric, cfg: Dict[str, Any]):
     probe.finish(policy_step, sync=lambda: jax.device_get(jax.tree.leaves(params)[0]))
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test and not preempted:
-        test(player, fabric, cfg, log_dir)
+        if obs_widened:
+            # the agent expects the scenario family's widened observation; the
+            # host eval env emits the base one — there is nothing to evaluate
+            warnings.warn("skipping run_test: env.variants widened the observation past the host env's")
+        else:
+            test(player, fabric, cfg, log_dir)
     logger.finalize()
     resil.close()
     if preempted:

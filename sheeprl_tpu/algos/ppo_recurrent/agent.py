@@ -200,6 +200,70 @@ def evaluate_actions(
     return logprob, entropy, values
 
 
+def evaluate_actions_resettable(
+    agent: RecurrentPPOAgent,
+    params: Any,
+    obs: Dict[str, Array],  # [L, N, ...]
+    prev_actions: Array,  # [L, N, A]
+    hx0: Array,  # [N, H]
+    cx0: Array,  # [N, H]
+    actions: Array,  # [L, N, A]
+    dones: Array,  # [L, N, 1]
+    *,
+    reset_on_done: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """:func:`evaluate_actions` for sequences that may CROSS episode
+    boundaries (the fused rollout's fixed windows): the LSTM carry is zeroed
+    after every stored done, replaying ``reset_recurrent_state_on_done``
+    rollouts state-for-state.  The time loop is a ``lax.scan`` of
+    single-step ``agent.apply`` calls — same params, same module — with the
+    reset applied between steps."""
+
+    def step(carry, xs):
+        hx, cx = carry
+        obs_t, pa_t, done_t = xs
+        actor_out, values, (new_hx, new_cx) = agent.apply(
+            params, {k: v[None] for k, v in obs_t.items()}, pa_t[None], hx, cx
+        )
+        if reset_on_done:
+            keep = 1.0 - done_t
+            new_hx = keep * new_hx
+            new_cx = keep * new_cx
+        return (new_hx, new_cx), (tuple(h[0] for h in actor_out), values[0])
+
+    _, (heads, values) = jax.lax.scan(step, (hx0, cx0), (obs, prev_actions, dones))
+    dists = _dists(agent, list(heads))
+    if agent.is_continuous:
+        d = dists[0]
+        return d.log_prob(actions)[..., None], d.entropy()[..., None], values
+    splits = np.cumsum(agent.actions_dim)[:-1]
+    onehot_parts = jnp.split(actions, splits, axis=-1)
+    idx_parts = [jnp.argmax(p, axis=-1) for p in onehot_parts]
+    logprob = sum(d.log_prob(i) for d, i in zip(dists, idx_parts))[..., None]
+    entropy = sum(d.entropy() for d in dists)[..., None]
+    return logprob, entropy, values
+
+
+def recurrent_rollout_step(
+    agent: RecurrentPPOAgent,
+    params: Any,
+    obs: Dict[str, Array],  # [1, E, ...]
+    prev_actions: Array,  # [1, E, A]
+    hx: Array,
+    cx: Array,
+    key: Array,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """The fused-rollout policy head (``ops/rollout_scan.py``'s recurrent
+    ``policy_fn``): sampling plus the one-hot -> env-action conversion of
+    ``RecurrentPPOPlayer.rollout_actions``, minus its key fold (the superstep
+    folds the counter in-graph)."""
+    actions, logprob, values, new_hx, new_cx = sample_actions(
+        agent, params, obs, prev_actions, hx, cx, key
+    )
+    real = real_actions_from_onehot(agent.actions_dim, agent.is_continuous, actions)
+    return actions, real, logprob, values, new_hx, new_cx
+
+
 class RecurrentPPOPlayer(HostPlayerParams):
     """Host-side rollout handle: params + jitted single-step functions; the
     caller owns the recurrent state (reference player usage,

@@ -36,15 +36,37 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
+from sheeprl_tpu.algos.ppo.ppo import (
+    resolve_fused_rollout_spec,
+    resolve_scenario_family,
+    scenario_theta_matrix,
+)
 from sheeprl_tpu.algos.ppo_recurrent.agent import (
     RecurrentPPOPlayer,
     build_agent,
     evaluate_actions,
+    evaluate_actions_resettable,
+    recurrent_rollout_step,
 )
 from sheeprl_tpu.algos.ppo_recurrent.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.config.compose import instantiate
 from sheeprl_tpu.envs import build_vector_env
+from sheeprl_tpu.envs.variants import ScenarioFamily
+from sheeprl_tpu.obs import (
+    log_sps_and_heartbeat,
+    telemetry_advance,
+    telemetry_mark_warm,
+    telemetry_register_flops,
+    telemetry_run_metrics,
+    telemetry_train_window,
+)
 from sheeprl_tpu.ops.math import gae
+from sheeprl_tpu.ops.rollout_scan import (
+    ENV_STREAM_SALT,
+    init_recurrent_env_carry,
+    make_recurrent_onpolicy_superstep_fn,
+)
+from sheeprl_tpu.ops.superstep import fused_fallback, reset_fused_fallback_warnings
 from sheeprl_tpu.parallel.shard_map import shard_map
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -109,18 +131,27 @@ def build_sequences(
     return out
 
 
-def make_train_fn(fabric, agent, tx, cfg, obs_keys):
-    """Fused masked-sequence update (replaces reference train(), :31-116)."""
+def make_local_train(fabric, agent, tx, cfg, obs_keys, *, use_mesh: bool, sequence_dones: bool = False):
+    """The UNJITTED masked-sequence update body (replaces reference train(),
+    :31-116).  ``use_mesh`` guards the collectives (and the per-shard key
+    fork) so the same body serves the ``shard_map``-ped host-path update and
+    the fused superstep's embedded call.  ``sequence_dones`` marks batches
+    whose sequences are FIXED windows that may cross episode boundaries (the
+    fused rollout): the replay then resets the LSTM carry at the stored
+    per-step dones (``evaluate_actions_resettable``) instead of assuming
+    episode-aligned chunks."""
     update_epochs = int(cfg.algo.update_epochs)
     num_batches = max(1, int(cfg.algo.per_rank_num_batches))
     vf_coef = float(cfg.algo.vf_coef)
     clip_vloss = bool(cfg.algo.clip_vloss)
     normalize_adv = bool(cfg.algo.normalize_advantages)
     reduction = str(cfg.algo.loss_reduction)
+    reset_on_done = bool(cfg.algo.reset_recurrent_state_on_done)
     data_axis = fabric.data_axis
 
     def local_train(params, opt_state, data, hx0, cx0, key, clip_coef, ent_coef):
-        key = jax.random.fold_in(key, lax.axis_index(data_axis))
+        if use_mesh:
+            key = jax.random.fold_in(key, lax.axis_index(data_axis))
         n_local = data["mask"].shape[1]
         bs = n_local // num_batches
 
@@ -130,15 +161,28 @@ def make_train_fn(fabric, agent, tx, cfg, obs_keys):
 
             def loss_fn(p):
                 obs = {k: batch[k] for k in obs_keys}
-                logprobs, entropy, values = evaluate_actions(
-                    agent,
-                    p,
-                    obs,
-                    batch["prev_actions"],
-                    h0,
-                    c0,
-                    batch["actions"],
-                )
+                if sequence_dones:
+                    logprobs, entropy, values = evaluate_actions_resettable(
+                        agent,
+                        p,
+                        obs,
+                        batch["prev_actions"],
+                        h0,
+                        c0,
+                        batch["actions"],
+                        batch["dones"],
+                        reset_on_done=reset_on_done,
+                    )
+                else:
+                    logprobs, entropy, values = evaluate_actions(
+                        agent,
+                        p,
+                        obs,
+                        batch["prev_actions"],
+                        h0,
+                        c0,
+                        batch["actions"],
+                    )
                 mask = batch["mask"]
                 msum = mask.sum() + 1e-8
                 adv = batch["advantages"]
@@ -159,7 +203,8 @@ def make_train_fn(fabric, agent, tx, cfg, obs_keys):
                 return pg + vf_coef * v + ent_coef * ent, (pg, v, ent)
 
             (_, (pg, v, ent)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            grads = lax.pmean(grads, data_axis)
+            if use_mesh:
+                grads = lax.pmean(grads, data_axis)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return (params, opt_state), jnp.stack([pg, v, ent])
@@ -184,8 +229,20 @@ def make_train_fn(fabric, agent, tx, cfg, obs_keys):
         (params, opt_state, _), metrics = lax.scan(
             epoch_step, (params, opt_state, key), None, length=update_epochs
         )
-        return params, opt_state, lax.pmean(metrics.mean(axis=(0, 1)), data_axis)
+        metrics = metrics.mean(axis=(0, 1))
+        if use_mesh:
+            metrics = lax.pmean(metrics, data_axis)
+        return params, opt_state, metrics
 
+    return local_train
+
+
+def make_train_fn(fabric, agent, tx, cfg, obs_keys):
+    """The host-path jitted update: :func:`make_local_train` ``shard_map``-ped
+    over the data axis (sequences sharded, params/opt replicated, gradient
+    ``pmean`` as the DDP all-reduce)."""
+    data_axis = fabric.data_axis
+    local_train = make_local_train(fabric, agent, tx, cfg, obs_keys, use_mesh=True)
     train_fn = shard_map(
         local_train,
         mesh=fabric.mesh,
@@ -239,6 +296,20 @@ def main(fabric, cfg: Dict[str, Any]):
     )
     n_actions = int(np.sum(actions_dim))
 
+    # scenario variants ride the fused rollout only (same contract as PPO);
+    # `distractors` widens the observation the agent is built against
+    # resolved unconditionally: enabled variants with the fused path off must
+    # hit the loud RuntimeError below, never silently train the base env
+    scenario_family = resolve_scenario_family(cfg)
+    obs_widened = False
+    if scenario_family is not None and not cnn_keys and len(mlp_keys) == 1:
+        k0 = mlp_keys[0]
+        if tuple(observation_space[k0].shape) != (scenario_family.obs_dim,):
+            spaces_d = dict(observation_space.spaces)
+            spaces_d[k0] = gym.spaces.Box(-np.inf, np.inf, (scenario_family.obs_dim,), np.float32)
+            observation_space = gym.spaces.Dict(spaces_d)
+            obs_widened = True
+
     agent, params = build_agent(
         fabric,
         actions_dim,
@@ -285,6 +356,66 @@ def main(fabric, cfg: Dict[str, Any]):
     train_fn = make_train_fn(fabric, agent, tx, cfg, obs_keys)
     gae_fn = jax.jit(partial(gae, gamma=float(cfg.algo.gamma), gae_lambda=float(cfg.algo.gae_lambda)))
 
+    # fused on-policy collection (`algo.fused_rollout`, ported from PPO): the
+    # T-step rollout — LSTM state carried through the scan — plus GAE and the
+    # whole epochs x minibatches update compile into ONE donated jit
+    num_batches = max(1, int(cfg.algo.per_rank_num_batches))
+    fused_rollout = bool(cfg.algo.get("fused_rollout", False))
+    reset_fused_fallback_warnings()
+    fused_spec = None
+    if fused_rollout:
+        fused_spec = resolve_fused_rollout_spec(
+            cfg, fabric, cnn_keys, mlp_keys, observation_space, is_continuous, is_multidiscrete, actions_dim
+        )
+        if fused_spec is not None and rollout_steps % seq_len != 0:
+            fused_fallback(
+                "recurrent_seq",
+                f"algo.rollout_steps ({rollout_steps}) must be a multiple of "
+                f"per_rank_sequence_length ({seq_len}) for fixed-window fused sequences",
+            )
+            fused_spec = None
+        if fused_spec is not None and num_envs % world_size != 0:
+            fused_fallback(
+                "env_shard", f"env.num_envs ({num_envs}) must be divisible by the device count ({world_size})"
+            )
+            fused_spec = None
+        if fused_spec is not None:
+            n_seq_local = (rollout_steps // seq_len) * (num_envs // world_size)
+            if n_seq_local % num_batches != 0:
+                # the in-graph minibatch permutation truncates to
+                # num_batches * bs — an indivisible count would drop sequences
+                fused_fallback(
+                    "sequence_batches",
+                    f"per-shard sequence count ({n_seq_local}) must be divisible by "
+                    f"per_rank_num_batches ({num_batches})",
+                )
+                fused_spec = None
+    if scenario_family is not None and fused_spec is None:
+        raise RuntimeError(
+            "env.variants requires the fused rollout path; set "
+            "algo.fused_rollout=True (if it is set, the fused_fallback "
+            "telemetry event names the gate that failed)"
+        )
+    superstep_fn = None
+    if fused_spec is not None:
+        superstep_fn = make_recurrent_onpolicy_superstep_fn(
+            fused_spec,
+            policy_fn=partial(recurrent_rollout_step, agent),
+            value_fn=lambda p, o, pa, hx, cx: agent.apply(p, o, pa, hx, cx)[1],
+            local_train=make_local_train(
+                fabric, agent, tx, cfg, obs_keys, use_mesh=True, sequence_dones=True
+            ),
+            obs_key=mlp_keys[0],
+            rollout_steps=rollout_steps,
+            seq_len=seq_len,
+            step_increment=num_envs * fabric.num_processes,
+            gamma=float(cfg.algo.gamma),
+            gae_lambda=float(cfg.algo.gae_lambda),
+            reset_on_done=bool(cfg.algo.reset_recurrent_state_on_done),
+            mesh=fabric.mesh,
+            data_axis=fabric.data_axis,
+        )
+
     start_update = (state["update"] + 1) if cfg.checkpoint.resume_from else 1
     policy_step = state["update"] * policy_steps_per_update if cfg.checkpoint.resume_from else 0
     last_log = state["last_log"] if cfg.checkpoint.resume_from else 0
@@ -314,203 +445,337 @@ def main(fabric, cfg: Dict[str, Any]):
     cx = np.zeros((num_envs, agent.lstm_hidden_size), np.float32)
     prev_actions = np.zeros((num_envs, n_actions), np.float32)
 
-    # rollout arrays preallocated once and written in place — no per-step
-    # list appends (or the defensive hx/cx/prev_actions .copy()s: the indexed
-    # write is itself the copy), no end-of-window np.stack
-    store = RolloutStore(rollout_steps)
-    for update in range(start_update, num_updates + 1):
-        buf = store.begin(update)
-        with timer("Time/env_interaction_time"):
-            # fused rollout step: key folding, sampling and the real-action
-            # conversion in one jitted dispatch + one fetch per env step
-            update_key = player_key
-            for t in range(rollout_steps):
-                policy_step += num_envs * fabric.num_processes
-                obs_t = {k: v[None] for k, v in next_obs.items()}
-                actions, real_actions, logprobs, values, new_hx, new_cx = player.rollout_actions(
-                    obs_t, prev_actions[None], hx, cx, update_key, policy_step
-                )
-                actions_np, real_actions, logprobs_np, values_np, new_hx, new_cx = jax.device_get(
-                    (actions, real_actions, logprobs, values, new_hx, new_cx)
-                )
-                actions_np = actions_np[0]
-                logprobs_np = logprobs_np[0]
-                values_np = values_np[0]
-                real_actions = real_actions[0]
-                if not is_continuous and real_actions.shape[-1] == 1 and not is_multidiscrete:
-                    real_actions = real_actions[..., 0]
+    steps_per_dispatch = int(cfg.algo.update_epochs) * num_batches
+    if superstep_fn is not None:
+        # ------------------------------------------------------------------
+        # fused on-policy path: the rollout (LSTM carry riding the scan),
+        # GAE, sequence windowing and the epochs x minibatches update are ONE
+        # donated jit; the metrics fetch is the only host sync per update
+        # ------------------------------------------------------------------
+        def place_carry(carry):
+            return jax.tree.map(lambda x: jax.device_put(x, fabric.batch_sharding), carry)
 
-                obs, rewards, terminated, truncated, info = envs.step(
-                    real_actions.reshape(envs.action_space.shape)
-                )
-                rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, 1)
-
-                # truncation bootstrap with the POST-step recurrent state
-                # (reference :312-336)
-                truncated_envs = np.nonzero(truncated)[0]
-                if len(truncated_envs) > 0 and "final_obs" in info:
-                    final_obs = {
-                        k: np.stack([np.asarray(info["final_obs"][e][k]) for e in truncated_envs])
-                        for k in obs_keys
-                    }
-                    final_obs = prepare_obs(final_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
-                    vals = np.asarray(
-                        player.get_values(
-                            {k: v[None] for k, v in final_obs.items()},
-                            actions_np[truncated_envs][None],
-                            new_hx[truncated_envs],
-                            new_cx[truncated_envs],
-                        )
-                    ).reshape(len(truncated_envs))
-                    rewards[truncated_envs, 0] += float(cfg.algo.gamma) * vals
-
-                dones = np.logical_or(terminated, truncated).reshape(num_envs, 1).astype(np.float32)
-                step_values = {k: next_obs[k] for k in obs_keys}
-                step_values["dones"] = dones
-                step_values["values"] = values_np
-                step_values["actions"] = actions_np
-                step_values["logprobs"] = logprobs_np
-                step_values["rewards"] = rewards
-                step_values["prev_hx"] = hx
-                step_values["prev_cx"] = cx
-                step_values["prev_actions"] = prev_actions
-                buf.put(t, step_values)
-
-                prev_actions = (1 - dones) * actions_np
-                if reset_on_done:
-                    hx = (1 - dones) * new_hx
-                    cx = (1 - dones) * new_cx
-                else:
-                    hx, cx = new_hx, new_cx
-                next_obs = prepare_obs(obs, cnn_keys=cnn_keys, num_envs=num_envs)
-
-                if cfg.metric.log_level > 0 and "final_info" in info:
-                    ep = info["final_info"].get("episode")
-                    if ep is not None:
-                        for i in np.nonzero(ep.get("_r", []))[0]:
-                            aggregator.update("Rewards/rew_avg", float(ep["r"][i]))
-                            aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
-                            print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep['r'][i]}")
-
-        local_data = buf.arrays()  # [T, E, ...]
-
-        # GAE on device (reference :386-398)
-        next_values = np.asarray(
-            player.get_values({k: v[None] for k, v in next_obs.items()}, prev_actions[None], hx, cx)
-        )[0]
-        returns, advantages = gae_fn(
-            jnp.asarray(local_data["rewards"]),
-            jnp.asarray(local_data["values"]),
-            jnp.asarray(local_data["dones"]),
-            jnp.asarray(next_values),
+        key = jax.device_put(key, fabric.replicated)
+        # one scenario row per env for the run's lifetime (PPO's contract)
+        thetas = (
+            scenario_theta_matrix(cfg, fused_spec, num_envs)
+            if isinstance(fused_spec, ScenarioFamily)
+            else None
         )
-        local_data["returns"] = np.asarray(returns)
-        local_data["advantages"] = np.asarray(advantages)
-
-        # episode split + fixed-length chunking + padding (reference :406-444)
-        train_keys = [*obs_keys, "actions", "logprobs", "values", "returns", "advantages", "prev_actions"]
-        sequences = build_sequences(local_data, train_keys, seq_len, num_envs, pad_multiple)
-        hx0 = sequences.pop("hx0")
-        cx0 = sequences.pop("cx0")
-        if fabric.num_processes > 1:
-            # every process must contribute the SAME padded count to the
-            # global array — agree on the max and pad with masked dummies
-            from sheeprl_tpu.parallel.collectives import all_gather_object
-
-            n_here = sequences["mask"].shape[1]
-            n_target = max(all_gather_object(n_here))
-            if n_here < n_target:
-                extra = n_target - n_here
-                sequences = {
-                    k: np.concatenate(
-                        [v, np.zeros((v.shape[0], extra, *v.shape[2:]), v.dtype)], axis=1
+        env_carry = place_carry(
+            init_recurrent_env_carry(
+                fused_spec,
+                num_envs,
+                jax.random.fold_in(jax.random.PRNGKey(int(cfg.seed)), ENV_STREAM_SALT),
+                hidden_size=agent.lstm_hidden_size,
+                action_dim=n_actions,
+                thetas=thetas,
+            )
+        )
+        for update in range(start_update, num_updates + 1):
+            telemetry_advance(policy_step)
+            if update == start_update + 1:
+                # no bench probe in this loop — warm the recompile watchdog here
+                telemetry_mark_warm()
+            # rollout_actions' fold schedule on top of a per-update key — the
+            # same in-graph discipline as the fused PPO loop
+            update_key = jax.random.fold_in(player_key, update)
+            step_before = policy_step
+            with timer("Time/env_interaction_time"):
+                params, opt_state, env_carry, key, metrics, ep_stats = superstep_fn(
+                    params,
+                    opt_state,
+                    env_carry,
+                    update_key,
+                    key,
+                    np.uint32(step_before),
+                    # host numpy scalars — jnp.float32 would materialize them
+                    # on the default backend every update (see ppo.py)
+                    np.float32(clip_coef),
+                    np.float32(ent_coef),
+                )
+                policy_step += policy_steps_per_update
+                metrics = np.asarray(metrics)
+            telemetry_train_window(1, steps_per_dispatch)
+            train_step += world_size
+            if update == start_update:
+                # one dispatch covers collection AND all gradient steps, so
+                # scale the program flops down to per-gradient-step for MFU
+                telemetry_register_flops(
+                    superstep_fn,
+                    params,
+                    opt_state,
+                    env_carry,
+                    update_key,
+                    key,
+                    np.uint32(step_before),
+                    np.float32(clip_coef),
+                    np.float32(ent_coef),
+                    scale=1.0 / steps_per_dispatch,
+                )
+            if cfg.metric.log_level > 0:
+                # one fetch of the per-step episode flags replaces the host
+                # loop's final_info plumbing
+                ep_done = np.asarray(ep_stats["done"])
+                finished = np.nonzero(ep_done)
+                if finished[0].size:
+                    finished_rets = np.asarray(ep_stats["ret"])[finished]
+                    for r in finished_rets:
+                        aggregator.update("Rewards/rew_avg", float(r))
+                    for length in np.asarray(ep_stats["len"])[finished]:
+                        aggregator.update("Game/ep_len_avg", float(length))
+                    # same per-episode evidence lines as the host loop — the
+                    # learning-check recipes (benchmarks/learning_checks.sh,
+                    # tools/sweep.py) grep these for the reward trend
+                    for i, r in zip(finished[-1], finished_rets):
+                        print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={float(r)}")
+                aggregator.update("Loss/policy_loss", float(metrics[0]))
+                aggregator.update("Loss/value_loss", float(metrics[1]))
+                aggregator.update("Loss/entropy_loss", float(metrics[2]))
+                if policy_step - last_log >= cfg.metric.log_every or update == num_updates:
+                    metrics_dict = aggregator.compute()
+                    logger.log_metrics(metrics_dict, policy_step)
+                    telemetry_run_metrics(metrics_dict)
+                    aggregator.reset()
+                    log_sps_and_heartbeat(
+                        logger,
+                        policy_step=policy_step,
+                        env_steps=(policy_step - last_log) * cfg.env.action_repeat,
+                        train_steps=train_step - last_train,
+                        train_invocations=(train_step - last_train) // world_size,
                     )
-                    for k, v in sequences.items()
+                    last_log = policy_step
+                    last_train = train_step
+            if cfg.algo.anneal_clip_coef:
+                clip_coef = polynomial_decay(
+                    update, initial=initial_clip_coef, final=0.0, max_decay_steps=num_updates, power=1.0
+                )
+            if cfg.algo.anneal_ent_coef:
+                ent_coef = polynomial_decay(
+                    update, initial=initial_ent_coef, final=0.0, max_decay_steps=num_updates, power=1.0
+                )
+            if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+                update == num_updates and cfg.checkpoint.save_last
+            ):
+                last_checkpoint = policy_step
+                ckpt_state = {
+                    "agent": jax.device_get(params),
+                    "opt_state": jax.device_get(opt_state),
+                    "update": update,
+                    "batch_size": int(cfg.algo.per_rank_batch_size) * world_size,
+                    "last_log": last_log,
+                    "last_checkpoint": last_checkpoint,
+                    "rng_key": jax.device_get(key),
+                    "player_rng_key": jax.device_get(player_key),
                 }
-                hx0 = np.concatenate([hx0, np.zeros((extra, hx0.shape[1]), hx0.dtype)], axis=0)
-                cx0 = np.concatenate([cx0, np.zeros((extra, cx0.shape[1]), cx0.dtype)], axis=0)
-            sequences = fabric.make_global(sequences, (None, fabric.data_axis))
-            hx0 = fabric.make_global(hx0, (fabric.data_axis,))
-            cx0 = fabric.make_global(cx0, (fabric.data_axis,))
+                ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
+                fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
+        # the player sampled nothing during the fused loop; publish the final
+        # params once for the eval rollout below
+        player.update_params(params)
+    else:
+        # rollout arrays preallocated once and written in place — no per-step
+        # list appends (or the defensive hx/cx/prev_actions .copy()s: the indexed
+        # write is itself the copy), no end-of-window np.stack
+        store = RolloutStore(rollout_steps)
+        for update in range(start_update, num_updates + 1):
+            buf = store.begin(update)
+            with timer("Time/env_interaction_time"):
+                # fused rollout step: key folding, sampling and the real-action
+                # conversion in one jitted dispatch + one fetch per env step
+                update_key = player_key
+                for t in range(rollout_steps):
+                    policy_step += num_envs * fabric.num_processes
+                    obs_t = {k: v[None] for k, v in next_obs.items()}
+                    actions, real_actions, logprobs, values, new_hx, new_cx = player.rollout_actions(
+                        obs_t, prev_actions[None], hx, cx, update_key, policy_step
+                    )
+                    actions_np, real_actions, logprobs_np, values_np, new_hx, new_cx = jax.device_get(
+                        (actions, real_actions, logprobs, values, new_hx, new_cx)
+                    )
+                    actions_np = actions_np[0]
+                    logprobs_np = logprobs_np[0]
+                    values_np = values_np[0]
+                    real_actions = real_actions[0]
+                    if not is_continuous and real_actions.shape[-1] == 1 and not is_multidiscrete:
+                        real_actions = real_actions[..., 0]
 
-        with timer("Time/train_time"):
-            key, train_key = jax.random.split(key)
-            params, opt_state, metrics = train_fn(
-                params,
-                opt_state,
-                sequences,
-                hx0,
-                cx0,
-                train_key,
-                # host numpy scalars — jnp.float32 would materialize them on
-                # the default backend every update (see ppo.py)
-                np.float32(clip_coef),
-                np.float32(ent_coef),
+                    obs, rewards, terminated, truncated, info = envs.step(
+                        real_actions.reshape(envs.action_space.shape)
+                    )
+                    rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, 1)
+
+                    # truncation bootstrap with the POST-step recurrent state
+                    # (reference :312-336)
+                    truncated_envs = np.nonzero(truncated)[0]
+                    if len(truncated_envs) > 0 and "final_obs" in info:
+                        final_obs = {
+                            k: np.stack([np.asarray(info["final_obs"][e][k]) for e in truncated_envs])
+                            for k in obs_keys
+                        }
+                        final_obs = prepare_obs(final_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
+                        vals = np.asarray(
+                            player.get_values(
+                                {k: v[None] for k, v in final_obs.items()},
+                                actions_np[truncated_envs][None],
+                                new_hx[truncated_envs],
+                                new_cx[truncated_envs],
+                            )
+                        ).reshape(len(truncated_envs))
+                        rewards[truncated_envs, 0] += float(cfg.algo.gamma) * vals
+
+                    dones = np.logical_or(terminated, truncated).reshape(num_envs, 1).astype(np.float32)
+                    step_values = {k: next_obs[k] for k in obs_keys}
+                    step_values["dones"] = dones
+                    step_values["values"] = values_np
+                    step_values["actions"] = actions_np
+                    step_values["logprobs"] = logprobs_np
+                    step_values["rewards"] = rewards
+                    step_values["prev_hx"] = hx
+                    step_values["prev_cx"] = cx
+                    step_values["prev_actions"] = prev_actions
+                    buf.put(t, step_values)
+
+                    prev_actions = (1 - dones) * actions_np
+                    if reset_on_done:
+                        hx = (1 - dones) * new_hx
+                        cx = (1 - dones) * new_cx
+                    else:
+                        hx, cx = new_hx, new_cx
+                    next_obs = prepare_obs(obs, cnn_keys=cnn_keys, num_envs=num_envs)
+
+                    if cfg.metric.log_level > 0 and "final_info" in info:
+                        ep = info["final_info"].get("episode")
+                        if ep is not None:
+                            for i in np.nonzero(ep.get("_r", []))[0]:
+                                aggregator.update("Rewards/rew_avg", float(ep["r"][i]))
+                                aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
+                                print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep['r'][i]}")
+
+            local_data = buf.arrays()  # [T, E, ...]
+
+            # GAE on device (reference :386-398)
+            next_values = np.asarray(
+                player.get_values({k: v[None] for k, v in next_obs.items()}, prev_actions[None], hx, cx)
+            )[0]
+            returns, advantages = gae_fn(
+                jnp.asarray(local_data["rewards"]),
+                jnp.asarray(local_data["values"]),
+                jnp.asarray(local_data["dones"]),
+                jnp.asarray(next_values),
             )
-            # one host fetch serves the sync point and the three aggregator
-            # scalars below — block_until_ready plus a second asarray (or a
-            # blocking transfer per float()) would each be an extra round trip
-            metrics = np.asarray(metrics)
-        player.params = params
-        train_step += world_size
+            local_data["returns"] = np.asarray(returns)
+            local_data["advantages"] = np.asarray(advantages)
 
-        if cfg.metric.log_level > 0:
-            aggregator.update("Loss/policy_loss", float(metrics[0]))
-            aggregator.update("Loss/value_loss", float(metrics[1]))
-            aggregator.update("Loss/entropy_loss", float(metrics[2]))
+            # episode split + fixed-length chunking + padding (reference :406-444)
+            train_keys = [*obs_keys, "actions", "logprobs", "values", "returns", "advantages", "prev_actions"]
+            sequences = build_sequences(local_data, train_keys, seq_len, num_envs, pad_multiple)
+            hx0 = sequences.pop("hx0")
+            cx0 = sequences.pop("cx0")
+            if fabric.num_processes > 1:
+                # every process must contribute the SAME padded count to the
+                # global array — agree on the max and pad with masked dummies
+                from sheeprl_tpu.parallel.collectives import all_gather_object
 
-            if policy_step - last_log >= cfg.metric.log_every or update == num_updates:
-                metrics_dict = aggregator.compute()
-                logger.log_metrics(metrics_dict, policy_step)
-                aggregator.reset()
-                if not timer.disabled:
-                    timer_metrics = timer.compute()
-                    if timer_metrics.get("Time/train_time"):
-                        logger.log_metrics(
-                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
-                            policy_step,
+                n_here = sequences["mask"].shape[1]
+                n_target = max(all_gather_object(n_here))
+                if n_here < n_target:
+                    extra = n_target - n_here
+                    sequences = {
+                        k: np.concatenate(
+                            [v, np.zeros((v.shape[0], extra, *v.shape[2:]), v.dtype)], axis=1
                         )
-                    if timer_metrics.get("Time/env_interaction_time"):
-                        logger.log_metrics(
-                            {
-                                "Time/sps_env_interaction": (
-                                    (policy_step - last_log) * cfg.env.action_repeat
-                                )
-                                / timer_metrics["Time/env_interaction_time"]
-                            },
-                            policy_step,
-                        )
-                    timer.reset()
-                last_log = policy_step
-                last_train = train_step
+                        for k, v in sequences.items()
+                    }
+                    hx0 = np.concatenate([hx0, np.zeros((extra, hx0.shape[1]), hx0.dtype)], axis=0)
+                    cx0 = np.concatenate([cx0, np.zeros((extra, cx0.shape[1]), cx0.dtype)], axis=0)
+                sequences = fabric.make_global(sequences, (None, fabric.data_axis))
+                hx0 = fabric.make_global(hx0, (fabric.data_axis,))
+                cx0 = fabric.make_global(cx0, (fabric.data_axis,))
 
-        if cfg.algo.anneal_clip_coef:
-            clip_coef = polynomial_decay(
-                update, initial=initial_clip_coef, final=0.0, max_decay_steps=num_updates, power=1.0
-            )
-        if cfg.algo.anneal_ent_coef:
-            ent_coef = polynomial_decay(
-                update, initial=initial_ent_coef, final=0.0, max_decay_steps=num_updates, power=1.0
-            )
+            with timer("Time/train_time"):
+                key, train_key = jax.random.split(key)
+                params, opt_state, metrics = train_fn(
+                    params,
+                    opt_state,
+                    sequences,
+                    hx0,
+                    cx0,
+                    train_key,
+                    # host numpy scalars — jnp.float32 would materialize them on
+                    # the default backend every update (see ppo.py)
+                    np.float32(clip_coef),
+                    np.float32(ent_coef),
+                )
+                # one host fetch serves the sync point and the three aggregator
+                # scalars below — block_until_ready plus a second asarray (or a
+                # blocking transfer per float()) would each be an extra round trip
+                metrics = np.asarray(metrics)
+            player.params = params
+            train_step += world_size
 
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            update == num_updates and cfg.checkpoint.save_last
-        ):
-            last_checkpoint = policy_step
-            ckpt_state = {
-                "agent": jax.device_get(params),
-                "opt_state": jax.device_get(opt_state),
-                "update": update,
-                "batch_size": int(cfg.algo.per_rank_batch_size) * world_size,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
-                "rng_key": jax.device_get(key),
-                "player_rng_key": jax.device_get(player_key),
-            }
-            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
-            fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
+            if cfg.metric.log_level > 0:
+                aggregator.update("Loss/policy_loss", float(metrics[0]))
+                aggregator.update("Loss/value_loss", float(metrics[1]))
+                aggregator.update("Loss/entropy_loss", float(metrics[2]))
+
+                if policy_step - last_log >= cfg.metric.log_every or update == num_updates:
+                    metrics_dict = aggregator.compute()
+                    logger.log_metrics(metrics_dict, policy_step)
+                    aggregator.reset()
+                    if not timer.disabled:
+                        timer_metrics = timer.compute()
+                        if timer_metrics.get("Time/train_time"):
+                            logger.log_metrics(
+                                {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                                policy_step,
+                            )
+                        if timer_metrics.get("Time/env_interaction_time"):
+                            logger.log_metrics(
+                                {
+                                    "Time/sps_env_interaction": (
+                                        (policy_step - last_log) * cfg.env.action_repeat
+                                    )
+                                    / timer_metrics["Time/env_interaction_time"]
+                                },
+                                policy_step,
+                            )
+                        timer.reset()
+                    last_log = policy_step
+                    last_train = train_step
+
+            if cfg.algo.anneal_clip_coef:
+                clip_coef = polynomial_decay(
+                    update, initial=initial_clip_coef, final=0.0, max_decay_steps=num_updates, power=1.0
+                )
+            if cfg.algo.anneal_ent_coef:
+                ent_coef = polynomial_decay(
+                    update, initial=initial_ent_coef, final=0.0, max_decay_steps=num_updates, power=1.0
+                )
+
+            if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+                update == num_updates and cfg.checkpoint.save_last
+            ):
+                last_checkpoint = policy_step
+                ckpt_state = {
+                    "agent": jax.device_get(params),
+                    "opt_state": jax.device_get(opt_state),
+                    "update": update,
+                    "batch_size": int(cfg.algo.per_rank_batch_size) * world_size,
+                    "last_log": last_log,
+                    "last_checkpoint": last_checkpoint,
+                    "rng_key": jax.device_get(key),
+                    "player_rng_key": jax.device_get(player_key),
+                }
+                ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
+                fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
-        test(player, fabric, cfg, log_dir)
+        if obs_widened:
+            import warnings
+
+            warnings.warn("skipping run_test: env.variants widened the observation past the host env's")
+        else:
+            test(player, fabric, cfg, log_dir)
     logger.finalize()

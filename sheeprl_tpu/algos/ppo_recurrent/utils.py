@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
+from sheeprl_tpu.obs.telemetry import telemetry_deliberate_compiles
 import jax
 import numpy as np
 
@@ -19,6 +20,9 @@ AGGREGATOR_KEYS = {
 MODELS_TO_REGISTER = {"agent"}
 
 
+# the eval rollout compiles fresh programs (eval batch shapes) after the
+# loop's warm point; that is a deliberate one-time compile, not a retrace
+@telemetry_deliberate_compiles("eval_rollout")
 def test(player: Any, fabric: Any, cfg: Dict[str, Any], log_dir: str) -> None:
     """Greedy evaluation episode threading the recurrent state
     (reference ppo_recurrent/utils.py test)."""

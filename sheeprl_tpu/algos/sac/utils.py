@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Sequence
 
+from sheeprl_tpu.obs.telemetry import telemetry_deliberate_compiles
 import numpy as np
 
 AGGREGATOR_KEYS = {
@@ -26,6 +27,9 @@ def prepare_obs(
     )
 
 
+# the eval rollout compiles fresh programs (eval batch shapes) after the
+# loop's warm point; that is a deliberate one-time compile, not a retrace
+@telemetry_deliberate_compiles("eval_rollout")
 def test(player: Any, fabric: Any, cfg: Dict[str, Any], log_dir: str) -> None:
     """Greedy evaluation episode (reference utils.py:38-62)."""
     from sheeprl_tpu.envs import make_env

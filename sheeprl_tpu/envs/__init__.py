@@ -6,7 +6,24 @@ framework uses everywhere — where the reference is NCHW (utils/env.py:193).
 """
 
 from sheeprl_tpu.envs.factory import build_vector_env, get_dummy_env, make_env, resolve_env_backend
-from sheeprl_tpu.envs.jittable import JaxCartPole, JaxPendulum, JittableEnvSpec, StepOut, get_jittable_env
+from sheeprl_tpu.envs.jittable import (
+    JaxCartPole,
+    JaxPendulum,
+    JittableEnvSpec,
+    StepOut,
+    get_jittable_env,
+    make_cartpole_spec,
+    make_pendulum_spec,
+    register_jittable_env,
+)
+from sheeprl_tpu.envs.variants import (
+    ScenarioFamily,
+    compose_variant_env_id,
+    identity_theta,
+    make_scenario_family,
+    parse_variant_env_id,
+    sample_scenario_matrix,
+)
 from sheeprl_tpu.envs.wrappers import (
     ActionRepeat,
     FrameStack,
@@ -22,8 +39,17 @@ __all__ = [
     "JaxCartPole",
     "JaxPendulum",
     "JittableEnvSpec",
+    "ScenarioFamily",
     "StepOut",
+    "compose_variant_env_id",
     "get_jittable_env",
+    "identity_theta",
+    "make_cartpole_spec",
+    "make_pendulum_spec",
+    "make_scenario_family",
+    "parse_variant_env_id",
+    "register_jittable_env",
+    "sample_scenario_matrix",
     "build_vector_env",
     "resolve_env_backend",
     "GrayscaleRenderWrapper",

@@ -22,6 +22,11 @@ API contract (single env; batch with ``jax.vmap``):
 Dynamics are transcribed from gymnasium's classic-control sources (CartPole's
 Euler integrator, Pendulum's clipped torque) and parity-tested per-transition
 against the gymnasium envs in ``tests/test_envs/test_jittable.py``.
+
+``make_cartpole_spec`` / ``make_pendulum_spec`` accept physics overrides that
+may be traced jax scalars, so ``envs/variants.py`` can vmap a whole matrix of
+randomized physics through one compiled program; the zero-argument calls below
+reproduce the gymnasium constants exactly.
 """
 
 from __future__ import annotations
@@ -32,6 +37,8 @@ import jax
 import jax.numpy as jnp
 
 Pytree = Any
+
+Scalar = Any  # python float or traced jax scalar
 
 
 class StepOut(NamedTuple):
@@ -55,6 +62,9 @@ class JittableEnvSpec(NamedTuple):
     init: Callable[[jax.Array], Pytree]
     step: Callable[[Pytree, jax.Array, jax.Array], Tuple[Pytree, StepOut]]
     observation: Callable[[Pytree], jax.Array]
+    # Pixel envs (envs/jittable_pixels.py) carry the full frame shape here;
+    # vector envs leave it None and expose ``(obs_dim,)`` implicitly.
+    obs_shape: Optional[Tuple[int, ...]] = None
 
 
 # ---------------------------------------------------------------------------
@@ -64,9 +74,7 @@ class JittableEnvSpec(NamedTuple):
 _CP_GRAVITY = 9.8
 _CP_MASSCART = 1.0
 _CP_MASSPOLE = 0.1
-_CP_TOTAL_MASS = _CP_MASSPOLE + _CP_MASSCART
 _CP_LENGTH = 0.5  # half the pole's length
-_CP_POLEMASS_LENGTH = _CP_MASSPOLE * _CP_LENGTH
 _CP_FORCE_MAG = 10.0
 _CP_TAU = 0.02
 _CP_THETA_THRESHOLD = 12 * 2 * jnp.pi / 360
@@ -83,45 +91,60 @@ def _cartpole_obs(state: Pytree) -> jax.Array:
     return state["y"]
 
 
-def _cartpole_step(state: Pytree, action: jax.Array, key: jax.Array) -> Tuple[Pytree, StepOut]:
-    del key  # deterministic dynamics; the key slot is for stochastic envs
-    x, x_dot, theta, theta_dot = state["y"]
-    force = jnp.where(action == 1, _CP_FORCE_MAG, -_CP_FORCE_MAG).astype(jnp.float32)
-    costheta = jnp.cos(theta)
-    sintheta = jnp.sin(theta)
-    temp = (force + _CP_POLEMASS_LENGTH * theta_dot**2 * sintheta) / _CP_TOTAL_MASS
-    thetaacc = (_CP_GRAVITY * sintheta - costheta * temp) / (
-        _CP_LENGTH * (4.0 / 3.0 - _CP_MASSPOLE * costheta**2 / _CP_TOTAL_MASS)
+def make_cartpole_spec(
+    *,
+    gravity: Scalar = _CP_GRAVITY,
+    masscart: Scalar = _CP_MASSCART,
+    masspole: Scalar = _CP_MASSPOLE,
+    length: Scalar = _CP_LENGTH,
+    force_mag: Scalar = _CP_FORCE_MAG,
+    tau: Scalar = _CP_TAU,
+) -> JittableEnvSpec:
+    """CartPole-v1 twin with overridable physics (args may be traced scalars)."""
+
+    def step(state: Pytree, action: jax.Array, key: jax.Array) -> Tuple[Pytree, StepOut]:
+        del key  # deterministic dynamics; the key slot is for stochastic envs
+        total_mass = masspole + masscart
+        polemass_length = masspole * length
+        x, x_dot, theta, theta_dot = state["y"]
+        force = jnp.where(action == 1, force_mag, -force_mag).astype(jnp.float32)
+        costheta = jnp.cos(theta)
+        sintheta = jnp.sin(theta)
+        temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+        thetaacc = (gravity * sintheta - costheta * temp) / (
+            length * (4.0 / 3.0 - masspole * costheta**2 / total_mass)
+        )
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        # Euler integration, gymnasium's kinematics_integrator="euler" order
+        x = x + tau * x_dot
+        x_dot = x_dot + tau * xacc
+        theta = theta + tau * theta_dot
+        theta_dot = theta_dot + tau * thetaacc
+        y = jnp.stack([x, x_dot, theta, theta_dot]).astype(jnp.float32)
+        t = state["t"] + 1
+        terminated = (
+            (x < -_CP_X_THRESHOLD)
+            | (x > _CP_X_THRESHOLD)
+            | (theta < -_CP_THETA_THRESHOLD)
+            | (theta > _CP_THETA_THRESHOLD)
+        )
+        truncated = t >= _CP_MAX_STEPS
+        out = StepOut(obs=y, reward=jnp.float32(1.0), terminated=terminated, truncated=truncated)
+        return {"y": y, "t": t}, out
+
+    return JittableEnvSpec(
+        env_id="CartPole-v1",
+        obs_dim=4,
+        is_continuous=False,
+        action_dim=2,
+        max_episode_steps=_CP_MAX_STEPS,
+        init=_cartpole_init,
+        step=step,
+        observation=_cartpole_obs,
     )
-    xacc = temp - _CP_POLEMASS_LENGTH * thetaacc * costheta / _CP_TOTAL_MASS
-    # Euler integration, gymnasium's kinematics_integrator="euler" order
-    x = x + _CP_TAU * x_dot
-    x_dot = x_dot + _CP_TAU * xacc
-    theta = theta + _CP_TAU * theta_dot
-    theta_dot = theta_dot + _CP_TAU * thetaacc
-    y = jnp.stack([x, x_dot, theta, theta_dot]).astype(jnp.float32)
-    t = state["t"] + 1
-    terminated = (
-        (x < -_CP_X_THRESHOLD)
-        | (x > _CP_X_THRESHOLD)
-        | (theta < -_CP_THETA_THRESHOLD)
-        | (theta > _CP_THETA_THRESHOLD)
-    )
-    truncated = t >= _CP_MAX_STEPS
-    out = StepOut(obs=y, reward=jnp.float32(1.0), terminated=terminated, truncated=truncated)
-    return {"y": y, "t": t}, out
 
 
-JaxCartPole = JittableEnvSpec(
-    env_id="CartPole-v1",
-    obs_dim=4,
-    is_continuous=False,
-    action_dim=2,
-    max_episode_steps=_CP_MAX_STEPS,
-    init=_cartpole_init,
-    step=_cartpole_step,
-    observation=_cartpole_obs,
-)
+JaxCartPole = make_cartpole_spec()
 
 
 # ---------------------------------------------------------------------------
@@ -153,36 +176,66 @@ def _pendulum_obs(state: Pytree) -> jax.Array:
     return jnp.stack([jnp.cos(th), jnp.sin(th), thdot]).astype(jnp.float32)
 
 
-def _pendulum_step(state: Pytree, action: jax.Array, key: jax.Array) -> Tuple[Pytree, StepOut]:
-    del key
-    th, thdot = state["y"]
-    u = jnp.clip(jnp.reshape(action, (-1,))[0], -_PD_MAX_TORQUE, _PD_MAX_TORQUE)
-    costs = _angle_normalize(th) ** 2 + 0.1 * thdot**2 + 0.001 * u**2
-    newthdot = thdot + (3 * _PD_G / (2 * _PD_L) * jnp.sin(th) + 3.0 / (_PD_M * _PD_L**2) * u) * _PD_DT
-    newthdot = jnp.clip(newthdot, -_PD_MAX_SPEED, _PD_MAX_SPEED)
-    newth = th + newthdot * _PD_DT
-    y = jnp.stack([newth, newthdot]).astype(jnp.float32)
-    t = state["t"] + 1
-    next_state = {"y": y, "t": t}
-    out = StepOut(
-        obs=_pendulum_obs(next_state),
-        reward=-costs.astype(jnp.float32),
-        terminated=jnp.bool_(False),
-        truncated=t >= _PD_MAX_STEPS,
+def make_pendulum_spec(
+    *,
+    g: Scalar = _PD_G,
+    m: Scalar = _PD_M,
+    l: Scalar = _PD_L,
+    dt: Scalar = _PD_DT,
+) -> JittableEnvSpec:
+    """Pendulum-v1 twin with overridable physics (args may be traced scalars)."""
+
+    def step(state: Pytree, action: jax.Array, key: jax.Array) -> Tuple[Pytree, StepOut]:
+        del key
+        th, thdot = state["y"]
+        u = jnp.clip(jnp.reshape(action, (-1,))[0], -_PD_MAX_TORQUE, _PD_MAX_TORQUE)
+        costs = _angle_normalize(th) ** 2 + 0.1 * thdot**2 + 0.001 * u**2
+        newthdot = thdot + (3 * g / (2 * l) * jnp.sin(th) + 3.0 / (m * l**2) * u) * dt
+        newthdot = jnp.clip(newthdot, -_PD_MAX_SPEED, _PD_MAX_SPEED)
+        newth = th + newthdot * dt
+        y = jnp.stack([newth, newthdot]).astype(jnp.float32)
+        t = state["t"] + 1
+        next_state = {"y": y, "t": t}
+        out = StepOut(
+            obs=_pendulum_obs(next_state),
+            reward=-costs.astype(jnp.float32),
+            terminated=jnp.bool_(False),
+            truncated=t >= _PD_MAX_STEPS,
+        )
+        return next_state, out
+
+    return JittableEnvSpec(
+        env_id="Pendulum-v1",
+        obs_dim=3,
+        is_continuous=True,
+        action_dim=1,
+        max_episode_steps=_PD_MAX_STEPS,
+        init=_pendulum_init,
+        step=step,
+        observation=_pendulum_obs,
     )
-    return next_state, out
 
 
-JaxPendulum = JittableEnvSpec(
-    env_id="Pendulum-v1",
-    obs_dim=3,
-    is_continuous=True,
-    action_dim=1,
-    max_episode_steps=_PD_MAX_STEPS,
-    init=_pendulum_init,
-    step=_pendulum_step,
-    observation=_pendulum_obs,
-)
+JaxPendulum = make_pendulum_spec()
+
+
+# Physics factories keyed by env id, consumed by the ``physics_*`` variant
+# combinators in ``envs/variants.py``.  Each maps the canonical randomization
+# axes (size / speed / mass multipliers) onto the env's own constants.
+def _cartpole_physics(size: Scalar, speed: Scalar, mass: Scalar) -> JittableEnvSpec:
+    return make_cartpole_spec(
+        length=_CP_LENGTH * size, tau=_CP_TAU * speed, masspole=_CP_MASSPOLE * mass
+    )
+
+
+def _pendulum_physics(size: Scalar, speed: Scalar, mass: Scalar) -> JittableEnvSpec:
+    return make_pendulum_spec(l=_PD_L * size, dt=_PD_DT * speed, m=_PD_M * mass)
+
+
+PHYSICS_FACTORIES: dict = {
+    "CartPole-v1": _cartpole_physics,
+    "Pendulum-v1": _pendulum_physics,
+}
 
 
 _REGISTRY = {
@@ -191,7 +244,15 @@ _REGISTRY = {
 }
 
 
+def register_jittable_env(spec: JittableEnvSpec) -> None:
+    """Register a jittable twin under its ``env_id`` (idempotent overwrite)."""
+    _REGISTRY[spec.env_id] = spec
+
+
 def get_jittable_env(env_id: str) -> Optional[JittableEnvSpec]:
     """The jittable twin of a gymnasium env id, or ``None`` when no pure
     reimplementation exists (the caller falls back to the host loop)."""
+    if env_id not in _REGISTRY and (env_id.startswith("PixelPointmass") or env_id.startswith("PixelPendulum")):
+        # Lazy-register the pixel family so importing this module stays cheap.
+        from sheeprl_tpu.envs import jittable_pixels  # noqa: F401
     return _REGISTRY.get(env_id)

@@ -1,0 +1,223 @@
+"""Jittable pixel envs: rendered ``[H, W, 3]`` uint8 frames from pure state.
+
+The pixel counterpart of :mod:`sheeprl_tpu.envs.jittable` — the SAC-AE /
+DroQ / Dreamer pixel pipelines get a dependency-free benchmark env (no
+dm_control, no ALE) whose rendering is a PURE function of the state vector:
+the same ``lax``-only draw runs identically jitted and eager (the
+determinism contract ``tests/test_envs/test_jittable_pixels.py`` pins), and
+vmaps over env batches like any other spec function.
+
+Two tasks, both continuous-action (the SAC family's requirement):
+
+- ``PixelPointmass-v0`` — a damped point mass on the unit square pushed by a
+  2-D force toward a fixed center target; per-step reward
+  ``1 - tanh(8 * dist)``, so a random policy hovers near 0 while a
+  goal-seeking one approaches 1 per step.  Frames show the green target disc
+  and the white agent disc.
+- ``PixelPendulum-v0`` — Pendulum-v1 dynamics (the vector twin's exact step
+  function) with the rod rendered from ``(theta, theta_dot)``; the classic
+  negative angle cost is unchanged.
+
+Both specs register into the :func:`~sheeprl_tpu.envs.jittable
+.get_jittable_env` registry at import (the registry lazy-imports this module
+for ``Pixel*`` ids), and :class:`JittablePixelEnv` adapts a spec to the host
+gymnasium API so the standard vectorized pipeline (and Dreamer's replay
+path) can drive them unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+from gymnasium import spaces
+
+from sheeprl_tpu.envs.jittable import (
+    JittableEnvSpec,
+    Pytree,
+    StepOut,
+    make_pendulum_spec,
+    register_jittable_env,
+)
+
+_PM_MAX_STEPS = 100
+_PM_DAMPING = 0.8
+_PM_FORCE = 0.02
+_PM_TARGET = (0.5, 0.5)
+
+
+def _disc_mask(size: int, cx: jax.Array, cy: jax.Array, radius: float) -> jax.Array:
+    """Boolean ``[size, size]`` disc at fractional center ``(cx, cy)`` (unit
+    coordinates, x right / y down)."""
+    px = (jnp.arange(size, dtype=jnp.float32) + 0.5) / size
+    xx, yy = jnp.meshgrid(px, px, indexing="xy")
+    return (xx - cx) ** 2 + (yy - cy) ** 2 <= radius**2
+
+
+def _paint(img: jax.Array, mask: jax.Array, color: Tuple[int, int, int]) -> jax.Array:
+    rgb = jnp.asarray(color, jnp.uint8)
+    return jnp.where(mask[..., None], rgb, img)
+
+
+def make_pixel_pointmass_spec(*, size: int = 64, env_id: str = "PixelPointmass-v0") -> JittableEnvSpec:
+    """Damped point mass on the unit square, observed as rendered frames."""
+    size = int(size)
+    target = jnp.asarray(_PM_TARGET, jnp.float32)
+
+    def render(state: Pytree) -> jax.Array:
+        pos = state["y"][:2]
+        img = jnp.zeros((size, size, 3), jnp.uint8)
+        img = _paint(img, _disc_mask(size, target[0], target[1], 4.0 / 64.0), (0, 200, 0))
+        img = _paint(img, _disc_mask(size, pos[0], pos[1], 5.0 / 64.0), (255, 255, 255))
+        return img
+
+    def init(key: jax.Array) -> Pytree:
+        pos = jax.random.uniform(key, (2,), jnp.float32, minval=0.1, maxval=0.9)
+        return {"y": jnp.concatenate([pos, jnp.zeros((2,), jnp.float32)]), "t": jnp.int32(0)}
+
+    def step(state: Pytree, action: jax.Array, key: jax.Array) -> Tuple[Pytree, StepOut]:
+        del key
+        pos, vel = state["y"][:2], state["y"][2:]
+        a = jnp.clip(jnp.reshape(action, (-1,))[:2], -1.0, 1.0)
+        vel = _PM_DAMPING * vel + _PM_FORCE * a
+        new_pos = pos + vel
+        clipped = jnp.clip(new_pos, 0.0, 1.0)
+        # walls absorb: the velocity component that drove into the wall zeroes
+        vel = jnp.where(new_pos == clipped, vel, 0.0)
+        t = state["t"] + 1
+        next_state = {"y": jnp.concatenate([clipped, vel]).astype(jnp.float32), "t": t}
+        dist = jnp.sqrt(jnp.sum((clipped - target) ** 2) + 1e-12)
+        out = StepOut(
+            obs=render(next_state),
+            reward=(1.0 - jnp.tanh(8.0 * dist)).astype(jnp.float32),
+            terminated=jnp.bool_(False),
+            truncated=t >= _PM_MAX_STEPS,
+        )
+        return next_state, out
+
+    return JittableEnvSpec(
+        env_id=env_id,
+        obs_dim=size * size * 3,
+        is_continuous=True,
+        action_dim=2,
+        max_episode_steps=_PM_MAX_STEPS,
+        init=init,
+        step=step,
+        observation=render,
+        obs_shape=(size, size, 3),
+    )
+
+
+def make_pixel_pendulum_spec(*, size: int = 64, env_id: str = "PixelPendulum-v0") -> JittableEnvSpec:
+    """Pendulum-v1 dynamics with the rod rendered from the state vector."""
+    size = int(size)
+    base = make_pendulum_spec()
+    rod_len = 0.35  # unit coordinates; pivot at the frame center
+    rod_halfwidth = 1.6 / 64.0
+
+    def render(state: Pytree) -> jax.Array:
+        th = state["y"][0]
+        # theta 0 is upright; screen y grows downward
+        tip = jnp.stack([0.5 + rod_len * jnp.sin(th), 0.5 - rod_len * jnp.cos(th)])
+        px = (jnp.arange(size, dtype=jnp.float32) + 0.5) / size
+        xx, yy = jnp.meshgrid(px, px, indexing="xy")
+        # distance from each pixel to the pivot->tip segment
+        dx, dy = tip[0] - 0.5, tip[1] - 0.5
+        seg2 = dx * dx + dy * dy + 1e-12
+        tt = jnp.clip(((xx - 0.5) * dx + (yy - 0.5) * dy) / seg2, 0.0, 1.0)
+        dist2 = (xx - (0.5 + tt * dx)) ** 2 + (yy - (0.5 + tt * dy)) ** 2
+        img = jnp.zeros((size, size, 3), jnp.uint8)
+        img = _paint(img, dist2 <= rod_halfwidth**2, (230, 90, 90))
+        img = _paint(img, _disc_mask(size, jnp.float32(0.5), jnp.float32(0.5), 2.5 / 64.0), (160, 160, 160))
+        return img
+
+    def step(state: Pytree, action: jax.Array, key: jax.Array) -> Tuple[Pytree, StepOut]:
+        next_state, out = base.step(state, action, key)
+        return next_state, out._replace(obs=render(next_state))
+
+    return JittableEnvSpec(
+        env_id=env_id,
+        obs_dim=size * size * 3,
+        is_continuous=True,
+        action_dim=1,
+        max_episode_steps=base.max_episode_steps,
+        init=base.init,
+        step=step,
+        observation=render,
+        obs_shape=(size, size, 3),
+    )
+
+
+_PIXEL_FACTORIES = {
+    "PixelPointmass-v0": make_pixel_pointmass_spec,
+    "PixelPendulum-v0": make_pixel_pendulum_spec,
+}
+
+for _factory in _PIXEL_FACTORIES.values():
+    register_jittable_env(_factory())
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled(env_id: str, size: int):
+    """One spec + jitted (init, step, observation) triple per (id, size):
+    every host env instance shares the same compiled programs instead of
+    recompiling per vector-env slot."""
+    factory = _PIXEL_FACTORIES.get(env_id)
+    if factory is None:
+        raise ValueError(f"unknown jittable pixel env '{env_id}' (have {sorted(_PIXEL_FACTORIES)})")
+    spec = factory(size=size)
+    return spec, jax.jit(spec.init), jax.jit(spec.step), jax.jit(spec.observation)
+
+
+class JittablePixelEnv(gym.Env):
+    """Host gymnasium adapter over a jittable pixel spec: the pure
+    ``init``/``step``/``observation`` run jitted on the host backend, one env
+    per instance, frames exposed under the ``rgb`` key (the pixel pipeline's
+    standard layout, like ``envs/toy.py``'s PixelCatcher)."""
+
+    metadata = {"render_modes": ["rgb_array"], "render_fps": 30}
+    render_mode = "rgb_array"
+
+    def __init__(self, id: str = "PixelPointmass-v0", size: int = 64, seed: Optional[int] = None) -> None:
+        spec, self._init, self._step, self._observation = _compiled(str(id), int(size))
+        self._spec = spec
+        self.observation_space = spaces.Dict(
+            {"rgb": spaces.Box(0, 255, spec.obs_shape, np.uint8)}
+        )
+        self.action_space = spaces.Box(-1.0, 1.0, (spec.action_dim,), np.float32)
+        if seed is not None:
+            self.action_space.seed(seed)
+        self._key = jax.random.PRNGKey(0 if seed is None else int(seed))
+        self._state: Optional[Pytree] = None
+
+    def _frame(self) -> Dict[str, np.ndarray]:
+        return {"rgb": np.asarray(self._observation(self._state))}
+
+    def reset(
+        self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
+    ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        if seed is not None:
+            self._key = jax.random.PRNGKey(int(seed))
+            self.action_space.seed(seed)
+        self._key, k_init = jax.random.split(self._key)
+        self._state = self._init(k_init)
+        return self._frame(), {}
+
+    def step(self, action: Any) -> Tuple[Dict[str, np.ndarray], float, bool, bool, Dict[str, Any]]:
+        self._key, k_step = jax.random.split(self._key)
+        act = np.asarray(action, np.float32).reshape(-1)
+        self._state, out = self._step(self._state, act, k_step)
+        return (
+            {"rgb": np.asarray(out.obs)},
+            float(out.reward),
+            bool(out.terminated),
+            bool(out.truncated),
+            {},
+        )
+
+    def render(self) -> np.ndarray:
+        return self._frame()["rgb"]

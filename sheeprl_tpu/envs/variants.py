@@ -1,0 +1,292 @@
+"""Procedural scenario variants over :class:`JittableEnvSpec`.
+
+Each variant is a pure spec→spec combinator parameterized by one scalar of a
+*scenario vector* theta.  Because the combinators only close over jax scalars,
+a whole ``[N, P]`` parameter matrix becomes N distinct env instances of one
+compiled program: ``jax.vmap(lambda th, ...: family.instantiate(th).step(...))``
+traces the wrapped dynamics once and batches the parameters like any other
+array input.  ``ops/rollout_scan.py`` threads the matrix through its
+``data``-axis ``shard_map`` alongside the env state, so domain randomization
+rides the fused superstep with zero extra dispatches.
+
+Conventions shared by every variant:
+
+- theta = 0.0 is the *identity point*: the wrapped spec reproduces the base
+  spec transition-for-transition (parity-tested against the host gymnasium
+  envs in ``tests/test_envs/test_variants.py``).
+- wrapper state nests the inner state under ``"env"`` plus the wrapper's own
+  fields, so combinators stack in any subset of the canonical order.
+- wrappers that consume randomness split the incoming key and pass the second
+  half inward, keeping the inner env's stream independent of the wrapper's.
+
+Variants (canonical application order, physics innermost):
+
+- ``phys_size`` / ``phys_speed`` / ``phys_mass`` — rebuild the base dynamics
+  with the matching constant scaled by ``exp(theta)`` (log-scale multiplier,
+  identity at 0).  Requires a physics factory in ``jittable.PHYSICS_FACTORIES``.
+- ``sticky_actions`` — with probability ``theta`` the previous action is
+  repeated instead of the new one (ALE-style sticky actions).
+- ``reward_delay`` — rewards are emitted ``round(theta * max_delay)`` steps
+  late through a fixed ring buffer; pending rewards flush on episode end so
+  the episodic return is preserved.
+- ``distractors`` — ``dims`` extra observation entries following an AR(1)
+  random walk scaled by ``theta`` (representation-robustness distractors in
+  the spirit of the fork's dmc_64/dmc_extended wrappers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.envs.jittable import (
+    PHYSICS_FACTORIES,
+    JittableEnvSpec,
+    StepOut,
+    get_jittable_env,
+)
+
+Pytree = Any
+
+# Canonical composition order: physics variants rebuild the base dynamics so
+# they must apply innermost; observation transforms apply last.
+VARIANT_ORDER: Tuple[str, ...] = (
+    "phys_size",
+    "phys_speed",
+    "phys_mass",
+    "sticky_actions",
+    "reward_delay",
+    "distractors",
+)
+
+# Default theta sampling ranges per variant (uniform).  Physics thetas are
+# log-scale multipliers; the rest are probabilities / fractions.
+DEFAULT_RANGES: Dict[str, Tuple[float, float]] = {
+    "phys_size": (-0.2, 0.2),
+    "phys_speed": (-0.2, 0.2),
+    "phys_mass": (-0.2, 0.2),
+    "sticky_actions": (0.0, 0.3),
+    "reward_delay": (0.0, 1.0),
+    "distractors": (0.0, 1.0),
+}
+
+# AR(1) coefficient for the distractor random walk.
+_DISTRACTOR_RHO = 0.9
+
+
+def _physics_axis(axis: str) -> Callable[[JittableEnvSpec, jax.Array], JittableEnvSpec]:
+    def combinator(spec: JittableEnvSpec, theta: jax.Array) -> JittableEnvSpec:
+        factory = PHYSICS_FACTORIES.get(spec.env_id)
+        if factory is None:
+            raise ValueError(f"no physics factory registered for env id '{spec.env_id}'")
+        factor = jnp.exp(theta)
+        one = jnp.float32(1.0)
+        factors = {"size": one, "speed": one, "mass": one}
+        factors[axis] = factor
+        return factory(factors["size"], factors["speed"], factors["mass"])
+
+    return combinator
+
+
+def with_sticky_actions(spec: JittableEnvSpec, theta: jax.Array) -> JittableEnvSpec:
+    """Repeat the previous action with probability ``theta`` (identity at 0)."""
+    if spec.is_continuous:
+        zero_action = jnp.zeros((spec.action_dim,), jnp.float32)
+    else:
+        zero_action = jnp.int32(0)
+
+    def init(key: jax.Array) -> Pytree:
+        return {"env": spec.init(key), "prev_a": zero_action, "has_prev": jnp.bool_(False)}
+
+    def step(state: Pytree, action: jax.Array, key: jax.Array) -> Tuple[Pytree, StepOut]:
+        k_sticky, k_env = jax.random.split(key)
+        # strict < keeps theta=0 an exact identity (uniform is in [0, 1))
+        use_prev = (jax.random.uniform(k_sticky) < theta) & state["has_prev"]
+        eff = jax.tree_util.tree_map(
+            lambda prev, new: jnp.where(use_prev, prev, new), state["prev_a"], action
+        )
+        inner_next, out = spec.step(state["env"], eff, k_env)
+        return {"env": inner_next, "prev_a": eff, "has_prev": jnp.bool_(True)}, out
+
+    def observation(state: Pytree) -> jax.Array:
+        return spec.observation(state["env"])
+
+    return spec._replace(init=init, step=step, observation=observation)
+
+
+def with_reward_delay(
+    spec: JittableEnvSpec, theta: jax.Array, *, max_delay: int = 4
+) -> JittableEnvSpec:
+    """Emit rewards ``round(theta * max_delay)`` steps late (identity at 0).
+
+    A fixed ``[max_delay]`` ring buffer keeps shapes static while theta picks
+    the effective delay per instance.  On episode end the whole buffer flushes
+    into the terminal reward so episodic return is preserved.
+    """
+
+    def init(key: jax.Array) -> Pytree:
+        return {"env": spec.init(key), "buf": jnp.zeros((max_delay,), jnp.float32)}
+
+    def step(state: Pytree, action: jax.Array, key: jax.Array) -> Tuple[Pytree, StepOut]:
+        inner_next, out = spec.step(state["env"], action, key)
+        k = jnp.clip(jnp.round(theta * max_delay).astype(jnp.int32), 0, max_delay)
+        buf = state["buf"]  # buf[i] is emitted i+1 steps from now
+        emit_now = jnp.where(k == 0, out.reward, buf[0])
+        shifted = jnp.concatenate([buf[1:], jnp.zeros((1,), jnp.float32)])
+        slot = (jnp.arange(max_delay) == (k - 1)) & (k > 0)
+        new_buf = shifted + jnp.where(slot, out.reward, jnp.float32(0.0))
+        done = out.terminated | out.truncated
+        emit = jnp.where(done, emit_now + new_buf.sum(), emit_now)
+        new_buf = jnp.where(done, jnp.zeros_like(new_buf), new_buf)
+        return {"env": inner_next, "buf": new_buf}, out._replace(reward=emit)
+
+    def observation(state: Pytree) -> jax.Array:
+        return spec.observation(state["env"])
+
+    return spec._replace(init=init, step=step, observation=observation)
+
+
+def with_distractors(
+    spec: JittableEnvSpec, theta: jax.Array, *, dims: int = 4
+) -> JittableEnvSpec:
+    """Append ``dims`` AR(1) noise entries scaled by ``theta`` to the obs."""
+
+    def init(key: jax.Array) -> Pytree:
+        k_dx, k_env = jax.random.split(key)
+        return {"env": spec.init(k_env), "dx": jax.random.normal(k_dx, (dims,), jnp.float32)}
+
+    def step(state: Pytree, action: jax.Array, key: jax.Array) -> Tuple[Pytree, StepOut]:
+        k_dx, k_env = jax.random.split(key)
+        inner_next, out = spec.step(state["env"], action, k_env)
+        eps = jax.random.normal(k_dx, (dims,), jnp.float32)
+        dx = _DISTRACTOR_RHO * state["dx"] + jnp.sqrt(1.0 - _DISTRACTOR_RHO**2) * eps
+        next_state = {"env": inner_next, "dx": dx}
+        return next_state, out._replace(obs=jnp.concatenate([out.obs, theta * dx]))
+
+    def observation(state: Pytree) -> jax.Array:
+        return jnp.concatenate([spec.observation(state["env"]), theta * state["dx"]])
+
+    return spec._replace(init=init, step=step, observation=observation, obs_dim=spec.obs_dim + dims)
+
+
+VARIANTS: Dict[str, Callable[..., JittableEnvSpec]] = {
+    "phys_size": _physics_axis("size"),
+    "phys_speed": _physics_axis("speed"),
+    "phys_mass": _physics_axis("mass"),
+    "sticky_actions": with_sticky_actions,
+    "reward_delay": with_reward_delay,
+    "distractors": with_distractors,
+}
+
+
+class ScenarioFamily(NamedTuple):
+    """A variant-wrapped env family: metadata + ``instantiate(theta) -> spec``.
+
+    ``instantiate`` is a pure function of a ``[param_dim]`` theta row; vmapping
+    it over an ``[N, param_dim]`` matrix yields N scenario instances of one
+    compiled program.  Metadata mirrors :class:`JittableEnvSpec` so downstream
+    code (agent building, rollout scan) treats both uniformly.
+    """
+
+    env_id: str  # composed id, e.g. "CartPole-v1+sticky_actions+distractors"
+    base_id: str
+    variant_names: Tuple[str, ...]
+    param_dim: int
+    obs_dim: int
+    is_continuous: bool
+    action_dim: int
+    max_episode_steps: int
+    instantiate: Callable[[jax.Array], JittableEnvSpec]
+
+
+def compose_variant_env_id(base_id: str, variant_names: Sequence[str]) -> str:
+    """Greppable composed id for telemetry: ``base+variant1+variant2``."""
+    return "+".join([base_id, *variant_names])
+
+
+def parse_variant_env_id(env_id: str) -> Tuple[str, Tuple[str, ...]]:
+    """Inverse of :func:`compose_variant_env_id`."""
+    base, *names = env_id.split("+")
+    return base, tuple(names)
+
+
+def canonical_variant_order(variant_names: Sequence[str]) -> Tuple[str, ...]:
+    """Sort requested variants into the canonical composition order."""
+    unknown = sorted(set(variant_names) - set(VARIANT_ORDER))
+    if unknown:
+        raise ValueError(f"unknown variant(s) {unknown}; known: {list(VARIANT_ORDER)}")
+    return tuple(name for name in VARIANT_ORDER if name in variant_names)
+
+
+def make_scenario_family(
+    base_id: str,
+    variant_names: Sequence[str],
+    *,
+    distractor_dims: int = 4,
+    reward_max_delay: int = 4,
+) -> Optional[ScenarioFamily]:
+    """Build a scenario family over ``base_id``'s jittable twin.
+
+    Returns ``None`` when the base env has no jittable twin (caller falls back
+    to the host loop, naming the composed variant id in its breadcrumb).
+    Raises on unknown variant names or physics variants without a factory.
+    """
+    names = canonical_variant_order(variant_names)
+    base = get_jittable_env(base_id)
+    if base is None:
+        return None
+    if any(n.startswith("phys_") for n in names) and base_id not in PHYSICS_FACTORIES:
+        raise ValueError(f"no physics factory registered for env id '{base_id}'")
+
+    def instantiate(theta: jax.Array) -> JittableEnvSpec:
+        spec = base
+        for i, name in enumerate(names):
+            if name == "distractors":
+                spec = with_distractors(spec, theta[i], dims=distractor_dims)
+            elif name == "reward_delay":
+                spec = with_reward_delay(spec, theta[i], max_delay=reward_max_delay)
+            else:
+                spec = VARIANTS[name](spec, theta[i])
+        return spec
+
+    obs_dim = base.obs_dim + (distractor_dims if "distractors" in names else 0)
+    return ScenarioFamily(
+        env_id=compose_variant_env_id(base_id, names),
+        base_id=base_id,
+        variant_names=names,
+        param_dim=len(names),
+        obs_dim=obs_dim,
+        is_continuous=base.is_continuous,
+        action_dim=base.action_dim,
+        max_episode_steps=base.max_episode_steps,
+        instantiate=instantiate,
+    )
+
+
+def identity_theta(family: ScenarioFamily) -> jax.Array:
+    """The theta row at which every variant is an exact no-op."""
+    return jnp.zeros((family.param_dim,), jnp.float32)
+
+
+def sample_scenario_matrix(
+    key: jax.Array,
+    n: int,
+    variant_names: Sequence[str],
+    ranges: Optional[Dict[str, Tuple[float, float]]] = None,
+) -> jax.Array:
+    """Uniformly sample an ``[n, P]`` scenario matrix, one column per variant.
+
+    ``ranges`` overrides :data:`DEFAULT_RANGES` per variant name.
+    """
+    names = canonical_variant_order(variant_names)
+    merged = dict(DEFAULT_RANGES)
+    merged.update(ranges or {})
+    cols = []
+    for name, k in zip(names, jax.random.split(key, max(len(names), 1))):
+        low, high = merged[name]
+        cols.append(jax.random.uniform(k, (n,), jnp.float32, minval=low, maxval=high))
+    if not cols:
+        return jnp.zeros((n, 0), jnp.float32)
+    return jnp.stack(cols, axis=1)

@@ -1,0 +1,147 @@
+"""Fused-rollout CLI acceptance for the ported algos (ISSUE 19 tentpole part 4).
+
+``algo.fused_rollout=True`` on a2c and ppo_recurrent must meet the same bar
+the PPO original is pinned to in ``test_fused_rollout.py``: exactly ONE train
+dispatch per update, zero post-warmup recompiles, no fused_fallback, and a
+run-registry record with ``variant=fused_rollout`` (the regress-gate cell
+key).  Scenario variants (``env.variants.enabled``) must ride the fused path
+end-to-end and refuse the host loop loudly rather than silently training the
+un-randomized base env.
+
+All CLI runs compile a real program, so everything here is marked ``slow``.
+"""
+
+import json
+import os
+
+import pytest
+
+from sheeprl_tpu.cli import run
+
+
+def _telemetry_events(tmp_path):
+    jsonls = []
+    for root, _, files in os.walk(tmp_path):
+        jsonls += [os.path.join(root, f) for f in files if f == "telemetry.jsonl"]
+    assert len(jsonls) == 1, f"expected exactly one telemetry.jsonl, found {jsonls}"
+    return [json.loads(line) for line in open(jsonls[0]) if line.strip()]
+
+
+def _registry_records(tmp_path):
+    path = os.path.join(tmp_path, "RUNS.jsonl")
+    assert os.path.exists(path)
+    return [json.loads(line) for line in open(path) if line.strip()]
+
+
+def _common_args(tmp_path):
+    return [
+        "fabric.devices=1",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "env.num_envs=2",
+        "checkpoint.save_last=False",
+        "metric.log_level=1",
+        "metric.telemetry.enabled=True",
+        "metric.telemetry.poll_interval=0.0",
+        f"metric.telemetry.runs_jsonl={tmp_path}/RUNS.jsonl",
+        f"log_base_dir={tmp_path}/logs",
+    ]
+
+
+def _assert_fused_acceptance(tmp_path, updates):
+    events = _telemetry_events(tmp_path)
+    assert "fused_fallback" not in {e["event"] for e in events}
+    (run_end,) = [e for e in events if e["event"] == "run_end"]
+    assert run_end["train_windows"] == updates
+    assert run_end["train_dispatches"] == updates  # ONE dispatch per update
+    assert run_end.get("recompiles", 0) == 0
+    assert run_end["fused_fallbacks"] == {}
+    (rec,) = [r for r in _registry_records(tmp_path) if r.get("kind") == "train"]
+    assert rec.get("variant") == "fused_rollout"
+    assert rec["train_dispatches"] == updates
+    return run_end
+
+
+@pytest.mark.slow
+def test_a2c_fused_cli_one_dispatch_per_update(tmp_path, monkeypatch):
+    """a2c + fused_rollout over 3 updates: 3 train windows, 3 dispatches,
+    0 recompiles once warm."""
+    monkeypatch.chdir(tmp_path)
+    run(
+        _common_args(tmp_path)
+        + [
+            "exp=a2c",
+            "dry_run=False",
+            "algo.total_steps=192",  # 3 updates of 32 steps x 2 envs
+            "algo.rollout_steps=32",
+            "algo.per_rank_batch_size=64",
+            "algo.fused_rollout=True",
+        ]
+    )
+    _assert_fused_acceptance(tmp_path, updates=3)
+
+
+@pytest.mark.slow
+def test_ppo_recurrent_fused_cli_one_dispatch_per_update(tmp_path, monkeypatch):
+    """ppo_recurrent + fused_rollout over 3 updates: the sequence-chunked
+    update (32-step rollout -> 16-step sequences) is still one dispatch."""
+    monkeypatch.chdir(tmp_path)
+    run(
+        _common_args(tmp_path)
+        + [
+            "exp=ppo_recurrent",
+            "dry_run=False",
+            "algo.total_steps=192",
+            "algo.rollout_steps=32",
+            "algo.per_rank_sequence_length=16",
+            "algo.per_rank_num_batches=2",
+            "algo.update_epochs=2",
+            "algo.fused_rollout=True",
+        ]
+    )
+    _assert_fused_acceptance(tmp_path, updates=3)
+
+
+@pytest.mark.slow
+def test_ppo_fused_cli_with_variants_single_dispatch(tmp_path, monkeypatch):
+    """env.variants ride the fused superstep: a scenario run (physics +
+    sticky + distractors, so the obs is widened too) is still one dispatch
+    per update with no fallback breadcrumb."""
+    monkeypatch.chdir(tmp_path)
+    run(
+        _common_args(tmp_path)
+        + [
+            "exp=ppo",
+            "dry_run=True",
+            "algo.rollout_steps=32",
+            "algo.per_rank_batch_size=8",
+            "algo.update_epochs=2",
+            "algo.encoder.cnn_features_dim=16",
+            "algo.encoder.mlp_features_dim=8",
+            "algo.fused_rollout=True",
+            "env.variants.enabled=[phys_size,sticky_actions,distractors]",
+        ]
+    )
+    _assert_fused_acceptance(tmp_path, updates=1)
+
+
+@pytest.mark.slow
+def test_variants_refuse_host_loop(tmp_path, monkeypatch):
+    """Variants without the fused path must fail loudly: the agent may be
+    built against the widened scenario obs and the host loop cannot apply
+    variants, so silently training the base env is never an option."""
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(RuntimeError, match="env.variants requires the fused rollout path"):
+        run(
+            _common_args(tmp_path)
+            + [
+                "exp=ppo",
+                "dry_run=True",
+                "algo.rollout_steps=32",
+                "algo.per_rank_batch_size=8",
+                "algo.fused_rollout=False",
+                "env.variants.enabled=[sticky_actions]",
+            ]
+        )

@@ -1,0 +1,308 @@
+"""Scenario-variant parity tests (ISSUE 19 tentpole part 1).
+
+Every combinator in ``sheeprl_tpu/envs/variants.py`` promises that theta = 0
+is an exact identity point — these tests pin that promise against the *host
+gymnasium envs* (not just the jittable twins), transition-for-transition in
+fp32, so a variant that perturbs the base dynamics at its identity point
+fails here rather than as a silent learning regression.  The vmapped-N vs
+N-sequential test pins the batching contract the fused superstep relies on:
+one [N, P] theta matrix through ``jax.vmap`` must equal N hand-instantiated
+scenario envs stepped one at a time.
+"""
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.config.compose import compose
+from sheeprl_tpu.envs.jittable import JaxCartPole, JaxPendulum
+from sheeprl_tpu.envs.variants import (
+    DEFAULT_RANGES,
+    VARIANT_ORDER,
+    canonical_variant_order,
+    compose_variant_env_id,
+    identity_theta,
+    make_scenario_family,
+    parse_variant_env_id,
+    sample_scenario_matrix,
+)
+from sheeprl_tpu.utils.utils import dotdict
+
+
+def _with_inner(state, y):
+    """Overwrite the base env state wherever the wrapper nests it."""
+    if "y" in state:
+        return {**state, "y": y, "t": jnp.int32(0)}
+    return {**state, "env": _with_inner(state["env"], y)}
+
+
+def test_compose_parse_roundtrip():
+    composed = compose_variant_env_id("CartPole-v1", ("sticky_actions", "distractors"))
+    assert composed == "CartPole-v1+sticky_actions+distractors"
+    assert parse_variant_env_id(composed) == ("CartPole-v1", ("sticky_actions", "distractors"))
+    assert parse_variant_env_id("Pendulum-v1") == ("Pendulum-v1", ())
+
+
+def test_canonical_order_and_unknown_variant():
+    # request order does not matter; composition order is canonical
+    assert canonical_variant_order(["distractors", "phys_mass"]) == ("phys_mass", "distractors")
+    with pytest.raises(ValueError, match="unknown variant"):
+        canonical_variant_order(["phys_mass", "gravity_flip"])
+
+
+def test_family_metadata():
+    family = make_scenario_family("CartPole-v1", ["distractors", "sticky_actions"])
+    assert family.env_id == "CartPole-v1+sticky_actions+distractors"
+    assert family.base_id == "CartPole-v1"
+    assert family.param_dim == 2
+    assert family.obs_dim == JaxCartPole.obs_dim + 4  # distractors widen the obs
+    assert family.action_dim == JaxCartPole.action_dim
+    assert not family.is_continuous
+    assert make_scenario_family("Acrobot-v1", ["sticky_actions"]) is None  # no twin
+    ident = identity_theta(family)
+    assert ident.shape == (2,) and float(jnp.abs(ident).sum()) == 0.0
+
+
+@pytest.mark.parametrize("variant", VARIANT_ORDER)
+def test_cartpole_identity_parity(variant):
+    """Each single-variant wrapper at theta=0 matches host gymnasium CartPole:
+    same next obs / reward / terminated at random interior and near-threshold
+    states (distractor dims must be exactly zero)."""
+    family = make_scenario_family("CartPole-v1", [variant])
+    spec = family.instantiate(identity_theta(family))
+    base_dim = JaxCartPole.obs_dim
+    step = jax.jit(spec.step)
+    env = gym.make("CartPole-v1")
+    env.reset(seed=0)
+    rng = np.random.default_rng(0)
+    states = list(rng.uniform(-0.05, 0.05, size=(25, 4)))
+    states += [
+        np.array([2.39, 1.0, 0.0, 0.0]),  # terminates on the x threshold
+        np.array([0.0, 0.0, 0.2094, 1.0]),  # terminates on the theta threshold
+    ]
+    for i, s in enumerate(states):
+        a = int(rng.integers(0, 2))
+        env.reset(seed=i)
+        env.unwrapped.state = np.asarray(s, np.float64)
+        obs_ref, reward_ref, term_ref, _trunc, _ = env.step(a)
+        state = _with_inner(spec.init(jax.random.PRNGKey(i)), jnp.asarray(s, jnp.float32))
+        _ns, out = step(state, jnp.int32(a), jax.random.PRNGKey(100 + i))
+        np.testing.assert_allclose(np.asarray(out.obs)[:base_dim], obs_ref, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(out.obs)[base_dim:], 0.0)
+        assert bool(out.terminated) == bool(term_ref)
+        assert float(out.reward) == float(reward_ref)
+    env.close()
+
+
+@pytest.mark.parametrize("variant", ["phys_mass", "sticky_actions", "reward_delay", "distractors"])
+def test_pendulum_identity_parity(variant):
+    """Continuous-action coverage: the wrappers at theta=0 match host
+    gymnasium Pendulum (including the out-of-range torque clip)."""
+    family = make_scenario_family("Pendulum-v1", [variant])
+    spec = family.instantiate(identity_theta(family))
+    base_dim = JaxPendulum.obs_dim
+    step = jax.jit(spec.step)
+    env = gym.make("Pendulum-v1")
+    env.reset(seed=0)
+    rng = np.random.default_rng(1)
+    for i in range(25):
+        th = rng.uniform(-np.pi, np.pi)
+        thdot = rng.uniform(-8.0, 8.0)
+        u = rng.uniform(-3.0, 3.0, size=1)
+        env.reset(seed=i)
+        env.unwrapped.state = np.array([th, thdot])
+        obs_ref, reward_ref, _term, _trunc, _ = env.step(u.astype(np.float32))
+        state = _with_inner(
+            spec.init(jax.random.PRNGKey(i)), jnp.asarray([th, thdot], jnp.float32)
+        )
+        _ns, out = step(state, jnp.asarray(u, jnp.float32), jax.random.PRNGKey(100 + i))
+        np.testing.assert_allclose(np.asarray(out.obs)[:base_dim], obs_ref, atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(out.obs)[base_dim:], 0.0)
+        assert float(out.reward) == pytest.approx(float(reward_ref), abs=1e-3)
+    env.close()
+
+
+def test_all_variants_stacked_identity_parity():
+    """The full six-variant stack at theta=0 is still an exact identity
+    against host gymnasium CartPole."""
+    family = make_scenario_family("CartPole-v1", list(VARIANT_ORDER))
+    assert family.param_dim == len(VARIANT_ORDER)
+    spec = family.instantiate(identity_theta(family))
+    step = jax.jit(spec.step)
+    env = gym.make("CartPole-v1")
+    env.reset(seed=0)
+    rng = np.random.default_rng(2)
+    for i in range(10):
+        s = rng.uniform(-0.05, 0.05, size=4)
+        a = int(rng.integers(0, 2))
+        env.reset(seed=i)
+        env.unwrapped.state = np.asarray(s, np.float64)
+        obs_ref, reward_ref, term_ref, _trunc, _ = env.step(a)
+        state = _with_inner(spec.init(jax.random.PRNGKey(i)), jnp.asarray(s, jnp.float32))
+        _ns, out = step(state, jnp.int32(a), jax.random.PRNGKey(100 + i))
+        np.testing.assert_allclose(
+            np.asarray(out.obs)[: JaxCartPole.obs_dim], obs_ref, atol=1e-5
+        )
+        np.testing.assert_array_equal(np.asarray(out.obs)[JaxCartPole.obs_dim :], 0.0)
+        assert bool(out.terminated) == bool(term_ref)
+        assert float(out.reward) == float(reward_ref)
+    env.close()
+
+
+def test_sticky_actions_repeats_previous_action():
+    """At theta=1 the requested action is ignored after the first step (the
+    previous action repeats); at theta=0 the requested action always lands."""
+    family = make_scenario_family("CartPole-v1", ["sticky_actions"])
+    sticky = family.instantiate(jnp.ones((1,), jnp.float32))
+    ident = family.instantiate(identity_theta(family))
+    s0 = sticky.init(jax.random.PRNGKey(0))
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    s1, _ = sticky.step(s0, jnp.int32(1), k1)
+    # theta=1: requesting 0 or 1 both replay the previous action (1)
+    _, out_forced = sticky.step(s1, jnp.int32(0), k2)
+    _, out_explicit = sticky.step(s1, jnp.int32(1), k2)
+    np.testing.assert_array_equal(np.asarray(out_forced.obs), np.asarray(out_explicit.obs))
+    # theta=0 from the same state: the two actions genuinely differ
+    _, out_a0 = ident.step(s1, jnp.int32(0), k2)
+    _, out_a1 = ident.step(s1, jnp.int32(1), k2)
+    assert not np.array_equal(np.asarray(out_a0.obs), np.asarray(out_a1.obs))
+
+
+def test_reward_delay_shifts_and_flushes():
+    """At theta=1 (delay = max_delay) rewards are held back in the ring, and
+    the pending buffer flushes on episode end so the episodic return is
+    exactly preserved."""
+    family = make_scenario_family("CartPole-v1", ["reward_delay"])
+    spec = family.instantiate(jnp.ones((1,), jnp.float32))
+    # cart drifting right from x=2.2: terminates at the 2.4 threshold in ~10
+    # steps, long enough for the 4-step ring to hold rewards back first
+    state = _with_inner(
+        spec.init(jax.random.PRNGKey(0)), jnp.asarray([2.2, 1.0, 0.0, 0.0], jnp.float32)
+    )
+    emitted, steps, out = [], 0, None
+    for t in range(50):
+        state, out = spec.step(state, jnp.int32(1), jax.random.fold_in(jax.random.PRNGKey(1), t))
+        emitted.append(float(out.reward))
+        steps += 1
+        if bool(out.terminated | out.truncated):
+            break
+    assert out is not None and bool(out.terminated)
+    assert steps > 4, "episode ended before the ring could delay anything"
+    assert emitted[:4] == [0.0] * 4  # first rewards held back by the ring
+    assert sum(emitted) == pytest.approx(float(steps))  # flushed on episode end
+
+
+def test_distractors_evolve_and_scale():
+    """At theta=1 the extra dims follow a non-degenerate AR(1) walk; the base
+    slice of the obs is untouched."""
+    family = make_scenario_family("CartPole-v1", ["distractors"])
+    spec = family.instantiate(jnp.ones((1,), jnp.float32))
+    base = family.instantiate(identity_theta(family))
+    assert spec.obs_dim == JaxCartPole.obs_dim + 4
+    state = spec.init(jax.random.PRNGKey(0))
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    s1, out1 = spec.step(state, jnp.int32(0), k1)
+    _s2, out2 = spec.step(s1, jnp.int32(0), k2)
+    dx1 = np.asarray(out1.obs)[JaxCartPole.obs_dim :]
+    dx2 = np.asarray(out2.obs)[JaxCartPole.obs_dim :]
+    assert np.abs(dx1).max() > 0 and not np.array_equal(dx1, dx2)
+    # same transition through the identity instance: base slice matches
+    _sb, outb = base.step(state, jnp.int32(0), k1)
+    np.testing.assert_allclose(
+        np.asarray(out1.obs)[: JaxCartPole.obs_dim],
+        np.asarray(outb.obs)[: JaxCartPole.obs_dim],
+        atol=1e-6,
+    )
+
+
+def test_vmapped_matches_sequential():
+    """One vmapped program over the [N, P] theta matrix == N sequentially
+    instantiated scenario envs, transition-for-transition — the batching
+    contract the fused superstep's shard_map path is built on."""
+    names = list(VARIANT_ORDER)
+    family = make_scenario_family("CartPole-v1", names)
+    n = 8
+    thetas = sample_scenario_matrix(jax.random.PRNGKey(0), n, names)
+    init_keys = jax.random.split(jax.random.PRNGKey(1), n)
+
+    def v_init(th, k):
+        return family.instantiate(th).init(k)
+
+    def v_step(th, s, a, k):
+        return family.instantiate(th).step(s, a, k)
+
+    states_v = jax.vmap(v_init)(thetas, init_keys)
+    states_s = [family.instantiate(thetas[i]).init(init_keys[i]) for i in range(n)]
+    jax.tree.map(
+        lambda a, *bs: np.testing.assert_allclose(
+            np.asarray(a), np.stack([np.asarray(b) for b in bs]), rtol=1e-6, atol=1e-6
+        ),
+        states_v,
+        *states_s,
+    )
+    rng = np.random.default_rng(3)
+    for t in range(5):
+        actions = jnp.asarray(rng.integers(0, 2, size=n), jnp.int32)
+        step_keys = jax.random.split(jax.random.fold_in(jax.random.PRNGKey(2), t), n)
+        states_v, out_v = jax.vmap(v_step)(thetas, states_v, actions, step_keys)
+        next_states, outs = [], []
+        for i in range(n):
+            si, oi = family.instantiate(thetas[i]).step(states_s[i], actions[i], step_keys[i])
+            next_states.append(si)
+            outs.append(oi)
+        states_s = next_states
+        jax.tree.map(
+            lambda a, *bs: np.testing.assert_allclose(
+                np.asarray(a), np.stack([np.asarray(b) for b in bs]), rtol=1e-6, atol=1e-6
+            ),
+            out_v,
+            *outs,
+        )
+    jax.tree.map(
+        lambda a, *bs: np.testing.assert_allclose(
+            np.asarray(a), np.stack([np.asarray(b) for b in bs]), rtol=1e-6, atol=1e-6
+        ),
+        states_v,
+        *states_s,
+    )
+
+
+def test_scenario_matrix_sampling():
+    names = ["phys_mass", "sticky_actions"]
+    thetas = np.asarray(sample_scenario_matrix(jax.random.PRNGKey(0), 64, names))
+    assert thetas.shape == (64, 2) and thetas.dtype == np.float32
+    lo, hi = DEFAULT_RANGES["phys_mass"]
+    assert np.all(thetas[:, 0] >= lo) and np.all(thetas[:, 0] <= hi)
+    lo, hi = DEFAULT_RANGES["sticky_actions"]
+    assert np.all(thetas[:, 1] >= lo) and np.all(thetas[:, 1] <= hi)
+    assert np.std(thetas[:, 0]) > 1e-3  # actually randomized
+    # per-variant range override
+    tight = np.asarray(
+        sample_scenario_matrix(
+            jax.random.PRNGKey(0), 64, names, ranges={"sticky_actions": (0.5, 0.5)}
+        )
+    )
+    np.testing.assert_allclose(tight[:, 1], 0.5)
+    # no variants -> [n, 0] matrix, not an error
+    assert sample_scenario_matrix(jax.random.PRNGKey(0), 4, []).shape == (4, 0)
+
+
+def test_fused_fallback_names_composed_variant_id():
+    """ISSUE 19 satellite: when the base env has no jittable twin, the
+    fallback breadcrumb names the full variant-composed id (sweep triage
+    greps which *scenario* was skipped, not just which base env)."""
+    from sheeprl_tpu.algos.ppo.ppo import resolve_fused_rollout_spec
+    from sheeprl_tpu.ops.superstep import reset_fused_fallback_warnings
+
+    cfg = dotdict(
+        compose(
+            "config",
+            ["exp=ppo", "env.id=Acrobot-v1", "env.variants.enabled=[phys_size,distractors]"],
+        )
+    )
+    reset_fused_fallback_warnings()
+    with pytest.warns(UserWarning, match=r"Acrobot-v1\+phys_size\+distractors"):
+        spec = resolve_fused_rollout_spec(cfg, None, [], ["state"], None, False, False, (3,))
+    assert spec is None

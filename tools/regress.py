@@ -107,6 +107,11 @@ METRICS: Dict[str, Tuple[bool, float]] = {
 # tpu* glob on purpose.
 METRIC_FLOORS: Tuple[Tuple[str, str, float], ...] = (
     ("train:dreamer_v3:*:tpu*:mfu", "mfu", 0.30),
+    # The ISSUE-19 bar: the batched domain-randomization sweep
+    # (benchmarks/scenario_sweep.py --record) must sustain >=100k AGGREGATE
+    # env-steps/s across its scenario instances — on every backend, CPU
+    # included (the bar was set on a single-core CPU host).
+    ("train:ppo:scenario_sweep:*", "sps_env", 100_000.0),
 )
 
 
@@ -339,9 +344,17 @@ def evaluate(
 # ---------------------------------------------------------------- output ----
 
 
-# keys owned by tools/jaxcheck (static config-matrix verdicts folded into the
-# same grid file) — a regression-gate rewrite must carry them forward
-PRESERVED_KEYS = ("config_cells", "config_summary", "static_findings")
+# keys owned by other tools writing into the same grid file — a
+# regression-gate rewrite must carry them forward: tools/jaxcheck's static
+# config-matrix verdicts (config_*, static_findings) and tools/sweep.py's
+# executed scenario verdicts (executed_*)
+PRESERVED_KEYS = (
+    "config_cells",
+    "config_summary",
+    "static_findings",
+    "executed_cells",
+    "executed_summary",
+)
 
 
 def write_scenarios(doc: Dict[str, Any], path: str) -> None:
@@ -471,6 +484,19 @@ def self_test() -> int:
         rec(2, "dreamer_v3", None, env="mfu_probe", variant="mfu", mfu=0.0),
         rec(3, "dreamer_v3", None, env="mfu_probe", variant="mfu", mfu=0.0),
     ]
+
+    # ISSUE-19 scenario-sweep floor: the batched domain-randomization cell
+    # carries an absolute 100k aggregate-sps bar on EVERY backend (the bar
+    # was set on a single-core CPU host), firing even on a first record
+    def sweep_rec(t, sps, backend="cpu"):
+        return rec(t, "ppo", sps, env="scenario_sweep", backend=backend, variant="fused_scenarios")
+
+    records += [
+        sweep_rec(1, 190000.0),
+        sweep_rec(2, 230000.0),
+        sweep_rec(3, 240000.0),
+        sweep_rec(1, 60000.0, backend="fake"),
+    ]
     doc = evaluate(records)
     got = {}
     for key, cell in doc["cells"].items():
@@ -511,15 +537,61 @@ def self_test() -> int:
     cpu_mfu = doc["cells"].get("train:dreamer_v3:mfu_probe:cpux1p1:mfu")
     if cpu_mfu is None or cpu_mfu["verdict"] != "pass" or "floor" in cpu_mfu["metrics"]["mfu"]:
         failures.append(f"mfu floor: CPU virtual-mesh cell must not be floored, got {cpu_mfu}")
+    sweep_ok = doc["cells"].get("train:ppo:scenario_sweep:cpux1p1:fused_scenarios")
+    if (
+        sweep_ok is None
+        or sweep_ok["verdict"] != "pass"
+        or sweep_ok["metrics"]["sps_env"].get("floor") != 100_000.0
+    ):
+        failures.append(f"scenario_sweep floor: want passing cell carrying floor=100k, got {sweep_ok}")
+    sweep_low = doc["cells"].get("train:ppo:scenario_sweep:fakex1p1:fused_scenarios")
+    if sweep_low is None or sweep_low["verdict"] != "regress":
+        failures.append(f"scenario_sweep floor: a 60k cell must regress even with no history, got {sweep_low}")
     if slo_goodput({"qps": 900.0, "p95_ms": 250.0, "slo_ms": 100.0}) != 0.0:
         failures.append("qps@p95: an SLO miss must zero the goodput")
     if slo_goodput({"load_report": {"mode": "ramp", "max_good_qps": 123.0}}) != 123.0:
         failures.append("qps@p95: a ramp report's max_good_qps must win over uptime counters")
     if exit_code(doc) != 1:
         failures.append(f"exit code: want 1, got {exit_code(doc)}")
-    healthy = [r for r in records if r["algo"] != "sac" and r.get("env") != "mfu_probe_xl"]
+    healthy = [
+        r
+        for r in records
+        if r["algo"] != "sac"
+        and r.get("env") != "mfu_probe_xl"
+        and not (r.get("env") == "scenario_sweep" and r.get("backend") == "fake")
+    ]
     if exit_code(evaluate(healthy)) != 0:
         failures.append("exit code without the regressed cells: want 0")
+
+    # a regress rewrite of the grid file must carry every PRESERVED_KEYS
+    # section (static config verdicts AND tools/sweep.py executed verdicts)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        grid_path = os.path.join(td, "SCENARIOS.json")
+        prev = {
+            "schema": SCHEMA_VERSION,
+            "config_cells": {"config:exp=ppo:fabric=cpu": {"verdict": "pass"}},
+            "config_summary": {"cells": 1, "pass": 1},
+            "static_findings": [],
+            "executed_cells": {
+                "sweep:ppo:CartPole-v1+sticky_actions": {"tier": "learn", "verdict": "learn_pass"}
+            },
+            "executed_summary": {"cells": 1, "verdicts": {"learn_pass": 1}},
+        }
+        with open(grid_path, "w") as f:
+            json.dump(prev, f)
+        write_scenarios(evaluate(healthy), grid_path)
+        with open(grid_path) as f:
+            merged = json.load(f)
+        missing = [k for k in PRESERVED_KEYS if k not in merged]
+        if missing:
+            failures.append(f"write_scenarios dropped preserved sections: {missing}")
+        kept = (merged.get("executed_cells") or {}).get("sweep:ppo:CartPole-v1+sticky_actions") or {}
+        if kept.get("verdict") != "learn_pass":
+            failures.append(f"executed cell mutated through the regress rewrite: {kept}")
+        if "cells" not in merged:
+            failures.append("regress rewrite lost its own verdict grid")
     if failures:
         print("regress self-test FAILED:\n  " + "\n  ".join(failures), file=sys.stderr)
         return 1
