@@ -780,12 +780,12 @@ def serve_registry_stats(records) -> dict:
     (scale events, summed router counters, best goodput). A fleet
     acceptance sweep registers several serve runs back-to-back; digesting
     only the newest record — the old behaviour — hid every earlier run."""
-    serve_recs = [r for r in records if r.get("kind") == "serve"]
+    serve_recs = [r for r in records if r.get("kind") in ("serve", "serve_train")]
     if not serve_recs:
         return {
             "error": (
-                "no serve records in this registry (kind=serve). Serve sessions append "
-                "one on exit via register_run; run `python -m sheeprl_tpu serve ...` "
+                "no serve records in this registry (kind=serve/serve_train). Serve sessions "
+                "append one on exit via register_run; run `python -m sheeprl_tpu serve ...` "
                 "first (see howto/serving.md)"
             )
         }
@@ -797,11 +797,16 @@ def serve_registry_stats(records) -> dict:
         row: dict = {
             "record": idx,
             "t": rec.get("t"),
+            "kind": rec.get("kind"),
             "algo": rec.get("algo"),
             "env": rec.get("env"),
             "variant": rec.get("variant"),
             "outcome": rec.get("outcome"),
         }
+        # serve_train records carry the online-learning bridge counters
+        # (eval improvement, shed experience, hook/publish/swap books)
+        if isinstance(rec.get("online"), dict):
+            row["online"] = dict(rec["online"])
         for k in ("qps", "p50_ms", "p95_ms", "slo_ms", "completed",
                   "shed_overloaded", "shed_expired", "failed"):
             if isinstance(stats.get(k), (int, float)):
@@ -934,6 +939,22 @@ def serve_stats(events_or_path) -> dict:
             }
             for e in swaps
         ]
+    # online-learning bridge fold: every serve_event the bridge emits is
+    # prefixed ``online_`` (exp_slab/exp_slab_shed/hook_hang/publish_*/...);
+    # a run_end ``online`` section (bridge+learner+publisher snapshot with
+    # shed_experience and the feedback-hook books) wins when present
+    online_events = {
+        k[len("online_"):]: n for k, n in sorted(by_kind.items()) if k.startswith("online_")
+    }
+    run_end_online = None
+    for e in reversed(events):
+        if e.get("event") == "run_end" and isinstance(e.get("online"), dict):
+            run_end_online = e["online"]
+            break
+    if online_events or run_end_online:
+        out["online"] = {**(run_end_online or {})}
+        if online_events:
+            out["online"]["events"] = online_events
     return out
 
 
@@ -1600,6 +1621,19 @@ if __name__ == "__main__":
         "backend, 'drain' runs every eligible entry now",
     )
     parser.add_argument(
+        "--drills",
+        action="store_true",
+        help="chaos-drill registry (tools/drills.py): every registered fault "
+        "kind cross-referenced against the tests that drill it, with pytest "
+        "markers and last cached verdicts; exit nonzero if any registered "
+        "fault kind has no drill",
+    )
+    parser.add_argument(
+        "--drills-json",
+        action="store_true",
+        help="with --drills: print the full registry JSON instead of the summary",
+    )
+    parser.add_argument(
         "--static",
         action="store_true",
         help="static gate: run the jaxcheck rule scan + config-matrix "
@@ -1666,6 +1700,38 @@ if __name__ == "__main__":
         print(json.dumps(results, indent=1))
         ran = [r for r in results if not r["outcome"].startswith("skipped")]
         sys.exit(0 if all(r["outcome"] == "completed" for r in ran) else 1)
+    if args.drills:
+        # the scanner imports the fault-domain modules (registration happens
+        # at import), so it runs in a child and this parent stays jax-free
+        import subprocess
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.drills", "--json"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        try:
+            registry = json.loads(proc.stdout)
+        except ValueError:
+            sys.stderr.write(proc.stdout + proc.stderr)
+            sys.exit(proc.returncode or 2)
+        if args.drills_json:
+            print(json.dumps(registry, indent=1))
+        else:
+            totals = registry["totals"]
+            print(
+                f"drills: {totals['drills']} tests exercise "
+                f"{totals['kinds_covered']}/{totals['kinds']} registered fault kinds"
+            )
+            for drill in registry["drills"]:
+                marks = ",".join(drill["markers"]) or "-"
+                kinds = ",".join(drill["fault_kinds"])
+                print(f"  [{drill['verdict']:>7}] {drill['nodeid']} marks={marks} faults={kinds}")
+            for domain, kinds in sorted(registry.get("uncovered", {}).items()):
+                print(f"  UNDRILLED {domain}: {', '.join(kinds)}")
+        sys.exit(0 if not registry.get("uncovered") else 1)
     if args.static:
         # jaxcheck imports the config plane with algo imports gated off, so
         # the child never loads jax; a subprocess keeps this parent identical

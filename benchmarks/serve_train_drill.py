@@ -1,0 +1,303 @@
+"""Online-learning loop drill at benchmark shape (ISSUE 20 acceptance).
+
+Runs the WHOLE production loop in one process, driven by the load
+generator — the loadgen's tapped clients ARE the served traffic:
+
+  loadgen -> fleet router/replicas -> ServeClient experience tap ->
+  ExperienceBridge (feedback hook, slab assembly) -> shm trajectory ring ->
+  OnlineLearner (staleness-bounded admission, masked regression) ->
+  CheckpointPublisher (committed checkpoint, monotonic version) ->
+  hot-swap gauntlet -> every replica serves the new version.
+
+The served policy boots far from a hidden expert; the feedback hook scores
+every served action against that expert (reward = -||a - a*||^2, target =
+a*), so *eval return* — mean hook reward of the currently-served policy on
+a fixed eval set — must measurably improve mid-run if and only if the loop
+actually closes. The drill fails loudly when it doesn't.
+
+``--record`` appends one ``kind=serve_train`` registry line
+(``serve_train:linear:linear_feedback:<backend>xDpP:bridge``) carrying the
+``online`` section (eval_return_delta, shed_experience, learner/publisher
+books) and ``serve_stats`` (qps/p95/SLO + load report), which
+``tools/regress.py`` gates with an absolute ``eval_return_delta >= 0.5``
+floor and the usual qps@p95 goodput band.
+
+Usage:
+  python benchmarks/serve_train_drill.py [--duration-s 6] [--rate-hz 300]
+      [--concurrency 4] [--record] [--runs RUNS.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SLO_MS = 200.0
+
+SERVE_NODE = {
+    "batch_ladder": [1, 2, 4, 8],
+    "slo_ms": SLO_MS,
+    "monitor_interval_s": 0.05,
+    "backoff_base_s": 0.02,
+    "backoff_max_s": 0.2,
+    "max_queue": 256,
+}
+FLEET_NODE = {
+    "enabled": True,
+    "num_replicas": 2,
+    "min_replicas": 1,
+    "max_replicas": 2,
+    "backlog_per_replica": 64,
+    "hedge_scan_ms": 2.0,
+    "autoscale_interval_s": 0.05,
+}
+
+
+def build_loop(workdir: str, *, rows_per_slab: int = 8, publish_every: int = 2, lr: float = 0.05):
+    """The same closed loop the tests drill, at benchmark scale."""
+    import numpy as np
+
+    from sheeprl_tpu.net.transport import ShmLearnerTransport, attach_actor_transport
+    from sheeprl_tpu.online import (
+        CheckpointPublisher,
+        ExperienceBridge,
+        Feedback,
+        GuardedHook,
+        OnlineConfig,
+        OnlineLearner,
+        VersionAuthority,
+        build_experience_layout,
+        linear_feedback_train_step,
+    )
+    from sheeprl_tpu.online.learner import linear_state
+    from sheeprl_tpu.resilience.manifest import build_manifest
+    from sheeprl_tpu.serve.config import serve_config_from_cfg
+    from sheeprl_tpu.serve.fleet import FleetServer
+    from sheeprl_tpu.serve.policy import build_linear_policy, make_linear_state
+    from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+    # boot policy (seed 0) far from the hidden expert (seed 7) the hook scores
+    ckpt_dir = os.path.join(workdir, "checkpoint")
+    os.makedirs(ckpt_dir)
+    state = make_linear_state(seed=0)
+    boot_path = os.path.join(ckpt_dir, "ckpt_100_0.ckpt")
+    man = build_manifest(step=100, backend="pickle", world_size=1, state=state)
+    save_checkpoint(boot_path, state, backend="pickle", manifest=man)
+
+    expert = make_linear_state(seed=7)
+    w_star = np.asarray(expert["agent"]["w"], dtype=np.float32)
+    b_star = np.asarray(expert["agent"]["b"], dtype=np.float32)
+
+    def hook(obs, action):
+        x = np.asarray(obs["vector"], dtype=np.float32)
+        target = x @ w_star + b_star
+        reward = -float(np.sum((np.asarray(action, dtype=np.float32) - target) ** 2))
+        return Feedback(reward=reward, target=target)
+
+    policy = build_linear_policy({"algo": {"name": "linear"}}, state)
+    cfg = serve_config_from_cfg({"serve": {**SERVE_NODE, "fleet": dict(FLEET_NODE)}})
+    server = FleetServer(policy, cfg, step=100, path=boot_path, ckpt_dir=ckpt_dir)
+    server.start()
+
+    ocfg = OnlineConfig(
+        enabled=True,
+        rows_per_slab=rows_per_slab,
+        ring_slots=4,
+        max_staleness=4,
+        publish_every=publish_every,
+        lr=lr,
+        hook_timeout_s=1.0,
+    )
+    authority = VersionAuthority(boot_step=100)
+    server.store.version_authority = authority
+    out_dim = np.asarray(state["agent"]["b"]).shape[0]
+    layout = build_experience_layout(policy.obs_spec, (out_dim,), ocfg.rows_per_slab)
+    learner_transport = ShmLearnerTransport(
+        payload_bytes=layout.nbytes, num_slots=ocfg.ring_slots, param_nbytes=64
+    )
+    actor_transport = attach_actor_transport(
+        learner_transport.actor_wire(0),
+        actor_id=0,
+        generation=0,
+        slots=list(range(ocfg.ring_slots)),
+    )
+    guard = GuardedHook(hook, timeout_s=ocfg.hook_timeout_s)
+    bridge = ExperienceBridge(
+        layout=layout, transport=actor_transport, authority=authority, hook=guard, cfg=ocfg
+    )
+    publisher = CheckpointPublisher(
+        ckpt_dir=ckpt_dir,
+        authority=authority,
+        state_fn=linear_state,
+        servers=[server],
+        boot_step=100,
+    )
+    params0 = {k: np.asarray(v, dtype=np.float32) for k, v in state["agent"].items()}
+    learner = OnlineLearner(
+        transport=learner_transport,
+        layout=layout,
+        authority=authority,
+        cfg=ocfg,
+        params=params0,
+        train_step=linear_feedback_train_step(ocfg.lr),
+        publisher=publisher,
+    )
+    bridge.start()
+    learner.start()
+    return {
+        "server": server,
+        "bridge": bridge,
+        "learner": learner,
+        "publisher": publisher,
+        "authority": authority,
+        "hook": hook,
+        "transports": (actor_transport, learner_transport),
+        "state": state,
+    }
+
+
+def eval_return(server, hook, *, n: int = 64, seed: int = 123) -> float:
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    in_dim = server.policy.obs_spec["vector"].shape[0]
+    total = 0.0
+    for _ in range(n):
+        obs = {"vector": rng.standard_normal(in_dim).astype(np.float32)}
+        total += hook(obs, server.infer(obs, deadline_s=10.0)).reward
+    return total / n
+
+
+def run_drill(duration_s: float, rate_hz: float, concurrency: int) -> dict:
+    import numpy as np
+
+    from sheeprl_tpu.serve.config import LoadConfig
+    from sheeprl_tpu.serve.loadgen import run_load
+
+    with tempfile.TemporaryDirectory(prefix="serve_train_") as workdir:
+        loop = build_loop(workdir)
+        server, bridge, learner, publisher = (
+            loop["server"], loop["bridge"], loop["learner"], loop["publisher"],
+        )
+        try:
+            before = eval_return(server, loop["hook"])
+            rng = np.random.default_rng(0)
+            in_dim = server.policy.obs_spec["vector"].shape[0]
+
+            def obs_factory(i: int):
+                return {"vector": rng.standard_normal(in_dim).astype(np.float32)}
+
+            lcfg = LoadConfig(
+                enabled=True,
+                rate_hz=float(rate_hz),
+                duration_s=float(duration_s) / 2.0,
+                concurrency=int(concurrency),
+                timeout_ms=2_000.0,
+            )
+            first = run_load(server, lcfg, obs_factory=obs_factory, experience_sink=bridge.observe)
+            mid = eval_return(server, loop["hook"])  # measurable improvement MID-run
+            second = run_load(server, lcfg, obs_factory=obs_factory, experience_sink=bridge.observe)
+            # let in-flight slabs/publishes drain before the final read
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and learner.transport.occupancy() > 0:
+                time.sleep(0.05)
+            after = eval_return(server, loop["hook"])
+
+            reports = [first, second]
+            ok = sum(r["ok"] for r in reports)
+            dropped = sum(r["errors"] + r["expired"] for r in reports)
+            p95 = max(r["p95_ms"] for r in reports)
+            online = {
+                "eval_return_before": before,
+                "eval_return_mid": mid,
+                "eval_return_after": after,
+                "eval_return_delta": after - before,
+                "eval_return_delta_mid": mid - before,
+                "shed_experience": bridge.shed_experience,
+                **{f"bridge_{k}": v for k, v in bridge.snapshot().items()},
+                **{f"learner_{k}": v for k, v in learner.snapshot().items()},
+                **{f"authority_{k}": v for k, v in loop["authority"].snapshot().items()},
+            }
+            serve_stats = {
+                "qps": sum(r["qps"] for r in reports) / len(reports),
+                "p50_ms": max(r["p50_ms"] for r in reports),
+                "p95_ms": p95,
+                "slo_ms": SLO_MS,
+                "load_report": second,
+            }
+            checks = {
+                "eval_improved_mid_run": mid > before + 0.5,
+                "eval_improved_overall": after - before >= 0.5,
+                "p95_within_slo": p95 <= SLO_MS,
+                "zero_dropped_admitted": dropped == 0,
+                "versions_confirmed": loop["authority"].confirmed_version >= 1,
+            }
+            return {
+                "ok_requests": ok,
+                "dropped": dropped,
+                "online": online,
+                "serve_stats": serve_stats,
+                "checks": checks,
+                "passed": all(checks.values()),
+            }
+        finally:
+            bridge.close()
+            learner.close()
+            server.close()
+            for t in loop["transports"]:
+                t.close()
+
+
+def record_cell(rec: dict, runs_path: str | None) -> None:
+    """One ``serve_train:linear:linear_feedback:<backend>xDpP:bridge`` line."""
+    import jax
+
+    from sheeprl_tpu.obs.registry import SCHEMA_VERSION, append_run_record, git_sha, runs_jsonl_path
+
+    record = {
+        "schema": SCHEMA_VERSION,
+        "t": time.time(),
+        "kind": "serve_train",
+        "algo": "linear",
+        "env": "linear_feedback",
+        "backend": jax.default_backend(),
+        "local_device_count": jax.local_device_count(),
+        "process_count": jax.process_count(),
+        "variant": "bridge",
+        "outcome": "completed" if rec["passed"] else "crashed",
+        "git_sha": git_sha(),
+        "online": rec["online"],
+        "serve_stats": rec["serve_stats"],
+    }
+    path = runs_jsonl_path(None, runs_path)
+    if path is None:
+        print("run registry disabled (SHEEPRL_TPU_RUNS_JSONL empty); record dropped", flush=True)
+        return
+    append_run_record(record, path)
+    print(f"recorded serve_train cell -> {path}", flush=True)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--duration-s", type=float, default=6.0)
+    parser.add_argument("--rate-hz", type=float, default=300.0)
+    parser.add_argument("--concurrency", type=int, default=4)
+    parser.add_argument("--record", action="store_true", help="append the RUNS.jsonl cell")
+    parser.add_argument("--runs", default="RUNS.jsonl")
+    args = parser.parse_args()
+
+    rec = run_drill(args.duration_s, args.rate_hz, args.concurrency)
+    print(json.dumps(rec, indent=1, default=float))
+    if args.record:
+        record_cell(rec, args.runs)
+    return 0 if rec["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

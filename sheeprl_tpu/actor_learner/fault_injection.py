@@ -41,11 +41,12 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Dict, List, Mapping, Sequence
 
-from sheeprl_tpu.utils.faults import DeterministicSchedule, parse_fault_entries
+from sheeprl_tpu.utils.faults import DeterministicSchedule, parse_fault_entries, register_fault_domain
 
 ACTOR_KINDS = ("actor_crash_mid_write", "actor_hang")
 LEARNER_KINDS = ("learner_kill", "param_lane_stall")
 _KINDS = ACTOR_KINDS + LEARNER_KINDS
+register_fault_domain("actor_learner", _KINDS)
 
 
 @dataclass

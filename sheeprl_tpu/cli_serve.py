@@ -80,11 +80,19 @@ def serving(args: Optional[List[str]] = None) -> None:
             )
         ckpt_dir = ckpt_dir or os.path.dirname(os.path.abspath(ckpt_path))
     else:
-        from sheeprl_tpu.serve.model import newest_committed
+        import warnings
 
-        newest = newest_committed(ckpt_dir)
+        from sheeprl_tpu.resilience.discovery import newest_committed, validation_load_gate
+
+        newest = newest_committed(
+            ckpt_dir,
+            gates=(validation_load_gate,),
+            on_reject=lambda cand, reason: warnings.warn(
+                f"serve: skipping checkpoint {cand.path!r} (step {cand.step}): {reason}"
+            ),
+        )
         if newest is None:
-            raise FileNotFoundError(f"no committed checkpoint found in {ckpt_dir}")
+            raise FileNotFoundError(f"no committed, loadable checkpoint found in {ckpt_dir}")
         ckpt_path, man = newest.path, newest.manifest
 
     cfg_path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(ckpt_path))), "config.yaml")

@@ -21,9 +21,14 @@ over the shared frame protocol (:mod:`sheeprl_tpu.net.frame`):
 
 The agent is single-threaded and ``select``-pumped like the TCP learner
 transport — no background threads, so the static-analysis (jaxcheck) thread
-rules hold. Params are fixed at boot: hot-swap across hosts is out of scope
-for v0 (the fleet's swap machinery is same-process); restart the agent on a
-newer committed checkpoint instead (howto/multihost.md).
+rules hold. Params are held in a :class:`~sheeprl_tpu.serve.model.ModelStore`,
+so the PR 6 hot-swap validation gauntlet runs *on the remote host* too:
+with ``ckpt_dir`` + ``swap_poll_s`` the pump loop polls for newer committed
+checkpoints (the same watcher cadence the local fleet uses), and
+``request_swap`` promotes an explicit path or raises ``SwapRejected`` — a
+poisoned checkpoint pushed across the host boundary is refused while the
+connection keeps serving the previous validated version
+(``tests/test_net/test_remote_swap.py``).
 
 ``agent_child_main`` is the ``multiprocessing`` spawn entrypoint the drills
 use (blob-parameterised like the actor spawn path); ``main`` is the
@@ -97,13 +102,26 @@ class ReplicaAgent:
         port: int = 0,
         rungs: Tuple[int, ...] = (1, 2, 4, 8),
         hb_interval_s: float = 0.5,
+        step: int = 0,
+        path: str = "",
+        ckpt_dir: Optional[str] = None,
+        swap_poll_s: float = 0.0,
     ) -> None:
-        from sheeprl_tpu.serve.model import CompiledLadder
+        from sheeprl_tpu.serve.model import CompiledLadder, ModelStore
 
         self.policy = policy
         # compile before accepting: an acked HELLO means "ready to serve",
         # mirroring warmup-precedes-routing on the local fleet
         self.ladder = CompiledLadder(policy, list(rungs))
+        # the store runs the full swap gauntlet on THIS host — remote
+        # replicas get the same torn/poisoned-checkpoint protection as local
+        self.store = ModelStore(policy, self.ladder, step=int(step), path=str(path))
+        self.ckpt_dir = ckpt_dir
+        self.swap_poll_s = float(swap_poll_s)
+        self._last_swap_poll = time.monotonic()
+        # torn/foreign checkpoints are refused before the store's gauntlet
+        # even loads them; counted here so ``swap_rejects`` covers both gates
+        self.manifest_refusals = 0
         self.rungs = tuple(int(r) for r in rungs)
         self.hb_interval_s = float(hb_interval_s)
         self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -128,9 +146,33 @@ class ReplicaAgent:
         while not self._closed and (should_stop is None or not should_stop()):
             self.pump(0.05)
 
+    # ------------------------------------------------------------------ swap
+    def request_swap(self, ckpt_path: str) -> Any:
+        """Promote ``ckpt_path`` through the gauntlet (raises SwapRejected)."""
+        from sheeprl_tpu.resilience.manifest import CommittedCheckpoint, read_manifest
+        from sheeprl_tpu.serve.errors import SwapRejected
+
+        man = read_manifest(ckpt_path)
+        if man is None:
+            self.manifest_refusals += 1
+            raise SwapRejected(
+                f"checkpoint {ckpt_path} has no commit manifest (torn or foreign write)"
+            )
+        return self.store.request_swap(CommittedCheckpoint(int(man["step"]), ckpt_path, man))
+
+    def maybe_swap(self) -> None:
+        """One watcher pass: promote a newer committed checkpoint from
+        ``ckpt_dir`` if the gauntlet passes it (rejections are recorded on
+        the store, never raised — the agent must keep serving)."""
+        if self.ckpt_dir:
+            self.store.maybe_swap_newest(self.ckpt_dir)
+
     def pump(self, timeout: float = 0.0) -> None:
         """One select cycle: heartbeats out, accepts, frames in."""
         now = time.monotonic()
+        if self.ckpt_dir and self.swap_poll_s > 0 and now - self._last_swap_poll >= self.swap_poll_s:
+            self._last_swap_poll = now
+            self.maybe_swap()
         if self._conns and now - self._last_hb >= self.hb_interval_s:
             self._last_hb = now
             hb = encode_frame(F_HEARTBEAT, b"")
@@ -232,7 +274,7 @@ class ReplicaAgent:
         try:
             import jax
 
-            outputs = self.ladder.run(self.policy.params, list(obs_list))
+            outputs = self.store.infer(list(obs_list))
             outputs = jax.device_get(outputs)  # host-side, picklable
         except Exception as err:
             reply = encode_frame(
@@ -293,10 +335,15 @@ def agent_child_main(conn: Any, blob: bytes) -> None:
 
         {"cfg": {...}, "state": {...},          # build_served_policy inputs
          "host": "127.0.0.1", "port": 0,        # bind address (0 = ephemeral)
-         "rungs": [1, 2, 4, 8]}
+         "rungs": [1, 2, 4, 8],
+         "step": 0, "path": "",                 # boot checkpoint identity
+         "ckpt_dir": None, "swap_poll_s": 0.0}  # hot-swap watcher (optional)
 
-    Protocol on the pipe: child sends ``("ready", host, port)`` once serving,
-    parent sends ``("close",)`` to stop, child answers ``("bye",)``.
+    Protocol on the pipe: child sends ``("ready", host, port)`` once serving;
+    parent may send ``("swap", ckpt_path)`` — the child runs the gauntlet and
+    answers ``("swap_ok", step)`` or ``("swap_rejected", reason)``; parent
+    sends ``("close",)`` to stop, child answers
+    ``("bye", batches, requests, swaps, swap_rejects)``.
     """
     from sheeprl_tpu.rollout.worker import sanitize_worker_environ
 
@@ -314,6 +361,10 @@ def agent_child_main(conn: Any, blob: bytes) -> None:
             host=spec.get("host", "127.0.0.1"),
             port=int(spec.get("port", 0)),
             rungs=tuple(spec.get("rungs", (1, 2, 4, 8))),
+            step=int(spec.get("step", 0)),
+            path=str(spec.get("path", "")),
+            ckpt_dir=spec.get("ckpt_dir"),
+            swap_poll_s=float(spec.get("swap_poll_s", 0.0)),
         )
         conn.send(("ready", agent.host, agent.port))
         while True:
@@ -321,8 +372,25 @@ def agent_child_main(conn: Any, blob: bytes) -> None:
                 msg = conn.recv()
                 if msg and msg[0] == "close":
                     break
+                if msg and msg[0] == "swap":
+                    from sheeprl_tpu.serve.errors import SwapRejected
+
+                    try:
+                        version = agent.request_swap(str(msg[1]))
+                        conn.send(("swap_ok", version.step))
+                    except SwapRejected as err:
+                        conn.send(("swap_rejected", str(err)))
+                    continue
             agent.pump(0.05)
-        conn.send(("bye", agent.batches_served, agent.requests_served))
+        conn.send(
+            (
+                "bye",
+                agent.batches_served,
+                agent.requests_served,
+                agent.store.swaps,
+                agent.store.swap_rejects + agent.manifest_refusals,
+            )
+        )
     except (EOFError, KeyboardInterrupt):
         pass
     except Exception as err:
@@ -354,19 +422,38 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--rungs", type=int, nargs="+", default=[1, 2, 4, 8])
+    parser.add_argument(
+        "--swap-poll-s", type=float, default=0.0,
+        help="poll ckpt-dir for newer committed checkpoints every N seconds (0 = fixed at boot)",
+    )
     args = parser.parse_args(argv)
 
-    from sheeprl_tpu.serve.model import newest_committed
+    import warnings
+
+    from sheeprl_tpu.resilience.discovery import newest_committed, validation_load_gate
     from sheeprl_tpu.serve.policy import build_served_policy
     from sheeprl_tpu.utils.checkpoint import load_checkpoint
 
-    ckpt = newest_committed(args.ckpt_dir)
+    ckpt = newest_committed(
+        args.ckpt_dir,
+        gates=(validation_load_gate,),
+        on_reject=lambda cand, reason: warnings.warn(
+            f"agent: skipping checkpoint {cand.path!r} (step {cand.step}): {reason}"
+        ),
+    )
     if ckpt is None:
-        parser.error(f"no committed checkpoint under {args.ckpt_dir}")
+        parser.error(f"no committed, loadable checkpoint under {args.ckpt_dir}")
     state = load_checkpoint(ckpt.path)
     policy = build_served_policy({"algo": {"name": args.algo}}, state)
     agent = ReplicaAgent(
-        policy, host=args.host, port=args.port, rungs=tuple(args.rungs)
+        policy,
+        host=args.host,
+        port=args.port,
+        rungs=tuple(args.rungs),
+        step=ckpt.step,
+        path=ckpt.path,
+        ckpt_dir=args.ckpt_dir,
+        swap_poll_s=args.swap_poll_s,
     )
     print(f"replica agent serving '{policy.name}' (step {ckpt.step}) on {agent.address}")
     try:

@@ -1,0 +1,50 @@
+"""Online-learning bridge: the serving fleet as a data source, the learner
+as a checkpoint publisher (ROADMAP open item 2 — the closed production loop).
+
+The package fuses the two halves the repo already has:
+
+- **serve → learn** — :class:`~sheeprl_tpu.online.bridge.ExperienceBridge`
+  assembles served requests (obs, action, the exact policy version that
+  produced them, and a reward/feedback label from a pluggable hook) into
+  version-tagged experience slabs and writes them through the PR 11
+  trajectory-ring writer protocol (shm or TCP — any
+  :class:`~sheeprl_tpu.net.transport.ActorTransport`).
+- **learn → serve** — :class:`~sheeprl_tpu.online.learner.OnlineLearner`
+  trains continuously under the existing staleness-bounded admission and
+  :class:`~sheeprl_tpu.online.publisher.CheckpointPublisher` commits
+  manifested checkpoints and pushes them through the PR 6 hot-swap
+  validation gauntlet into every replica.
+- **one version authority** —
+  :class:`~sheeprl_tpu.online.version.VersionAuthority` is the single
+  monotonic counter shared by the param lane and ``ModelStore.try_swap``,
+  so each trajectory records exactly which policy produced it.
+
+The robustness doctrine (howto/online_learning.md): every fault on the
+learning side — degraded checkpoint publish, reward-hook exception/hang,
+ring-full backpressure, learner death — degrades the *learning* loop
+(counted, telemetered shedding) while the serving SLO never blips.
+"""
+
+from sheeprl_tpu.online.bridge import ExperienceBridge, build_experience_layout
+from sheeprl_tpu.online.config import OnlineConfig, online_config_from_cfg
+from sheeprl_tpu.online.fault_injection import BridgeFaultSchedule, BridgeFaultSpec, parse_bridge_faults
+from sheeprl_tpu.online.feedback import Feedback, GuardedHook
+from sheeprl_tpu.online.learner import OnlineLearner, linear_feedback_train_step
+from sheeprl_tpu.online.publisher import CheckpointPublisher
+from sheeprl_tpu.online.version import VersionAuthority
+
+__all__ = [
+    "BridgeFaultSchedule",
+    "BridgeFaultSpec",
+    "CheckpointPublisher",
+    "ExperienceBridge",
+    "Feedback",
+    "GuardedHook",
+    "OnlineConfig",
+    "OnlineLearner",
+    "VersionAuthority",
+    "build_experience_layout",
+    "linear_feedback_train_step",
+    "online_config_from_cfg",
+    "parse_bridge_faults",
+]

@@ -1,0 +1,287 @@
+"""ExperienceBridge: served traffic → version-tagged slabs in the ring.
+
+The serving side of the loop. ``observe()`` is the tap the ``ServeClient``
+(or any router-path caller) invokes after a successful infer: it is a
+bounded non-blocking enqueue — the request path can never stall on the
+learning loop, full stop. A collector thread drains the queue, scores each
+row through the :class:`~sheeprl_tpu.online.feedback.GuardedHook`, and
+assembles rows into fixed-geometry slabs tagged with the policy version
+that *produced* them (``Request.served_step`` mapped through the
+:class:`~sheeprl_tpu.online.version.VersionAuthority`). Slabs are written
+through the PR 11 writer protocol
+(:class:`~sheeprl_tpu.net.transport.ActorTransport` — shm ring or TCP, the
+learner cannot tell the difference), so torn-write detection, seqlock
+commit and staleness admission all apply unchanged to served experience.
+
+Shedding doctrine (drilled, counted, telemetered — never silent, never
+blocking):
+
+- **queue full** (collector behind, e.g. a hanging hook) — ``observe``
+  drops the row, counts ``rows_shed_queue``;
+- **hook failure** (exception/hang/timeout) — the guard returns None, the
+  row is dropped, counted ``rows_shed_hook``;
+- **ring full** (learner dead or slow) — ``try_begin_write`` finds no FREE
+  slot, the whole assembled slab is dropped, counted ``slabs_shed_ring``.
+
+``shed_experience`` is the row-level total across all three — the single
+number the ring-full drill gates on. A version boundary flushes the partial
+slab (``n_rows`` < geometry) so one slab never mixes policies.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.actor_learner.ring import SlabLayout
+from sheeprl_tpu.obs.trace import new_trace_id, trace_event
+from sheeprl_tpu.online.config import OnlineConfig
+from sheeprl_tpu.online.fault_injection import BridgeFaultSchedule
+from sheeprl_tpu.online.feedback import GuardedHook
+from sheeprl_tpu.online.version import VersionAuthority
+
+
+def build_experience_layout(
+    obs_spec: Dict[str, Any], action_shape: Tuple[int, ...], rows: int
+) -> SlabLayout:
+    """The served-experience slab geometry: one field per observation leaf
+    (``obs.<key>``), the served action, the hook's reward, and the optional
+    feedback target with its validity mask."""
+    fields: Dict[str, Tuple[Tuple[int, ...], str]] = {}
+    for key in sorted(obs_spec):
+        sds = obs_spec[key]
+        fields[f"obs.{key}"] = ((rows,) + tuple(int(d) for d in sds.shape), np.dtype(sds.dtype).str)
+    act = tuple(int(d) for d in action_shape)
+    fields["action"] = ((rows,) + act, np.dtype(np.float32).str)
+    fields["reward"] = ((rows,), np.dtype(np.float32).str)
+    fields["target"] = ((rows,) + act, np.dtype(np.float32).str)
+    fields["target_mask"] = ((rows,), np.dtype(np.float32).str)
+    return SlabLayout(fields)
+
+
+class _Row:
+    __slots__ = ("obs", "action", "version", "trace_id", "t_enqueue")
+
+    def __init__(self, obs: Any, action: Any, version: int, trace_id: int, t_enqueue: float) -> None:
+        self.obs = obs
+        self.action = action
+        self.version = version
+        self.trace_id = trace_id
+        self.t_enqueue = t_enqueue
+
+
+class ExperienceBridge:
+    """Collector between the serving tap and the trajectory ring."""
+
+    def __init__(
+        self,
+        *,
+        layout: SlabLayout,
+        transport: Any,  # ActorTransport writer protocol
+        authority: VersionAuthority,
+        hook: GuardedHook,
+        cfg: OnlineConfig,
+        schedule: Optional[BridgeFaultSchedule] = None,
+        actor_id: int = 0,
+        on_event: Optional[Callable[[str, Dict[str, Any]], None]] = None,
+    ) -> None:
+        self.layout = layout
+        self.transport = transport
+        self.authority = authority
+        self.hook = hook
+        self.cfg = cfg
+        self._schedule = schedule
+        self.actor_id = int(actor_id)
+        self._on_event = on_event
+        self.rows_per_slab = int(cfg.rows_per_slab)
+        # derive per-row geometry from the layout (leading dim = rows)
+        self._row_shapes = {k: (shape[1:], dtype) for k, (shape, dtype) in layout.fields.items()}
+
+        self._lock = threading.Lock()
+        self._queue: Deque[_Row] = deque()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        # counters: single-writer each (observe() callers under _lock for the
+        # queue pair; the collector thread for the rest)
+        self.rows_in = 0
+        self.rows_collected = 0
+        self.rows_shed_queue = 0
+        self.rows_shed_hook = 0
+        self.slabs_committed = 0
+        self.slabs_assembled = 0
+        self.slabs_shed_ring = 0
+        self.rows_shed_ring = 0
+        self._seq = 0
+        # current accumulation buffer
+        self._pending: List[Tuple[_Row, Any]] = []  # (row, feedback)
+        self._pending_version: Optional[int] = None
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> "ExperienceBridge":
+        self._thread = threading.Thread(target=self._run, name="online-bridge", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+        self.hook.close()
+
+    def __enter__(self) -> "ExperienceBridge":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------ tap
+    def observe(self, obs: Any, action: Any, step: Any, trace_id: int = 0) -> bool:
+        """The ServeClient tap: bounded enqueue, never blocks. Returns False
+        when the row was shed (queue full or bridge stopped)."""
+        if self._stop.is_set():
+            return False
+        version = self.authority.version_for_step(step)
+        with self._lock:
+            if len(self._queue) >= self.cfg.queue_bound:
+                self.rows_shed_queue += 1
+                return False
+            self._queue.append(_Row(obs, action, version, int(trace_id), time.monotonic()))
+            self.rows_in += 1
+        self._wake.set()
+        return True
+
+    @property
+    def shed_experience(self) -> int:
+        """Total experience rows lost to shedding, all causes."""
+        return self.rows_shed_queue + self.rows_shed_hook + self.rows_shed_ring
+
+    # ------------------------------------------------------------ collector
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            row = self._pop()
+            if row is None:
+                self._wake.wait(0.02)
+                self._wake.clear()
+                continue
+            feedback = self.hook(row.obs, row.action)
+            if feedback is None:
+                self.rows_shed_hook += 1
+                self._event("exp_row_shed", cause="hook", version=row.version)
+                continue
+            self.rows_collected += 1
+            if self._pending and self._pending_version != row.version:
+                # version boundary: flush the partial slab so one slab never
+                # mixes policies (the staleness tag must be exact)
+                self._flush()
+            self._pending_version = row.version
+            self._pending.append((row, feedback))
+            if len(self._pending) >= self.rows_per_slab:
+                self._flush()
+        # drain on close: best-effort flush of the partial slab
+        if self._pending:
+            self._flush()
+
+    def _pop(self) -> Optional[_Row]:
+        with self._lock:
+            return self._queue.popleft() if self._queue else None
+
+    def _flush(self) -> None:
+        rows = self._pending
+        version = self._pending_version or 0
+        self._pending = []
+        self._pending_version = None
+        if not rows:
+            return
+        slab_index = self.slabs_assembled
+        self.slabs_assembled += 1
+        ring_full_injected = (
+            self._schedule is not None and self._schedule.ring_full_active(slab_index)
+        )
+        if ring_full_injected or not self.transport.try_begin_write():
+            # ring full (real or drilled): shed the WHOLE slab, counted —
+            # the alternative (blocking) would backpressure into serving
+            self.slabs_shed_ring += 1
+            self.rows_shed_ring += len(rows)
+            self._event(
+                "exp_slab_shed",
+                cause="ring_full_injected" if ring_full_injected else "ring_full",
+                rows=len(rows),
+                version=version,
+                shed_experience=self.shed_experience,
+            )
+            trace_event("exp_slab_shed", 0, rows=len(rows), version=version)
+            return
+        tid = new_trace_id()
+        t0 = rows[0][0].t_enqueue
+        data = self._pack(rows)
+        self.layout.pack_into(self.transport.payload_view(), data)
+        self.transport.write_meta(
+            seq=self._seq,
+            param_version=version,
+            actor_id=self.actor_id,
+            n_rows=len(rows),
+            collect_us=int((time.monotonic() - t0) * 1e6),
+            env_steps=len(rows),
+            trace_id=tid,
+            commit_t_us=int(time.monotonic() * 1e6),
+        )
+        self.transport.commit()
+        self._seq += 1
+        self.slabs_committed += 1
+        # the causal join request → slab: the slab's trace event carries the
+        # first few request trace ids collected into it
+        request_ids = [r.trace_id for r, _ in rows if r.trace_id][:8]
+        trace_event("exp_slab", tid, version=version, rows=len(rows), requests=request_ids)
+        self._event("exp_slab", rows=len(rows), version=version)
+
+    def _pack(self, rows: List[Tuple[_Row, Any]]) -> Dict[str, np.ndarray]:
+        n = self.rows_per_slab
+        data: Dict[str, np.ndarray] = {}
+        for key, (shape, dtype) in self._row_shapes.items():
+            data[key] = np.zeros((n,) + shape, dtype=dtype)
+        for i, (row, fb) in enumerate(rows):
+            for obs_key, value in row.obs.items():
+                data[f"obs.{obs_key}"][i] = np.asarray(value)
+            data["action"][i] = np.asarray(row.action, dtype=np.float32)
+            data["reward"][i] = float(fb.reward)
+            if fb.target is not None:
+                data["target"][i] = np.asarray(fb.target, dtype=np.float32)
+                data["target_mask"][i] = 1.0
+        return data
+
+    # ------------------------------------------------------------ reporting
+    def _event(self, kind: str, **fields: Any) -> None:
+        try:
+            from sheeprl_tpu.obs.telemetry import telemetry_serve_event
+
+            telemetry_serve_event(f"online_{kind}", **fields)
+        except Exception:
+            pass
+        if self._on_event is not None:
+            try:
+                self._on_event(kind, fields)
+            except Exception:
+                pass
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            depth = len(self._queue)
+        return {
+            "rows_in": self.rows_in,
+            "rows_collected": self.rows_collected,
+            "rows_shed_queue": self.rows_shed_queue,
+            "rows_shed_hook": self.rows_shed_hook,
+            "rows_shed_ring": self.rows_shed_ring,
+            "slabs_committed": self.slabs_committed,
+            "slabs_shed_ring": self.slabs_shed_ring,
+            "shed_experience": self.shed_experience,
+            "queue_depth": depth,
+            **self.hook.snapshot(),
+        }

@@ -1,0 +1,72 @@
+"""Bridge configuration: the ``online`` config node.
+
+Kept deliberately small — the bridge composes existing subsystems (serve,
+actor_learner, net, resilience) and most behaviour lives in *their* config
+nodes. What belongs here is only the glue the loop itself owns: slab
+geometry, the client-side queue bound (the never-block-serving knob), the
+staleness window for admission, the publish cadence, the hook budget, and
+the bridge fault schedule.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Mapping
+
+from sheeprl_tpu.online.fault_injection import BridgeFaultSpec, parse_bridge_faults
+
+
+@dataclass(frozen=True)
+class OnlineConfig:
+    enabled: bool = False
+    # slab geometry: rows of (obs, action, reward, target) per committed slab
+    rows_per_slab: int = 64
+    # trajectory-ring slots the bridge may write (the experience ring depth)
+    ring_slots: int = 4
+    # bounded client-side row queue between ServeClient taps and the
+    # collector thread: when full, observe() sheds (counted) — the request
+    # path NEVER blocks on the learning loop
+    queue_bound: int = 512
+    # staleness-bounded admission: a slab collected under version v is
+    # admitted while published_version - v <= max_staleness (PR 11 doctrine)
+    max_staleness: int = 2
+    # learner updates between checkpoint publishes
+    publish_every: int = 4
+    # reward-hook wall budget; a call past it counts as a hang and sheds
+    hook_timeout_s: float = 0.5
+    # learner step size for the built-in feedback-regression train step
+    lr: float = 0.1
+    faults: List[BridgeFaultSpec] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if self.rows_per_slab < 1:
+            raise ValueError(f"online.rows_per_slab must be >= 1, got {self.rows_per_slab}")
+        if self.ring_slots < 1:
+            raise ValueError(f"online.ring_slots must be >= 1, got {self.ring_slots}")
+        if self.queue_bound < 1:
+            raise ValueError(f"online.queue_bound must be >= 1, got {self.queue_bound}")
+        if self.max_staleness < 0:
+            raise ValueError(f"online.max_staleness must be >= 0, got {self.max_staleness}")
+        if self.publish_every < 1:
+            raise ValueError(f"online.publish_every must be >= 1, got {self.publish_every}")
+        if self.hook_timeout_s <= 0:
+            raise ValueError(f"online.hook_timeout_s must be > 0, got {self.hook_timeout_s}")
+
+
+def online_config_from_cfg(cfg: Mapping[str, Any]) -> OnlineConfig:
+    """Parse the ``online`` node out of a composed run config."""
+    node = cfg.get("online") or {}
+    if not hasattr(node, "get"):
+        raise ValueError(f"online config node must be a mapping, got {node!r}")
+    fault_node = (node.get("fault_injection") or {}).get("faults") if node.get("fault_injection") else None
+    return OnlineConfig(
+        enabled=bool(node.get("enabled", False)),
+        rows_per_slab=int(node.get("rows_per_slab", 64)),
+        ring_slots=int(node.get("ring_slots", 4)),
+        queue_bound=int(node.get("queue_bound", 512)),
+        max_staleness=int(node.get("max_staleness", 2)),
+        publish_every=int(node.get("publish_every", 4)),
+        hook_timeout_s=float(node.get("hook_timeout_s", 0.5)),
+        lr=float(node.get("lr", 0.1)),
+        faults=parse_bridge_faults(fault_node),
+    )

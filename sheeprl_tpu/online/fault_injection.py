@@ -1,0 +1,125 @@
+"""Deterministic bridge-domain faults for the online-learning loop.
+
+Same doctrine as the serve / actor_learner / rollout domains (see
+``sheeprl_tpu/utils/faults.py``): faults are scheduled against monotone
+counters owned by the component that executes them, so the drills replay
+bit-identically. The bridge owns three counters:
+
+- **publish attempts** (the learner's checkpoint commits) —
+  ``poison_publish`` NaN-poisons the checkpoint payload *before* the
+  manifest is written (a degraded producer committing garbage),
+  ``torn_publish`` writes the payload but dies before the manifest (the
+  classic torn commit the manifest discipline exists for), and
+  ``learner_kill`` stops the learner dead mid-swap — after the checkpoint
+  is on disk, before the gauntlet verdict lands.
+- **feedback rows** (reward-hook invocations) — ``hook_exception`` raises
+  inside the user hook, ``hook_hang`` stalls it for ``duration_s``; both
+  must shed the affected experience (counted) without touching serving.
+- **assembled slabs** — ``ring_full`` refuses ring writes for a
+  ``for_slabs`` window, simulating a dead/slow consumer: the bridge must
+  shed whole slabs (counted ``shed_experience``) and never block the
+  request path.
+
+Config shape (``online.fault_injection.faults``)::
+
+    faults:
+      - {kind: poison_publish, at_publish: 2}
+      - {kind: torn_publish,   at_publish: 3}
+      - {kind: learner_kill,   at_publish: 4}
+      - {kind: hook_exception, at_row: 100}
+      - {kind: hook_hang,      at_row: 200, duration_s: 2.0}
+      - {kind: ring_full,      at_slab: 5, for_slabs: 3}
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Mapping, Optional, Sequence
+
+from sheeprl_tpu.utils.faults import DeterministicSchedule, parse_fault_entries, register_fault_domain
+
+PUBLISH_KINDS = ("poison_publish", "torn_publish", "learner_kill")
+HOOK_KINDS = ("hook_exception", "hook_hang")
+SLAB_KINDS = ("ring_full",)
+_KINDS = PUBLISH_KINDS + HOOK_KINDS + SLAB_KINDS
+register_fault_domain("online", _KINDS)
+
+
+@dataclass(frozen=True)
+class BridgeFaultSpec:
+    """One scheduled bridge fault. Exactly one trigger counter applies per
+    kind; the others stay at their defaults."""
+
+    kind: str
+    at_publish: int = 0  # 1-based publish attempt (publish-counter kinds)
+    at_row: int = 0  # 0-based feedback-hook invocation (hook kinds)
+    at_slab: int = 0  # 0-based assembled-slab index (ring_full)
+    for_slabs: int = 1  # ring_full window length in slabs
+    duration_s: float = 0.0  # hook_hang stall
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "kind", str(self.kind).lower())
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown online fault kind {self.kind!r}; expected one of {_KINDS}")
+        if self.kind in PUBLISH_KINDS and self.at_publish < 1:
+            raise ValueError(f"{self.kind} needs at_publish >= 1, got {self.at_publish}")
+        if self.kind in HOOK_KINDS and self.at_row < 0:
+            raise ValueError(f"{self.kind} needs at_row >= 0, got {self.at_row}")
+        if self.kind == "ring_full" and self.for_slabs < 1:
+            raise ValueError(f"ring_full needs for_slabs >= 1, got {self.for_slabs}")
+        if self.kind == "hook_hang" and self.duration_s <= 0:
+            raise ValueError(f"hook_hang needs duration_s > 0, got {self.duration_s}")
+
+
+def parse_bridge_faults(node: Optional[Sequence[Mapping[str, Any]]]) -> List[BridgeFaultSpec]:
+    """``online.fault_injection.faults`` -> validated specs."""
+    if not node:
+        return []
+    entries = parse_fault_entries(
+        node,
+        domain="online.fault_injection",
+        required=("kind",),
+        fields=(
+            ("at_publish", int, 0),
+            ("at_row", int, 0),
+            ("at_slab", int, 0),
+            ("for_slabs", int, 1),
+            ("duration_s", float, 0.0),
+        ),
+    )
+    return [BridgeFaultSpec(**e) for e in entries]
+
+
+class BridgeFaultSchedule:
+    """Three deterministic sub-schedules, one per counter owner. Thread-safe
+    like the engine underneath: the collector thread queries hook/slab
+    faults while the learner thread queries publish faults."""
+
+    def __init__(self, faults: Sequence[BridgeFaultSpec]) -> None:
+        self._publish = DeterministicSchedule(
+            [f for f in faults if f.kind in PUBLISH_KINDS], at=lambda f: f.at_publish
+        )
+        self._hook = DeterministicSchedule(
+            [f for f in faults if f.kind in HOOK_KINDS], at=lambda f: f.at_row
+        )
+        self._slab = DeterministicSchedule(
+            [f for f in faults if f.kind in SLAB_KINDS],
+            at=lambda f: f.at_slab,
+            window=lambda f: f.for_slabs,
+        )
+
+    def publish_fault(self, attempt: int) -> Optional[BridgeFaultSpec]:
+        """At most one publish fault fires per attempt (1-based), the same
+        one-per-query semantics as the serve domain's ``poison_swap``."""
+        return self._publish.pop_first(attempt)
+
+    def hook_faults(self, row_index: int) -> List[BridgeFaultSpec]:
+        """Hook faults due at feedback row ``row_index`` (0-based), with
+        catch-up — a fault scheduled into a shed window still fires on the
+        next surviving row."""
+        return self._hook.pop_due(row_index)
+
+    def ring_full_active(self, slab_index: int) -> bool:
+        """True while a ``ring_full`` window covers assembled slab
+        ``slab_index`` — the bridge treats the ring as having no free slot."""
+        return bool(self._slab.pop_due(slab_index))

@@ -1,0 +1,166 @@
+"""The pluggable reward/feedback hook, guarded so it can never hurt serving.
+
+The hook is user code — the one component of the loop the repo does not
+control. It scores a served (obs, action) pair: a scalar reward, optionally
+with a feedback *target* (the corrected action — the "user clicked the right
+thing" label online systems actually learn from). User code fails in two
+ways a drill must cover: it raises, and it hangs. :class:`GuardedHook`
+contains both:
+
+- the hook runs on a dedicated worker thread, never on the request path
+  (the bridge collector calls the guard; ``ServeClient`` taps are a bounded
+  enqueue and nothing more);
+- every call carries a wall budget (``timeout_s``). A call past budget is
+  counted as a hang, its experience row is shed, the stuck worker is
+  abandoned (it exits on its own once the stall clears — generation-checked,
+  so an abandoned worker can never deliver a stale result into a new call)
+  and a fresh worker takes over;
+- an exception inside the hook is counted and sheds that row; the guard
+  itself never raises.
+
+Scheduled ``hook_exception`` / ``hook_hang`` faults (the ``online`` fault
+domain) are injected *around* the user hook inside the worker, so the drills
+exercise the exact production guard path.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, NamedTuple, Optional
+
+import numpy as np
+
+from sheeprl_tpu.online.fault_injection import BridgeFaultSchedule
+
+
+class Feedback(NamedTuple):
+    """One scored experience row. ``target`` is the optional corrected
+    action the learner regresses toward; reward-only hooks leave it None."""
+
+    reward: float
+    target: Optional[np.ndarray] = None
+
+
+def _normalize(result: Any) -> Feedback:
+    if isinstance(result, Feedback):
+        return result
+    if isinstance(result, tuple) and len(result) == 2:
+        return Feedback(float(result[0]), None if result[1] is None else np.asarray(result[1]))
+    return Feedback(float(result), None)
+
+
+class HookError(RuntimeError):
+    """A scheduled ``hook_exception`` fault firing (distinguishable in logs
+    from an organic hook failure)."""
+
+
+class GuardedHook:
+    """Budgeted, fault-drilled wrapper around a user reward hook.
+
+    Single-caller by design (the bridge collector thread); the counters are
+    plain attributes under that contract. ``__call__`` returns the
+    normalized :class:`Feedback` or ``None`` when the row must be shed
+    (error, hang, or shutdown)."""
+
+    def __init__(
+        self,
+        hook: Callable[[Any, Any], Any],
+        *,
+        timeout_s: float = 0.5,
+        schedule: Optional[BridgeFaultSchedule] = None,
+        on_event: Optional[Callable[[str, dict], None]] = None,
+    ) -> None:
+        self._hook = hook
+        self.timeout_s = float(timeout_s)
+        self._schedule = schedule
+        self._on_event = on_event
+        self.calls = 0
+        self.errors = 0
+        self.hangs = 0
+        self._generation = 0
+        self._inbox: Optional[queue.Queue] = None
+        self._closed = False
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self) -> None:
+        self._closed = True
+        self._generation += 1  # any in-flight worker exits after its item
+        if self._inbox is not None:
+            try:
+                self._inbox.put_nowait(None)
+            except queue.Full:
+                pass
+            self._inbox = None
+
+    # ----------------------------------------------------------------- call
+    def __call__(self, obs: Any, action: Any) -> Optional[Feedback]:
+        if self._closed:
+            return None
+        row = self.calls
+        self.calls += 1
+        faults = self._schedule.hook_faults(row) if self._schedule is not None else []
+        if self._inbox is None:
+            self._spawn()
+        out_q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._inbox.put((obs, action, faults, out_q))
+        try:
+            status, payload = out_q.get(timeout=self.timeout_s)
+        except queue.Empty:
+            # hang: abandon this worker (generation bump makes it exit once
+            # the stall clears) and shed the row
+            self.hangs += 1
+            self._generation += 1
+            self._inbox = None
+            self._event("hook_hang", row=row, timeout_s=self.timeout_s)
+            return None
+        if status == "error":
+            self.errors += 1
+            self._event("hook_error", row=row, error=repr(payload))
+            return None
+        return payload
+
+    # ------------------------------------------------------------- internal
+    def _spawn(self) -> None:
+        self._generation += 1
+        gen = self._generation
+        inbox: "queue.Queue" = queue.Queue()
+        self._inbox = inbox
+
+        def run() -> None:
+            while self._generation == gen:
+                try:
+                    item = inbox.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                if item is None:
+                    return
+                obs, action, faults, out_q = item
+                try:
+                    for fault in faults:
+                        if fault.kind == "hook_hang":
+                            time.sleep(fault.duration_s)
+                        elif fault.kind == "hook_exception":
+                            raise HookError(f"scheduled hook_exception at row {self.calls - 1}")
+                    result = ("ok", _normalize(self._hook(obs, action)))
+                except Exception as err:
+                    result = ("error", err)
+                try:
+                    # an abandoned worker's result goes nowhere: the caller
+                    # timed out and will never read out_q (bounded, size 1)
+                    out_q.put_nowait(result)
+                except queue.Full:
+                    pass
+
+        threading.Thread(target=run, name="online-hook", daemon=True).start()
+
+    def _event(self, kind: str, **fields: Any) -> None:
+        if self._on_event is not None:
+            try:
+                self._on_event(kind, fields)
+            except Exception:
+                pass
+
+    def snapshot(self) -> dict:
+        return {"hook_calls": self.calls, "hook_errors": self.errors, "hook_hangs": self.hangs}

@@ -1,0 +1,259 @@
+"""OnlineLearner: continuous training from the experience ring.
+
+The learner half of the bridge. A background thread polls the PR 11 learner
+transport (shm ring or TCP — :class:`~sheeprl_tpu.net.transport.LearnerTransport`)
+for committed experience slabs, applies the existing staleness-bounded
+admission (:func:`~sheeprl_tpu.actor_learner.config.admit` against the
+version authority's latest *published* version), and folds each admitted
+slab into the params with a pluggable ``train_step``. Every
+``publish_every`` updates the params go to the
+:class:`~sheeprl_tpu.online.publisher.CheckpointPublisher`, which commits a
+manifested checkpoint and pushes it through the hot-swap gauntlet.
+
+Robustness posture (drilled in ``tests/test_online``):
+
+- a non-finite update is **rolled back** (the previous params stand, the
+  rejection is counted + trace-evented) — the learner never publishes NaNs
+  it can see itself; the gauntlet is the independent second line;
+- a stale slab is dropped with ``telemetry_slab(admitted=False)`` and a
+  ``slab_drop_stale`` trace event carrying the slab's trace id — the same
+  accounting the actor–learner plane uses;
+- the learner dying (crash or the drilled ``learner_kill`` publish fault)
+  just stops consumption: the ring fills, the bridge sheds (counted), and
+  the fleet keeps serving the last validated version indefinitely.
+
+``linear_feedback_train_step`` is the built-in step for the linear policy:
+masked regression of ``obs @ w + b`` toward the hook's corrected-action
+targets — host-side numpy on purpose (the policy is tiny; no compile, no
+device round-trip on the learning path of a CPU drill).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.actor_learner.config import admit
+from sheeprl_tpu.actor_learner.ring import SlabLayout, SlabMeta
+from sheeprl_tpu.obs.trace import trace_event
+from sheeprl_tpu.online.config import OnlineConfig
+from sheeprl_tpu.online.version import VersionAuthority
+
+# train_step(params, batch) -> (new_params, metrics)
+TrainStep = Callable[[Any, Dict[str, np.ndarray]], Tuple[Any, Dict[str, float]]]
+
+
+def linear_feedback_train_step(lr: float = 0.1) -> TrainStep:
+    """Gradient step for the linear policy on feedback labels: pull
+    ``obs @ w + b`` toward each labelled row's ``target`` (rows without a
+    target — ``target_mask == 0`` — contribute nothing)."""
+
+    def step(params: Dict[str, np.ndarray], batch: Dict[str, np.ndarray]) -> Tuple[Dict[str, np.ndarray], Dict[str, float]]:
+        x = np.asarray(batch["obs.vector"], dtype=np.float32)
+        target = np.asarray(batch["target"], dtype=np.float32)
+        mask = np.asarray(batch["target_mask"], dtype=np.float32)
+        n_labeled = float(mask.sum())
+        w = np.asarray(params["w"], dtype=np.float32)
+        b = np.asarray(params["b"], dtype=np.float32)
+        if n_labeled < 1.0:
+            return {"w": w, "b": b}, {"loss": 0.0, "n_labeled": 0.0}
+        pred = x @ w + b
+        err = (pred - target) * mask[:, None]
+        grad_w = x.T @ err / n_labeled
+        grad_b = err.sum(axis=0) / n_labeled
+        new = {"w": w - lr * grad_w, "b": b - lr * grad_b}
+        loss = float((err**2).sum() / n_labeled)
+        return new, {"loss": loss, "n_labeled": n_labeled}
+
+    return step
+
+
+def linear_state(params: Dict[str, np.ndarray], step: int) -> Dict[str, Any]:
+    """Checkpointable state for the linear policy (the publisher's
+    ``state_fn``): the agent tree plus the update counter the manifest and
+    ``params_from_state`` expect."""
+    return {
+        "agent": {k: np.asarray(v) for k, v in params.items()},
+        "update": int(step),
+    }
+
+
+class OnlineLearner:
+    """Poll → admit → train → (periodically) publish, on a daemon thread."""
+
+    def __init__(
+        self,
+        *,
+        transport: Any,  # LearnerTransport reader protocol
+        layout: SlabLayout,
+        authority: VersionAuthority,
+        cfg: OnlineConfig,
+        params: Any,
+        train_step: TrainStep,
+        publisher: Optional[Any] = None,  # CheckpointPublisher
+        on_event: Optional[Callable[[str, Dict[str, Any]], None]] = None,
+    ) -> None:
+        self.transport = transport
+        self.layout = layout
+        self.authority = authority
+        self.cfg = cfg
+        self.params = params
+        self.train_step = train_step
+        self.publisher = publisher
+        self._on_event = on_event
+
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()  # guards params for concurrent readers
+
+        self.updates = 0
+        self.rows_trained = 0
+        self.slabs_admitted = 0
+        self.slabs_stale = 0
+        self.updates_rejected = 0  # non-finite rollbacks
+        self.publishes = 0
+        self.killed = False  # learner_kill drill tripped
+        self.last_loss: Optional[float] = None
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> "OnlineLearner":
+        self._thread = threading.Thread(target=self._run, name="online-learner", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+
+    def __enter__(self) -> "OnlineLearner":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def current_params(self) -> Any:
+        with self._lock:
+            return self.params
+
+    # ------------------------------------------------------------------ loop
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            meta = self.transport.poll()
+            if meta is None:
+                time.sleep(0.005)
+                continue
+            self._consume(meta)
+            if self.killed:
+                # the drilled mid-swap death: stop consuming, leave the ring
+                # to fill — exactly what a real learner crash looks like to
+                # the bridge (shed) and the fleet (keep serving)
+                return
+
+    def _consume(self, meta: SlabMeta) -> None:
+        from sheeprl_tpu.obs.telemetry import telemetry_slab
+
+        published = self.authority.published_version
+        ok = admit(meta.param_version, published, self.cfg.max_staleness)
+        try:
+            telemetry_slab(
+                staleness=published - int(meta.param_version),
+                occupancy=self.transport.occupancy(),
+                admitted=ok,
+            )
+        except Exception:
+            pass
+        if not ok:
+            self.slabs_stale += 1
+            trace_event(
+                "slab_drop_stale",
+                meta.trace_id,
+                version=int(meta.param_version),
+                published=published,
+                max_staleness=self.cfg.max_staleness,
+            )
+            self.transport.release(meta)
+            return
+        data = self.layout.unpack(self.transport.payload(meta))
+        self.transport.release(meta)  # unpack copies; the slot is free now
+        n = max(0, min(int(meta.n_rows), self.cfg.rows_per_slab))
+        batch = {k: v[:n] for k, v in data.items()}
+
+        with self._lock:
+            params = self.params
+        new_params, metrics = self.train_step(params, batch)
+        from sheeprl_tpu.resilience.sentinel import host_all_finite
+
+        if not host_all_finite(new_params):
+            # rollback: the previous params stand, nothing is published
+            self.updates_rejected += 1
+            trace_event(
+                "online_update_rejected", meta.trace_id, cause="non_finite", update=self.updates
+            )
+            self._event("update_rejected", cause="non_finite", update=self.updates)
+            return
+        with self._lock:
+            self.params = new_params
+        self.updates += 1
+        self.rows_trained += n
+        self.slabs_admitted += 1
+        self.last_loss = float(metrics.get("loss", 0.0))
+        # the causal join slab → gradient window: the update event reuses the
+        # slab's trace id so tools/trace.py can chain request → slab → update
+        trace_event(
+            "online_update",
+            meta.trace_id,
+            update=self.updates,
+            version=int(meta.param_version),
+            rows=n,
+            loss=self.last_loss,
+        )
+        self._event("update", update=self.updates, rows=n, loss=self.last_loss)
+        if self.publisher is not None and self.updates % self.cfg.publish_every == 0:
+            self._publish()
+
+    def _publish(self) -> None:
+        with self._lock:
+            params = self.params
+        result = self.publisher.publish(params)
+        self.publishes += 1
+        self._event("publish", **{k: v for k, v in result.items() if not isinstance(v, (dict, list))})
+        if result.get("killed"):
+            self.killed = True
+            self._stop.set()
+
+    # ------------------------------------------------------------- reporting
+    def _event(self, kind: str, **fields: Any) -> None:
+        try:
+            from sheeprl_tpu.obs.telemetry import telemetry_serve_event
+
+            telemetry_serve_event(f"online_{kind}", **fields)
+        except Exception:
+            pass
+        if self._on_event is not None:
+            try:
+                self._on_event(kind, fields)
+            except Exception:
+                pass
+
+    def snapshot(self) -> Dict[str, Any]:
+        snap = {
+            "updates": self.updates,
+            "rows_trained": self.rows_trained,
+            "slabs_admitted": self.slabs_admitted,
+            "slabs_stale": self.slabs_stale,
+            "updates_rejected": self.updates_rejected,
+            "publishes": self.publishes,
+            "killed": self.killed,
+            "last_loss": self.last_loss,
+        }
+        if self.publisher is not None:
+            snap.update(self.publisher.snapshot())
+        return snap

@@ -1,0 +1,219 @@
+"""CheckpointPublisher: learner params → committed checkpoint → hot swap.
+
+The learn → serve half of the loop. ``publish()`` turns the learner's
+current params into a *committed* checkpoint (payload staged, manifest last
+— the same crash-atomic discipline training checkpoints use), mints the
+next monotonic version from the shared
+:class:`~sheeprl_tpu.online.version.VersionAuthority`, optionally pushes
+the flat param bytes down the PR 11 param lane under that same version,
+and then asks every attached server to ``request_swap`` the new path —
+which runs the full PR 6 validation gauntlet (digest, structure,
+finiteness, smoke inference, prewarm) before any replica flips.
+
+A rejected swap is the *success* of the design, not a failure of the call:
+``SwapRejected`` is caught, counted, trace-evented, and the fleet keeps
+serving the previous validated version. The drilled publish faults
+(``sheeprl_tpu.online.fault_injection``) exercise exactly that seam:
+
+- ``poison_publish`` — a NaN is planted in the state before the manifest is
+  built, so the checkpoint *commits* (manifest digest matches the poisoned
+  payload) and the gauntlet's finiteness gate must catch it;
+- ``torn_publish`` — the payload lands without a manifest (a crash between
+  stage and commit); discovery never sees it and no version is minted;
+- ``learner_kill`` — ``publish`` returns ``{"killed": True}`` after the
+  commit but before any swap push, modelling the learner dying mid-publish.
+
+Boot-step resume goes through the shared discovery helper
+(:func:`~sheeprl_tpu.resilience.discovery.newest_committed`): a publisher
+pointed at a warm checkpoint dir continues the step sequence instead of
+colliding with existing commits.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from sheeprl_tpu.obs.trace import trace_event
+from sheeprl_tpu.online.fault_injection import BridgeFaultSchedule
+from sheeprl_tpu.online.version import VersionAuthority
+
+# state_fn(params, step) -> checkpointable state tree (e.g. learner.linear_state)
+StateFn = Callable[[Any, int], Dict[str, Any]]
+# flat_fn(params) -> uint8 bytes for the param lane (same version as the commit)
+FlatFn = Callable[[Any], np.ndarray]
+
+
+class CheckpointPublisher:
+    """Commit manifested checkpoints and push them through the gauntlet."""
+
+    def __init__(
+        self,
+        *,
+        ckpt_dir: str,
+        authority: VersionAuthority,
+        state_fn: StateFn,
+        servers: Sequence[Any] = (),
+        transport: Optional[Any] = None,  # LearnerTransport (param lane)
+        flat_fn: Optional[FlatFn] = None,
+        schedule: Optional[BridgeFaultSchedule] = None,
+        boot_step: Optional[int] = None,
+        on_event: Optional[Callable[[str, Dict[str, Any]], None]] = None,
+    ) -> None:
+        self.ckpt_dir = str(ckpt_dir)
+        self.authority = authority
+        self.state_fn = state_fn
+        self.servers = list(servers)
+        self.transport = transport
+        self.flat_fn = flat_fn
+        self._schedule = schedule
+        self._on_event = on_event
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+        if boot_step is None:
+            # resume the step sequence from the newest committed checkpoint
+            # already in the dir (shared discovery helper — satellite 1)
+            from sheeprl_tpu.resilience.discovery import newest_committed
+
+            newest = newest_committed(self.ckpt_dir)
+            boot_step = newest.step if newest is not None else 0
+        self._step = int(boot_step)
+
+        self.attempts = 0
+        self.committed = 0
+        self.torn = 0
+        self.poisoned = 0
+        self.swaps_ok = 0
+        self.swap_rejects = 0
+        self.poisoned_steps: List[int] = []
+        self.reject_reasons: List[str] = []
+
+    @property
+    def step(self) -> int:
+        """The last step a publish attempt used."""
+        return self._step
+
+    def publish(self, params: Any, *, step: Optional[int] = None) -> Dict[str, Any]:
+        """One publish attempt. Returns a result dict; never raises on a
+        rejected swap (that is the gauntlet doing its job)."""
+        self.attempts += 1
+        fault = self._schedule.publish_fault(self.attempts) if self._schedule is not None else None
+        kind = fault.kind if fault is not None else None
+        self._step = int(step) if step is not None else self._step + 1
+        this_step = self._step
+        state = self.state_fn(params, this_step)
+
+        if kind == "poison_publish":
+            # poison BEFORE the manifest: the digest matches the poisoned
+            # payload, so the checkpoint commits cleanly and only the
+            # gauntlet's finiteness gate stands between it and the fleet
+            self.poisoned += 1
+            self.poisoned_steps.append(this_step)
+            state = _poison_first_leaf(state)
+
+        from sheeprl_tpu.resilience.manifest import build_manifest
+        from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+        path = os.path.join(self.ckpt_dir, f"ckpt_{this_step}_0.ckpt")
+        if kind == "torn_publish":
+            # payload without manifest: the crash-between-stage-and-commit
+            # shape. Discovery skips it; no version is minted.
+            self.torn += 1
+            save_checkpoint(path, state, backend="pickle", manifest=None)
+            trace_event("param_publish_torn", ckpt_step=this_step)
+            self._event("publish_torn", step=this_step)
+            return {"step": this_step, "version": None, "torn": True}
+
+        man = build_manifest(step=this_step, backend="pickle", world_size=1, state=state)
+        save_checkpoint(path, state, backend="pickle", manifest=man)
+        version = self.authority.publish(this_step)
+        self.committed += 1
+        if self.transport is not None and self.flat_fn is not None:
+            try:
+                self.transport.publish_params(self.flat_fn(params), version)
+            except Exception:
+                pass  # the lane is advisory here; the checkpoint is the commit
+        trace_event(
+            "param_publish",
+            version=version,
+            ckpt_step=this_step,
+            poisoned=kind == "poison_publish",
+        )
+        self._event("publish_committed", step=this_step, version=version)
+
+        if kind == "learner_kill":
+            # died after commit, before the swap push: the fleet never hears
+            # about this checkpoint from us (its own swap watcher might)
+            return {"step": this_step, "version": version, "killed": True}
+
+        rejected: List[str] = []
+        swapped = 0
+        from sheeprl_tpu.serve.errors import SwapRejected
+
+        for server in self.servers:
+            try:
+                server.request_swap(path)
+                swapped += 1
+                self.swaps_ok += 1
+            except SwapRejected as err:
+                self.swap_rejects += 1
+                rejected.append(str(err))
+                self.reject_reasons.append(str(err))
+                trace_event("swap_rejected", version=version, ckpt_step=this_step, reason=str(err)[:200])
+                self._event("swap_rejected", step=this_step, version=version)
+        return {
+            "step": this_step,
+            "version": version,
+            "path": path,
+            "swapped": swapped,
+            "rejected": len(rejected),
+            "reject_reasons": rejected,
+        }
+
+    # ------------------------------------------------------------- reporting
+    def _event(self, kind: str, **fields: Any) -> None:
+        try:
+            from sheeprl_tpu.obs.telemetry import telemetry_serve_event
+
+            telemetry_serve_event(f"online_{kind}", **fields)
+        except Exception:
+            pass
+        if self._on_event is not None:
+            try:
+                self._on_event(kind, fields)
+            except Exception:
+                pass
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "publish_attempts": self.attempts,
+            "publish_committed": self.committed,
+            "publish_torn": self.torn,
+            "publish_poisoned": self.poisoned,
+            "swaps_ok": self.swaps_ok,
+            "swap_rejects": self.swap_rejects,
+            "published_version": self.authority.published_version,
+            "confirmed_version": self.authority.confirmed_version,
+        }
+
+
+def _poison_first_leaf(state: Dict[str, Any]) -> Dict[str, Any]:
+    """Deep-copy ``state`` with one NaN planted in its first float leaf."""
+    import copy
+
+    poisoned = copy.deepcopy(state)
+    stack: List[Any] = [poisoned]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, dict):
+            for key in sorted(node):
+                value = node[key]
+                if isinstance(value, np.ndarray) and np.issubdtype(value.dtype, np.floating):
+                    arr = np.array(value)
+                    arr.flat[0] = np.nan
+                    node[key] = arr
+                    return poisoned
+                if isinstance(value, dict):
+                    stack.append(value)
+    return poisoned

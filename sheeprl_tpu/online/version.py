@@ -1,0 +1,119 @@
+"""The single monotonic policy-version authority for the closed loop.
+
+Before the bridge, two counters described "which policy": the param lane's
+``version`` (bumped per learner update, PR 11) and the checkpoint ``step``
+the serving gauntlet promotes (PR 6). A trajectory tagged with one and a
+server reporting the other cannot be joined — exactly the ambiguity an
+online loop cannot afford, because staleness-bounded admission compares the
+version a slab was *collected under* against the version the learner has
+*published*.
+
+:class:`VersionAuthority` collapses both into one monotone counter:
+
+- ``publish(step)`` — the learner committed checkpoint ``step``; mints the
+  next version and records the ``step → version`` mapping. The same version
+  number goes onto the param lane (``publish_params(..., version)``) and
+  into the publish trace event.
+- ``version_for_step(step)`` — what the bridge stamps into slab metadata:
+  requests carry the checkpoint step their replica served under
+  (``Request.served_step``), and this maps it back to the lane's counter.
+- ``confirm(step)`` — ``ModelStore.try_swap`` promoted ``step`` into the
+  serving flip; the authority tracks the last *validated* version so drills
+  can assert "the fleet serves the last validated version indefinitely"
+  after a learner death or a rejected publish.
+
+Thread-safe: the learner thread publishes while replica threads stamp and
+swap watchers confirm.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+
+class VersionAuthority:
+    """Monotonic policy-version counter shared by the param lane and the
+    hot-swap gauntlet. ``boot_step`` registers the checkpoint the fleet is
+    serving at construction as version 0 (already validated: it booted)."""
+
+    def __init__(self, *, boot_step: Optional[int] = None) -> None:
+        self._lock = threading.Lock()
+        self._version = 0
+        self._step_to_version: Dict[int, int] = {}
+        self._version_to_step: Dict[int, int] = {}
+        self._confirmed_version = 0
+        self._confirmed_step = boot_step
+        if boot_step is not None:
+            self._step_to_version[int(boot_step)] = 0
+            self._version_to_step[0] = int(boot_step)
+
+    # ------------------------------------------------------------- publish ----
+    def publish(self, step: int) -> int:
+        """Mint the next version for checkpoint ``step`` (the learner's
+        commit path). Idempotent per step: republishing a step returns its
+        existing version instead of burning a new one."""
+        step = int(step)
+        with self._lock:
+            existing = self._step_to_version.get(step)
+            if existing is not None:
+                return existing
+            self._version += 1
+            self._step_to_version[step] = self._version
+            self._version_to_step[self._version] = step
+            return self._version
+
+    def confirm(self, step: int) -> Optional[int]:
+        """A swap promoted checkpoint ``step`` into serving. Returns the
+        confirmed version (``None`` for a step this authority never minted —
+        a foreign checkpoint, recorded as confirmed step only)."""
+        step = int(step)
+        with self._lock:
+            version = self._step_to_version.get(step)
+            if version is not None and version > self._confirmed_version:
+                self._confirmed_version = version
+            self._confirmed_step = step
+            return version
+
+    # -------------------------------------------------------------- lookup ----
+    def version_for_step(self, step: Any) -> int:
+        """The version whose checkpoint is ``step`` (what produced a served
+        action). Unknown steps map to 0 — the boot policy — so a request
+        served before the authority saw its step is stamped conservatively
+        old rather than invented new."""
+        try:
+            step = int(step)
+        except (TypeError, ValueError):
+            return 0
+        with self._lock:
+            return self._step_to_version.get(step, 0)
+
+    def step_for_version(self, version: int) -> Optional[int]:
+        with self._lock:
+            return self._version_to_step.get(int(version))
+
+    @property
+    def published_version(self) -> int:
+        """Newest version the learner has published (the admission bound)."""
+        with self._lock:
+            return self._version
+
+    @property
+    def confirmed_version(self) -> int:
+        """Newest version validated into serving by the gauntlet."""
+        with self._lock:
+            return self._confirmed_version
+
+    @property
+    def confirmed_step(self) -> Optional[int]:
+        with self._lock:
+            return self._confirmed_step
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "published_version": self._version,
+                "confirmed_version": self._confirmed_version,
+                "confirmed_step": self._confirmed_step,
+                "known_steps": len(self._step_to_version),
+            }

@@ -28,6 +28,7 @@ import os
 import warnings
 from typing import Any, Dict, List, Mapping, Optional, Tuple
 
+from sheeprl_tpu.resilience.discovery import newest_valid, validation_load_gate
 from sheeprl_tpu.resilience.manifest import CommittedCheckpoint, committed_checkpoints, gc_torn
 
 _pending_events: List[Tuple[str, Dict[str, Any]]] = []
@@ -89,7 +90,7 @@ def scan_run_checkpoints(run_root: str, *, collect_garbage: bool = True) -> List
 def resolve_auto_resume(cfg: Mapping[str, Any]) -> Optional[str]:
     """Resolve ``resume_from=auto`` to a concrete checkpoint path (or ``None``
     for a fresh start). See the module docstring for the candidate gates."""
-    from sheeprl_tpu.utils.checkpoint import elastic_per_rank_batch_size, load_checkpoint
+    from sheeprl_tpu.utils.checkpoint import elastic_per_rank_batch_size
     from sheeprl_tpu.utils.logger import run_base_dir
 
     run_root = run_base_dir(cfg)
@@ -101,27 +102,30 @@ def resolve_auto_resume(cfg: Mapping[str, Any]) -> Optional[str]:
         )
         return None
     world_size = _expected_world_size(cfg)
-    for cand in candidates:
+
+    def config_gate(cand: CommittedCheckpoint) -> Optional[str]:
         config_path = os.path.join(os.path.dirname(os.path.dirname(cand.path)), "config.yaml")
-        if not os.path.isfile(config_path):
-            _fallback(cand, f"missing {config_path}")
-            continue
+        return None if os.path.isfile(config_path) else f"missing {config_path}"
+
+    def mesh_gate(cand: CommittedCheckpoint) -> Optional[str]:
         batch_size = cand.manifest.get("batch_size")
         if world_size and isinstance(batch_size, int):
             try:
                 elastic_per_rank_batch_size(batch_size, world_size)
             except ValueError as exc:
-                _fallback(cand, str(exc))
-                continue
-        try:
-            load_checkpoint(cand.path)
-        except Exception as exc:
-            _fallback(cand, f"validation load failed: {exc!r}")
-            continue
+                return str(exc)
+        return None
+
+    winner = newest_valid(
+        candidates,
+        gates=(config_gate, mesh_gate, validation_load_gate),
+        on_reject=_fallback,
+    )
+    if winner is not None:
         queue_resilience_event(
-            "auto_resume", path=cand.path, ckpt_step=cand.step, candidates=len(candidates)
+            "auto_resume", path=winner.path, ckpt_step=winner.step, candidates=len(candidates)
         )
-        return cand.path
+        return winner.path
     warnings.warn(
         f"checkpoint.resume_from=auto: all {len(candidates)} committed checkpoints under "
         f"{run_root!r} were rejected — starting a fresh run"

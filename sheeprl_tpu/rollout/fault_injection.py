@@ -40,9 +40,10 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Dict, List, Mapping, Sequence
 
-from sheeprl_tpu.utils.faults import DeterministicSchedule, parse_fault_entries
+from sheeprl_tpu.utils.faults import DeterministicSchedule, parse_fault_entries, register_fault_domain
 
 _KINDS = ("crash", "hang", "slow")
+register_fault_domain("rollout", _KINDS)
 
 
 @dataclass

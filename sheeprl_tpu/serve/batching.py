@@ -37,7 +37,10 @@ _REQUEST_IDS = itertools.count()
 class Request:
     """One in-flight inference request: observation + deadline + Future."""
 
-    __slots__ = ("obs", "enqueue_t", "deadline_t", "future", "rid", "attempts", "trace_id", "t_dispatch")
+    __slots__ = (
+        "obs", "enqueue_t", "deadline_t", "future", "rid", "attempts", "trace_id",
+        "t_dispatch", "served_step",
+    )
 
     def __init__(self, obs: Any, enqueue_t: float, deadline_t: float) -> None:
         self.obs = obs
@@ -53,6 +56,11 @@ class Request:
         # re-route-at-front and requeue (every copy is the same object)
         self.trace_id = 0
         self.t_dispatch: Optional[float] = None
+        # checkpoint step of the params that served this request (stamped by
+        # the replica that completes it) — the online bridge maps it through
+        # the version authority so every experience row records the exact
+        # policy that produced it, swaps included
+        self.served_step: int = -1
 
     def expired(self, now: float) -> bool:
         return now >= self.deadline_t

@@ -27,7 +27,16 @@ from sheeprl_tpu.serve.server import PolicyServer
 
 class ServeClient:
     """One logical caller. Counts its retries so drills can assert that
-    shedding produced *backoff* (client-side), not just rejections."""
+    shedding produced *backoff* (client-side), not just rejections.
+
+    ``experience_sink`` is the online-learning tap
+    (:meth:`~sheeprl_tpu.online.bridge.ExperienceBridge.observe` or anything
+    with its signature): after a successful infer the client offers
+    ``(obs, action, served_step, trace_id)`` to the sink. The offer is
+    non-blocking by the sink's contract and exceptions are swallowed — the
+    learning loop must never be able to fail a request that already
+    succeeded.
+    """
 
     def __init__(
         self,
@@ -37,6 +46,7 @@ class ServeClient:
         timeout_s: Optional[float] = None,
         backoff_multiplier: float = 2.0,
         seed: int = 0,
+        experience_sink: Optional[Any] = None,
     ) -> None:
         self.server = server
         self.max_retries = int(max_retries)
@@ -45,6 +55,8 @@ class ServeClient:
         self._rng = random.Random(seed)
         self.retries = 0
         self.rejected = 0
+        self.experience_sink = experience_sink
+        self.experience_offered = 0
 
     def infer(self, obs: Any, timeout_s: Optional[float] = None) -> Any:
         """One request with admission-retry. Raises the final Overloaded when
@@ -52,14 +64,30 @@ class ServeClient:
         timeout_s = timeout_s if timeout_s is not None else self.timeout_s
         deadline = (time.monotonic() + timeout_s) if timeout_s is not None else None
         attempt = 0
+        # submit/wait exposes the request object (served_step, trace_id) for
+        # the experience tap; the client stays duck-typed over infer-only
+        # servers, which can't feed the tap but serve identically.
+        two_phase = hasattr(self.server, "submit") and hasattr(self.server, "wait")
         while True:
             try:
-                return self.server.infer(
-                    obs,
-                    deadline_s=(
-                        max(1e-3, deadline - time.monotonic()) if deadline is not None else None
-                    ),
+                deadline_s = (
+                    max(1e-3, deadline - time.monotonic()) if deadline is not None else None
                 )
+                if two_phase:
+                    req = self.server.submit(obs, deadline_s=deadline_s)
+                    out = self.server.wait(req)
+                else:
+                    req = None
+                    out = self.server.infer(obs, deadline_s=deadline_s)
+                if self.experience_sink is not None:
+                    try:
+                        self.experience_sink(
+                            obs, out, getattr(req, "served_step", -1), getattr(req, "trace_id", 0)
+                        )
+                        self.experience_offered += 1
+                    except Exception:
+                        pass
+                return out
             except Overloaded as err:
                 self.rejected += 1
                 attempt += 1

@@ -49,9 +49,10 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, List, Mapping, Sequence
 
-from sheeprl_tpu.utils.faults import DeterministicSchedule, parse_fault_entries
+from sheeprl_tpu.utils.faults import DeterministicSchedule, parse_fault_entries, register_fault_domain
 
 _KINDS = ("replica_crash", "slow_inference", "poison_swap", "router_blackhole")
+register_fault_domain("serve", _KINDS)
 
 
 @dataclass

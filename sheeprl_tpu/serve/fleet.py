@@ -184,6 +184,9 @@ class FleetReplica(threading.Thread):
                     except Exception:
                         pass
             else:
+                # stamp the serving checkpoint step BEFORE completion: the
+                # online bridge reads it off the request right after wait()
+                req.served_step = self._params_step
                 delivered = safe_complete(req, out)
                 if delivered and req.trace_id:
                     # critical-path decomposition, measured at the replica

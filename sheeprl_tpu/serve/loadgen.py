@@ -56,6 +56,7 @@ class _Worker(threading.Thread):
         stop: threading.Event,
         obs_factory: Callable[[np.random.Generator], Any],
         interval_s: float,
+        experience_sink: Optional[Any] = None,
     ) -> None:
         super().__init__(name=f"loadgen-{wid}", daemon=True)
         self.client = ServeClient(
@@ -63,6 +64,7 @@ class _Worker(threading.Thread):
             max_retries=cfg.max_retries,
             timeout_s=(cfg.timeout_ms / 1e3) if cfg.timeout_ms else None,
             seed=cfg.seed * 10_000 + wid,
+            experience_sink=experience_sink,
         )
         self._halt = stop
         self._obs_factory = obs_factory
@@ -106,13 +108,20 @@ def run_load(
     cfg: LoadConfig,
     *,
     obs_factory: Optional[Callable[[np.random.Generator], Any]] = None,
+    experience_sink: Optional[Any] = None,
 ) -> Dict[str, Any]:
-    """Drive the load shape described by ``cfg``; returns the run report."""
+    """Drive the load shape described by ``cfg``; returns the run report.
+
+    ``experience_sink`` is handed to every worker's :class:`ServeClient` —
+    the online-learning tap (``ExperienceBridge.observe``): the loadgen IS
+    the served traffic the bridge learns from in the ``serve_train`` drills.
+    """
     factory = obs_factory or _default_obs_factory(server)
     interval_s = cfg.concurrency / cfg.rate_hz if cfg.rate_hz > 0 else 0.0
     stop = threading.Event()
     workers = [
-        _Worker(i, server, cfg, stop, factory, interval_s) for i in range(cfg.concurrency)
+        _Worker(i, server, cfg, stop, factory, interval_s, experience_sink)
+        for i in range(cfg.concurrency)
     ]
     t0 = time.monotonic()
     for w in workers:
@@ -172,6 +181,7 @@ def run_ramp(
     step_duration_s: Optional[float] = None,
     obs_factory: Optional[Callable[[np.random.Generator], Any]] = None,
     on_step: Optional[Callable[[int, float], None]] = None,
+    experience_sink: Optional[Any] = None,
 ) -> Dict[str, Any]:
     """Stepped open-loop ramp that walks the offered rate up until the
     server stops meeting its SLO — the saturation-knee finder.
@@ -195,7 +205,9 @@ def run_ramp(
         if on_step is not None:
             on_step(k, rate)
         step_cfg = dataclasses.replace(cfg, rate_hz=float(rate), duration_s=float(per_step))
-        report = run_load(server, step_cfg, obs_factory=obs_factory)
+        report = run_load(
+            server, step_cfg, obs_factory=obs_factory, experience_sink=experience_sink
+        )
         report["step"] = k
         report["offered_rate_hz"] = float(rate)
         attempts = report["ok"] + report["shed"] + report["expired"] + report["errors"]

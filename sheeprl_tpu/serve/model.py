@@ -215,6 +215,10 @@ class ModelStore:
         self.swaps = 0
         self.swap_rejects = 0
         self.rollbacks = 0
+        # online-learning hook: a VersionAuthority whose confirm() is called
+        # with the promoted step AFTER each atomic flip — the gauntlet is the
+        # only gate between a published version and a confirmed one
+        self.version_authority: Optional[Any] = None
 
     # ---------------------------------------------------------------- serving
     @property
@@ -310,6 +314,16 @@ class ModelStore:
             self._previous = self._current
             self._current = ModelVersion(candidate.step, candidate.path, params)
             self.swaps += 1
+        if self.version_authority is not None:
+            try:
+                self.version_authority.confirm(candidate.step)
+            except Exception:
+                pass
+        from sheeprl_tpu.obs.trace import trace_event
+
+        # the terminal link of the online-learning causal chain: request →
+        # exp_slab → online_update → param_publish → model_swap
+        trace_event("model_swap", ckpt_step=candidate.step, attempt=attempt)
         self._emit("swap", {"step": candidate.step, "path": candidate.path, "attempt": attempt})
         return True, "promoted"
 
@@ -375,5 +389,6 @@ def _zero_obs(obs_spec: Any) -> Any:
 
 
 def newest_committed(ckpt_dir: str) -> Optional[CommittedCheckpoint]:
-    committed = committed_checkpoints(ckpt_dir)
-    return committed[-1] if committed else None
+    from sheeprl_tpu.resilience.discovery import newest_committed as _newest_committed
+
+    return _newest_committed(ckpt_dir)

@@ -157,6 +157,9 @@ class Replica(threading.Thread):
                     # expiry is completed AND counted as shed in one place
                     self.batcher.requeue([req])
                 else:
+                    # stamp the serving checkpoint step before completion —
+                    # the online bridge reads it off the request after wait()
+                    req.served_step = self.store.current.step
                     req.future.set_result(out)
         if self._on_batch is not None:
             try:

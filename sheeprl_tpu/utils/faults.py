@@ -21,6 +21,11 @@ This module is that doctrine, factored once:
   dropped; *windowed* faults (e.g. ``slow_inference`` over ``for_batches``)
   stay due for their whole window and then expire. Thread-safe: replica
   threads, the router and swap watchers query concurrently.
+- :func:`register_fault_domain` / :func:`fault_domains` — the domain
+  registry. Every domain module declares its fault-kind vocabulary here at
+  import, so drill-coverage tooling (``bench.py --drills`` →
+  ``tools/drills.py``) audits which fault keys the test suite exercises
+  against one authoritative list instead of folklore.
 
 The domain modules stay the public surface (their specs, kinds and config
 shapes are unchanged); they are thin adapters over this engine.
@@ -35,6 +40,30 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 # when listed in ``required``; coercions run with ``or``-style zero fallback
 # for floats so YAML ``null`` composes to 0.0 like the historical parsers.
 FieldSpec = Tuple[str, Callable[[Any], Any], Any]
+
+# domain name -> ordered fault-kind vocabulary. Populated by the domain
+# modules at import (rollout/serve/actor_learner/online); read by the drill
+# auditor. A plain module dict: registration is import-time only.
+_FAULT_DOMAINS: Dict[str, Tuple[str, ...]] = {}
+
+
+def register_fault_domain(domain: str, kinds: Sequence[str]) -> None:
+    """Declare ``domain``'s fault-kind vocabulary (idempotent; a re-import
+    re-registering identical kinds is a no-op, a conflicting registration
+    is a programming error surfaced immediately)."""
+    entry = tuple(str(k) for k in kinds)
+    existing = _FAULT_DOMAINS.get(domain)
+    if existing is not None and existing != entry:
+        raise ValueError(
+            f"fault domain {domain!r} re-registered with different kinds: {existing} != {entry}"
+        )
+    _FAULT_DOMAINS[domain] = entry
+
+
+def fault_domains() -> Dict[str, Tuple[str, ...]]:
+    """Snapshot of every registered domain's kinds (import the domain
+    modules first — registration happens at import)."""
+    return dict(_FAULT_DOMAINS)
 
 
 def parse_fault_entries(

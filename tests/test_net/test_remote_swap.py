@@ -1,0 +1,169 @@
+"""Remote hot-swap drills: the gauntlet runs ON THE REMOTE HOST.
+
+A real :func:`~sheeprl_tpu.net.agent.agent_child_main` process serves a
+committed checkpoint while a fleet routes live traffic to it; the parent
+then pushes degraded checkpoints at it over the control pipe:
+
+- a *poisoned* checkpoint (NaN planted before the manifest was built, so
+  the commit is digest-clean) must be rejected by the remote gauntlet's
+  finiteness gate with zero in-flight requests dropped;
+- a *torn* checkpoint (payload, no manifest) must be refused before the
+  gauntlet even loads it;
+- a good checkpoint must then swap in and change the served actions —
+  proving the rejections were the gauntlet's judgment, not a dead pipe.
+"""
+
+import copy
+import multiprocessing
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tests.test_serve.conftest import (
+    DRILL_FLEET,
+    DRILL_SERVE,
+    commit_linear,
+    expected_action,
+    linear_obs,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.net, pytest.mark.online]
+
+
+@pytest.fixture
+def spawn_swap_agent(tmp_path):
+    """Like test_remote_fleet's spawn_agent, but the blob carries the boot
+    checkpoint identity (step/path) so the agent's gauntlet has a baseline,
+    and the parent KEEPS the pipe to drive ``("swap", path)`` messages."""
+    import cloudpickle
+
+    from sheeprl_tpu.net.agent import agent_child_main
+
+    ctx = multiprocessing.get_context("spawn")
+    spawned = []
+
+    def build(state, *, step, path, rungs=(1, 2, 4)):
+        blob = cloudpickle.dumps(
+            {
+                "cfg": {"algo": {"name": "linear"}},
+                "state": state,
+                "rungs": list(rungs),
+                "step": int(step),
+                "path": str(path),
+            }
+        )
+        parent, child = ctx.Pipe(duplex=True)
+        proc = ctx.Process(target=agent_child_main, args=(child, blob), daemon=True)
+        proc.start()
+        child.close()
+        spawned.append((proc, parent))
+        assert parent.poll(120), "agent never became ready"
+        msg = parent.recv()
+        assert msg[0] == "ready", f"agent boot failed: {msg}"
+        return f"{msg[1]}:{msg[2]}", proc, parent
+
+    yield build
+    for proc, parent in spawned:
+        try:
+            if proc.is_alive():
+                parent.send(("close",))
+                proc.join(5)
+        except Exception:
+            pass
+        if proc.is_alive():
+            proc.kill()
+            proc.join(5)
+        parent.close()
+
+
+def _pipe_reply(parent, timeout_s=30.0):
+    assert parent.poll(timeout_s), "no reply from remote agent"
+    return parent.recv()
+
+
+def _poison(state):
+    poisoned = copy.deepcopy(state)
+    arr = np.array(poisoned["agent"]["w"])
+    arr.flat[0] = np.nan
+    poisoned["agent"]["w"] = arr
+    return poisoned
+
+
+def test_remote_gauntlet_rejects_degraded_swaps_in_flight_unharmed(tmp_path, spawn_swap_agent):
+    from sheeprl_tpu.serve.config import serve_config_from_cfg
+    from sheeprl_tpu.serve.fleet import REMOTE, FleetServer
+    from sheeprl_tpu.serve.policy import build_linear_policy, make_linear_state
+    from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+    ckpt_dir = str(tmp_path / "checkpoint")
+    path100, state0 = commit_linear(ckpt_dir, 100, seed=0)
+    addr, proc, parent = spawn_swap_agent(state0, step=100, path=path100)
+
+    # the publish dir is separate from the fleet's ckpt_dir: every swap in
+    # this drill is explicit, none comes from a background watcher
+    pub_dir = str(tmp_path / "published")
+    poison_path, _ = commit_linear(pub_dir, 110, state=_poison(state0))
+    torn_path = os.path.join(pub_dir, "ckpt_115_0.ckpt")
+    save_checkpoint(torn_path, make_linear_state(seed=1), backend="pickle", manifest=None)
+    state1 = make_linear_state(seed=1)
+    good_path, _ = commit_linear(pub_dir, 120, state=state1)
+
+    policy = build_linear_policy({"algo": {"name": "linear"}}, state0)
+    node = {
+        **DRILL_SERVE,
+        "fleet": {**DRILL_FLEET, "remote_agents": [addr], "num_replicas": 1, "max_replicas": 1},
+    }
+    cfg = serve_config_from_cfg({"serve": node})
+    server = FleetServer(policy, cfg, step=100, path=path100, ckpt_dir=ckpt_dir)
+    with server:
+        remote_slots = [s for s in server.slots if s.kind == REMOTE]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not all(s.alive for s in remote_slots):
+            time.sleep(0.02)
+        assert all(s.alive for s in remote_slots)
+
+        # 48 requests in flight, then the poisoned checkpoint lands mid-swarm
+        reqs = []
+        for i in range(48):
+            obs = linear_obs(state0, value=float(i % 7))
+            reqs.append((server.submit(obs, deadline_s=10.0), obs))
+        parent.send(("swap", poison_path))
+        for req, obs in reqs:
+            out = server.wait(req)  # zero dropped: every admitted completes
+            assert np.allclose(np.asarray(out), expected_action(state0, obs), atol=1e-5)
+        msg = _pipe_reply(parent)
+        assert msg[0] == "swap_rejected", msg
+        assert "non-finite" in msg[1]
+
+        # torn checkpoint: refused before the gauntlet even loads a byte
+        parent.send(("swap", torn_path))
+        msg = _pipe_reply(parent)
+        assert msg[0] == "swap_rejected", msg
+        assert "manifest" in msg[1]
+
+        # still serving the boot version, still correct
+        obs = linear_obs(state0, value=3.0)
+        out = server.wait(server.submit(obs, deadline_s=10.0))
+        assert np.allclose(np.asarray(out), expected_action(state0, obs), atol=1e-5)
+
+        # the good checkpoint swaps in remotely AND locally (the same commit
+        # the publisher would fan out), and the served actions change with it
+        parent.send(("swap", good_path))
+        msg = _pipe_reply(parent)
+        assert msg == ("swap_ok", 120), msg
+        server.request_swap(good_path)
+        obs = linear_obs(state1, value=2.0)
+        out = server.wait(server.submit(obs, deadline_s=10.0))
+        assert np.allclose(np.asarray(out), expected_action(state1, obs), atol=1e-5)
+
+    # the agent's own books agree: one promotion, two gauntlet rejections
+    parent.send(("close",))
+    msg = _pipe_reply(parent)
+    assert msg[0] == "bye"
+    _, batches, requests, swaps, swap_rejects = msg
+    assert requests >= 1
+    assert swaps == 1
+    assert swap_rejects == 2
+    proc.join(10)

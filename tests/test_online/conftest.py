@@ -1,0 +1,246 @@
+"""Fixtures for the online-learning bridge tests: an in-process closed loop
+(fleet or single server → bridge → learner → publisher → hot swap) over the
+committed linear policy, with a hidden target policy as the feedback oracle.
+
+The hook used everywhere is "imitate the hidden expert": reward is the
+negative squared distance between the served action and the expert's, the
+target is the expert action itself — so learning *provably* improves eval
+return as ``w`` converges toward ``w*``, which is what the mid-run
+improvement acceptance drill gates on.
+"""
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+import pytest
+
+from tests.test_serve.conftest import (  # noqa: F401  (make_server re-exported as a fixture)
+    DRILL_FLEET,
+    DRILL_SERVE,
+    commit_linear,
+    make_server,
+)
+
+
+def make_expert_hook(seed: int = 7):
+    """(hook, expert_state): Feedback(reward, target) against a hidden
+    expert linear policy drawn from ``seed``."""
+    from sheeprl_tpu.online import Feedback
+    from sheeprl_tpu.serve.policy import make_linear_state
+
+    expert = make_linear_state(seed=seed)
+    w = np.asarray(expert["agent"]["w"], dtype=np.float32)
+    b = np.asarray(expert["agent"]["b"], dtype=np.float32)
+
+    def hook(obs: Dict[str, Any], action: Any) -> Feedback:
+        x = np.asarray(obs["vector"], dtype=np.float32)
+        target = x @ w + b
+        reward = -float(np.sum((np.asarray(action, dtype=np.float32) - target) ** 2))
+        return Feedback(reward=reward, target=target)
+
+    return hook, expert
+
+
+def eval_return(server: Any, hook: Callable, *, n: int = 32, seed: int = 123) -> float:
+    """Mean hook reward of the CURRENTLY SERVED policy on a fixed eval set."""
+    rng = np.random.default_rng(seed)
+    in_dim = server.policy.obs_spec["vector"].shape[0]
+    total = 0.0
+    for _ in range(n):
+        obs = {"vector": rng.standard_normal(in_dim).astype(np.float32)}
+        out = server.infer(obs, deadline_s=10.0)
+        total += hook(obs, out).reward
+    return total / n
+
+
+class OnlineLoop:
+    """Everything the closed loop owns, with one close() for teardown."""
+
+    def __init__(self, **parts: Any) -> None:
+        self.__dict__.update(parts)
+        self.events: List[tuple] = parts.get("events", [])
+
+    def close(self) -> None:
+        for name in ("bridge", "learner"):
+            part = self.__dict__.get(name)
+            if part is not None:
+                part.close()
+        for name in ("server",):
+            part = self.__dict__.get(name)
+            if part is not None:
+                part.close()
+        for name in ("actor_transport", "learner_transport"):
+            part = self.__dict__.get(name)
+            if part is not None:
+                part.close()
+
+
+@pytest.fixture
+def make_loop(tmp_path):
+    """Factory for the full in-process loop. Keyword knobs:
+
+    - ``fleet``: route through a FleetServer (default True)
+    - ``online``: OnlineConfig field overrides
+    - ``faults``: bridge fault dicts (``parse_bridge_faults`` shape)
+    - ``hook``: replace the expert hook
+    - ``start_learner`` / ``start_bridge``: leave parts un-started
+    """
+    from sheeprl_tpu.net.transport import ShmLearnerTransport, attach_actor_transport
+    from sheeprl_tpu.online import (
+        BridgeFaultSchedule,
+        CheckpointPublisher,
+        ExperienceBridge,
+        GuardedHook,
+        OnlineConfig,
+        OnlineLearner,
+        VersionAuthority,
+        build_experience_layout,
+        linear_feedback_train_step,
+        parse_bridge_faults,
+    )
+    from sheeprl_tpu.online.learner import linear_state
+    from sheeprl_tpu.serve.config import serve_config_from_cfg
+    from sheeprl_tpu.serve.fleet import FleetServer
+    from sheeprl_tpu.serve.policy import build_linear_policy
+    from sheeprl_tpu.serve.server import PolicyServer
+
+    loops: List[OnlineLoop] = []
+
+    def build(
+        *,
+        fleet: bool = True,
+        online: Optional[Dict[str, Any]] = None,
+        faults: Optional[List[Dict[str, Any]]] = None,
+        hook: Optional[Callable] = None,
+        start_learner: bool = True,
+        start_bridge: bool = True,
+    ) -> OnlineLoop:
+        ckpt_dir = str(tmp_path / f"checkpoint{len(loops)}")
+        path, state = commit_linear(ckpt_dir, 100, seed=0)
+        policy = build_linear_policy({"algo": {"name": "linear"}}, state)
+        if fleet:
+            cfg = serve_config_from_cfg({"serve": {**DRILL_SERVE, "fleet": {**DRILL_FLEET}}})
+            server: Any = FleetServer(policy, cfg, step=100, path=path, ckpt_dir=ckpt_dir)
+        else:
+            cfg = serve_config_from_cfg({"serve": {**DRILL_SERVE}})
+            server = PolicyServer(policy, cfg, step=100, path=path, ckpt_dir=ckpt_dir)
+        server.start()
+
+        ocfg = OnlineConfig(
+            enabled=True,
+            rows_per_slab=8,
+            ring_slots=4,
+            max_staleness=4,
+            publish_every=2,
+            lr=0.05,
+            hook_timeout_s=0.3,
+            **(online or {}),
+        )
+        schedule = BridgeFaultSchedule(parse_bridge_faults(faults)) if faults else None
+        authority = VersionAuthority(boot_step=100)
+        server.store.version_authority = authority
+
+        expert_hook, expert = make_expert_hook()
+        the_hook = hook if hook is not None else expert_hook
+        out_dim = np.asarray(state["agent"]["b"]).shape[0]
+        layout = build_experience_layout(policy.obs_spec, (out_dim,), ocfg.rows_per_slab)
+        learner_transport = ShmLearnerTransport(
+            payload_bytes=layout.nbytes, num_slots=ocfg.ring_slots, param_nbytes=64
+        )
+        actor_transport = attach_actor_transport(
+            learner_transport.actor_wire(0),
+            actor_id=0,
+            generation=0,
+            slots=list(range(ocfg.ring_slots)),
+        )
+
+        events: List[tuple] = []
+
+        def on_event(kind: str, info: Dict[str, Any]) -> None:
+            events.append((kind, dict(info)))
+
+        guard = GuardedHook(the_hook, timeout_s=ocfg.hook_timeout_s, schedule=schedule)
+        bridge = ExperienceBridge(
+            layout=layout,
+            transport=actor_transport,
+            authority=authority,
+            hook=guard,
+            cfg=ocfg,
+            schedule=schedule,
+            on_event=on_event,
+        )
+        publisher = CheckpointPublisher(
+            ckpt_dir=ckpt_dir,
+            authority=authority,
+            state_fn=linear_state,
+            servers=[server],
+            schedule=schedule,
+            boot_step=100,
+            on_event=on_event,
+        )
+        params0 = {k: np.asarray(v, dtype=np.float32) for k, v in state["agent"].items()}
+        learner = OnlineLearner(
+            transport=learner_transport,
+            layout=layout,
+            authority=authority,
+            cfg=ocfg,
+            params=params0,
+            train_step=linear_feedback_train_step(ocfg.lr),
+            publisher=publisher,
+            on_event=on_event,
+        )
+        if start_bridge:
+            bridge.start()
+        if start_learner:
+            learner.start()
+        loop = OnlineLoop(
+            server=server,
+            state=state,
+            ckpt_dir=ckpt_dir,
+            cfg=ocfg,
+            authority=authority,
+            layout=layout,
+            learner_transport=learner_transport,
+            actor_transport=actor_transport,
+            hook=the_hook,
+            guard=guard,
+            bridge=bridge,
+            publisher=publisher,
+            learner=learner,
+            events=events,
+            expert=expert,
+        )
+        loops.append(loop)
+        return loop
+
+    yield build
+    for loop in loops:
+        loop.close()
+
+
+def drive(loop: OnlineLoop, n: int, *, seed: int = 0, timeout_s: float = 10.0) -> int:
+    """Serve ``n`` requests through a tapped ServeClient; returns how many
+    completed (raises if any admitted request is dropped — wait() surfaces
+    that as an exception)."""
+    from sheeprl_tpu.serve.client import ServeClient
+
+    client = ServeClient(loop.server, timeout_s=timeout_s, experience_sink=loop.bridge.observe)
+    rng = np.random.default_rng(seed)
+    in_dim = loop.server.policy.obs_spec["vector"].shape[0]
+    ok = 0
+    for _ in range(n):
+        obs = {"vector": rng.standard_normal(in_dim).astype(np.float32)}
+        client.infer(obs)
+        ok += 1
+    return ok
+
+
+def wait_until(predicate: Callable[[], bool], timeout_s: float = 10.0, interval_s: float = 0.01) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()

@@ -1,0 +1,91 @@
+"""GuardedHook drills: user feedback code can raise or hang; serving's
+experience collector must shed the row (counted) and keep going."""
+
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.online import BridgeFaultSchedule, Feedback, GuardedHook, parse_bridge_faults
+
+pytestmark = [pytest.mark.online]
+
+
+def test_normalizes_feedback_tuple_and_scalar():
+    returns = [Feedback(1.0, np.ones(2)), (2.0, np.zeros(2)), 3.0, (4.0, None)]
+    guard = GuardedHook(lambda obs, a: returns.pop(0), timeout_s=2.0)
+    try:
+        fb = guard(None, None)
+        assert fb.reward == 1.0 and np.allclose(fb.target, 1.0)
+        fb = guard(None, None)
+        assert fb.reward == 2.0 and np.allclose(fb.target, 0.0)
+        fb = guard(None, None)
+        assert fb.reward == 3.0 and fb.target is None
+        fb = guard(None, None)
+        assert fb.reward == 4.0 and fb.target is None
+        assert guard.snapshot() == {"hook_calls": 4, "hook_errors": 0, "hook_hangs": 0}
+    finally:
+        guard.close()
+
+
+def test_organic_exception_sheds_row_and_counts():
+    calls = []
+
+    def hook(obs, action):
+        calls.append(action)
+        if len(calls) == 2:
+            raise ValueError("user code blew up")
+        return 1.0
+
+    guard = GuardedHook(hook, timeout_s=2.0)
+    try:
+        assert guard(None, 0) is not None
+        assert guard(None, 1) is None  # the raising call
+        assert guard(None, 2) is not None  # guard recovered, same worker
+        assert guard.errors == 1
+    finally:
+        guard.close()
+
+
+def test_scheduled_hook_exception_fault():
+    schedule = BridgeFaultSchedule(parse_bridge_faults([{"kind": "hook_exception", "at_row": 1}]))
+    guard = GuardedHook(lambda obs, a: 1.0, timeout_s=2.0, schedule=schedule)
+    try:
+        assert guard(None, 0) is not None
+        assert guard(None, 1) is None  # injected HookError
+        assert guard(None, 2) is not None
+        assert guard.errors == 1 and guard.hangs == 0
+    finally:
+        guard.close()
+
+
+def test_scheduled_hang_is_abandoned_and_recovers():
+    events = []
+    schedule = BridgeFaultSchedule(
+        parse_bridge_faults([{"kind": "hook_hang", "at_row": 1, "duration_s": 0.6}])
+    )
+    guard = GuardedHook(
+        lambda obs, a: 42.0,
+        timeout_s=0.1,
+        schedule=schedule,
+        on_event=lambda k, info: events.append((k, info)),
+    )
+    try:
+        assert guard(None, 0).reward == 42.0
+        t0 = time.monotonic()
+        assert guard(None, 1) is None  # hang: shed within the budget
+        assert time.monotonic() - t0 < 0.5  # did NOT wait out the 0.6s stall
+        assert guard.hangs == 1
+        # a fresh worker serves the next row even while the old one stalls
+        assert guard(None, 2).reward == 42.0
+        assert [k for k, _ in events] == ["hook_hang"]
+    finally:
+        guard.close()
+
+
+def test_closed_guard_sheds_everything():
+    guard = GuardedHook(lambda obs, a: 1.0, timeout_s=1.0)
+    assert guard(None, 0) is not None
+    guard.close()
+    assert guard(None, 1) is None
+    guard.close()  # idempotent

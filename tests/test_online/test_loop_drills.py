@@ -1,0 +1,169 @@
+"""Closed-loop drills: served traffic → experience bridge → online learner →
+checkpoint publisher → hot-swap gauntlet → back into the serving fleet.
+
+Every test runs the REAL loop in-process (fleet router + replicas, shm
+trajectory ring, training thread, committed checkpoints on disk, the PR 6
+swap gauntlet) — nothing is mocked. The chaos drills then break exactly one
+link and assert the blast radius: serving never blips, sheds are counted,
+and the fleet keeps answering from the last validated version.
+"""
+
+import numpy as np
+import pytest
+
+from tests.test_online.conftest import drive, eval_return, wait_until
+
+pytestmark = [pytest.mark.online]
+
+
+def test_closed_loop_improves_eval_return_mid_run(make_loop):
+    loop = make_loop()
+    before = eval_return(loop.server, loop.hook)
+    n = drive(loop, 400)
+    assert n == 400  # zero dropped admitted requests
+    # the learner published and the gauntlet promoted at least a few versions
+    assert wait_until(lambda: loop.publisher.swaps_ok >= 3)
+    mid = eval_return(loop.server, loop.hook)
+    assert mid > before + 0.5, (before, mid)  # measurable, not epsilon
+    n = drive(loop, 400, seed=1)
+    assert n == 400
+    assert wait_until(lambda: loop.publisher.swaps_ok >= 6)
+    after = eval_return(loop.server, loop.hook)
+    assert after > before + 1.0, (before, mid, after)
+    # the version chain is coherent: everything published was confirmed
+    snap = loop.authority.snapshot()
+    assert snap["published_version"] >= 3
+    assert snap["confirmed_version"] == snap["published_version"]
+    assert loop.server.store.current.step == snap["confirmed_step"]
+    # and the learner actually trained on served experience
+    assert loop.learner.updates >= 6
+    assert loop.learner.rows_trained >= 48
+    assert loop.learner.updates_rejected == 0
+
+
+def test_poison_publish_mid_ramp_rejected_serving_continues(make_loop):
+    loop = make_loop(faults=[{"kind": "poison_publish", "at_publish": 2}])
+    n = drive(loop, 300)
+    assert n == 300
+    assert wait_until(lambda: loop.publisher.attempts >= 3)
+    assert loop.publisher.swap_rejects >= 1  # the gauntlet caught the poison
+    assert any("non-finite" in r for r in loop.publisher.reject_reasons)
+    # serving continued right through the rejected ramp: later CLEAN publishes
+    # were promoted, so the fleet is past boot but never served the poison
+    assert wait_until(lambda: loop.publisher.swaps_ok >= 1)
+    assert loop.server.store.current.step > 100
+    assert loop.server.store.current.step != loop.publisher.poisoned_steps[0]
+    assert drive(loop, 50, seed=2) == 50
+    assert np.isfinite(eval_return(loop.server, loop.hook, n=8))
+
+
+def test_learner_kill_fleet_serves_last_validated_indefinitely(make_loop):
+    loop = make_loop(faults=[{"kind": "learner_kill", "at_publish": 3}])
+    drive(loop, 300)
+    assert wait_until(lambda: loop.learner.killed)
+    assert not loop.learner.running
+    last_validated = loop.server.store.current.step
+    confirmed = loop.authority.confirmed_version
+    assert last_validated > 100  # the first two publishes did land
+    # the learner is gone; the fleet must keep serving the last validated
+    # version for as long as traffic keeps coming
+    for seed in (3, 4, 5):
+        assert drive(loop, 60, seed=seed) == 60
+    assert loop.server.store.current.step == last_validated
+    assert loop.authority.confirmed_version == confirmed
+    # with nobody draining the ring, the bridge sheds EXPERIENCE (counted),
+    # never admission — every request above completed
+    assert wait_until(lambda: loop.bridge.shed_experience > 0)
+    assert loop.bridge.rows_shed_ring > 0
+
+
+def test_ring_full_sheds_experience_not_admission(make_loop):
+    loop = make_loop(faults=[{"kind": "ring_full", "at_slab": 1, "for_slabs": 3}])
+    n = drive(loop, 300)
+    assert n == 300  # admission untouched by ring backpressure
+    assert wait_until(lambda: loop.bridge.slabs_shed_ring >= 3)
+    assert loop.bridge.shed_experience >= 3 * loop.cfg.rows_per_slab
+    kinds = [k for k, _ in loop.events]
+    assert "exp_slab_shed" in kinds
+    # slabs outside the fault window still flowed and trained
+    assert wait_until(lambda: loop.learner.updates >= 1)
+    assert loop.learner.slabs_admitted >= 1
+
+
+def test_trace_chain_request_to_swap(tmp_path, make_loop):
+    from sheeprl_tpu.obs.trace import configure_trace, shutdown_trace
+    from tools.trace import merge
+
+    trace_path = str(tmp_path / "trace.test.jsonl")
+    configure_trace("serve_train", trace_path)
+    try:
+        loop = make_loop()
+        drive(loop, 120)
+        assert wait_until(lambda: loop.publisher.swaps_ok >= 1 and loop.learner.updates >= 2)
+        # quiesce the learning side BEFORE reading the trace: the learner
+        # keeps draining slabs and publishing, so merging a live stream races
+        # the confirmed_step assertion below
+        loop.bridge.close()
+        loop.learner.close()
+    finally:
+        shutdown_trace()
+
+    merged = merge([trace_path])
+    traces = {int(k): v for k, v in merged["traces"].items()}
+    untraced = merged.get("untraced", [])
+
+    # 1) a served request chain that terminated in request_done …
+    done_tids = {
+        tid for tid, evs in traces.items() if any(e["kind"] == "request_done" for e in evs)
+    }
+    assert done_tids
+    # 2) … feeds an experience slab that lists it as provenance …
+    slabs = [
+        (tid, e)
+        for tid, evs in traces.items()
+        for e in evs
+        if e["kind"] == "exp_slab"
+    ]
+    assert slabs
+    fed = [
+        (tid, e) for tid, e in slabs if done_tids.intersection(int(r) for r in e["requests"])
+    ]
+    assert fed, "no exp_slab lists a completed request as provenance"
+    slab_tid, slab_ev = fed[0]
+    # 3) … whose SAME trace id reaches the learner's gradient window …
+    updates = [e for e in traces[slab_tid] if e["kind"] == "online_update"]
+    assert updates, "slab trace id never reached an online_update"
+    # 4) … and the published version / hot swap close the chain
+    publishes = [e for e in untraced if e["kind"] == "param_publish"]
+    swaps = [e for e in untraced if e["kind"] == "model_swap"]
+    assert publishes and swaps
+    published_steps = {int(e["ckpt_step"]) for e in publishes}
+    assert {int(e["ckpt_step"]) for e in swaps} & published_steps
+    # the swap the gauntlet promoted is the version the authority confirmed
+    assert loop.authority.confirmed_step in {int(e["ckpt_step"]) for e in swaps}
+
+
+@pytest.mark.slow
+def test_full_loop_under_loadgen_meets_slo(make_loop):
+    """The acceptance drill at benchmark shape: loadgen IS the served
+    traffic, its tap feeds the learner, eval improves, p95 holds."""
+    from sheeprl_tpu.serve.config import LoadConfig
+    from sheeprl_tpu.serve.loadgen import run_load
+
+    loop = make_loop()
+    before = eval_return(loop.server, loop.hook)
+    rng = np.random.default_rng(0)
+    in_dim = loop.server.policy.obs_spec["vector"].shape[0]
+
+    def obs_factory(i: int):
+        return {"vector": rng.standard_normal(in_dim).astype(np.float32)}
+
+    cfg = LoadConfig(enabled=True, rate_hz=400.0, duration_s=3.0, concurrency=4, timeout_ms=500.0)
+    report = run_load(
+        loop.server, cfg, obs_factory=obs_factory, experience_sink=loop.bridge.observe
+    )
+    assert report["ok"] > 0
+    assert report["slo_met"], report
+    assert wait_until(lambda: loop.publisher.swaps_ok >= 1)
+    after = eval_return(loop.server, loop.hook)
+    assert after > before, (before, after)

@@ -1,0 +1,119 @@
+"""CheckpointPublisher drills: manifest discipline, publish faults, and the
+gauntlet as the last line between a degraded checkpoint and the fleet."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.online import (
+    BridgeFaultSchedule,
+    CheckpointPublisher,
+    VersionAuthority,
+    parse_bridge_faults,
+)
+from sheeprl_tpu.online.learner import linear_state
+from sheeprl_tpu.resilience.discovery import newest_committed
+from tests.test_serve.conftest import DRILL_SERVE, commit_linear
+
+pytestmark = [pytest.mark.online]
+
+
+def _params(seed=0):
+    from sheeprl_tpu.serve.policy import make_linear_state
+
+    state = make_linear_state(seed=seed)
+    return {k: np.asarray(v, dtype=np.float32) for k, v in state["agent"].items()}
+
+
+def test_publish_commits_manifested_checkpoint_and_mints_version(tmp_path):
+    auth = VersionAuthority(boot_step=100)
+    pub = CheckpointPublisher(
+        ckpt_dir=str(tmp_path), authority=auth, state_fn=linear_state, boot_step=100
+    )
+    result = pub.publish(_params())
+    assert result["step"] == 101 and result["version"] == 1
+    newest = newest_committed(str(tmp_path))
+    assert newest is not None and newest.step == 101
+    assert auth.published_version == 1
+    assert auth.version_for_step(101) == 1
+    # confirmed only moves when a gauntlet promotes — no servers attached
+    assert auth.confirmed_version == 0
+    assert pub.snapshot()["publish_committed"] == 1
+
+
+def test_boot_step_resumes_from_existing_commits(tmp_path):
+    commit_linear(str(tmp_path), 140, seed=0)
+    commit_linear(str(tmp_path), 120, seed=0)
+    auth = VersionAuthority(boot_step=140)
+    pub = CheckpointPublisher(ckpt_dir=str(tmp_path), authority=auth, state_fn=linear_state)
+    assert pub.step == 140  # discovery helper found the newest commit
+    assert pub.publish(_params())["step"] == 141
+
+
+def test_torn_publish_leaves_no_manifest_and_mints_no_version(tmp_path):
+    schedule = BridgeFaultSchedule(parse_bridge_faults([{"kind": "torn_publish", "at_publish": 1}]))
+    auth = VersionAuthority(boot_step=100)
+    pub = CheckpointPublisher(
+        ckpt_dir=str(tmp_path), authority=auth, state_fn=linear_state,
+        schedule=schedule, boot_step=100,
+    )
+    result = pub.publish(_params())
+    assert result["torn"] is True and result["version"] is None
+    assert auth.published_version == 0
+    # the payload exists but discovery refuses it: no manifest, not committed
+    assert os.path.exists(os.path.join(str(tmp_path), "ckpt_101_0.ckpt"))
+    assert newest_committed(str(tmp_path)) is None
+    # the next publish commits cleanly at the NEXT step
+    result = pub.publish(_params())
+    assert result["step"] == 102 and result["version"] == 1
+    assert newest_committed(str(tmp_path)).step == 102
+
+
+def test_learner_kill_commits_but_never_pushes(tmp_path, make_server):
+    server, ckpt_dir, state = make_server()
+    server.start()
+    schedule = BridgeFaultSchedule(parse_bridge_faults([{"kind": "learner_kill", "at_publish": 1}]))
+    auth = VersionAuthority(boot_step=100)
+    server.store.version_authority = auth
+    pub = CheckpointPublisher(
+        ckpt_dir=ckpt_dir, authority=auth, state_fn=linear_state,
+        servers=[server], schedule=schedule, boot_step=100,
+    )
+    result = pub.publish(_params(seed=1))
+    assert result["killed"] is True
+    assert result["version"] == 1  # committed before the death
+    # the server never heard about it from the publisher
+    assert server.store.current.step == 100
+    assert pub.swaps_ok == 0 and pub.swap_rejects == 0
+
+
+def test_poison_publish_rejected_by_gauntlet_serving_continues(tmp_path, make_server):
+    from tests.test_serve.conftest import expected_action, linear_obs
+
+    server, ckpt_dir, state = make_server()
+    server.start()
+    schedule = BridgeFaultSchedule(parse_bridge_faults([{"kind": "poison_publish", "at_publish": 1}]))
+    auth = VersionAuthority(boot_step=100)
+    server.store.version_authority = auth
+    pub = CheckpointPublisher(
+        ckpt_dir=ckpt_dir, authority=auth, state_fn=linear_state,
+        servers=[server], schedule=schedule, boot_step=100,
+    )
+    result = pub.publish(_params(seed=0))
+    # the poisoned checkpoint COMMITTED (manifest digest matches the poison)
+    # — only the gauntlet's finiteness gate stood, and it held
+    assert result["rejected"] == 1 and result["swapped"] == 0
+    assert "non-finite" in result["reject_reasons"][0]
+    assert pub.swap_rejects == 1
+    assert server.store.current.step == 100  # still the boot version
+    assert auth.confirmed_version == 0
+    # serving continues, answers still correct
+    obs = linear_obs(state)
+    out = server.infer(obs, deadline_s=5.0)
+    assert np.allclose(np.asarray(out), expected_action(state, obs), atol=1e-5)
+    # the next (clean) publish swaps in fine and confirms
+    result = pub.publish(_params(seed=0))
+    assert result["swapped"] == 1
+    assert server.store.current.step == 102
+    assert auth.confirmed_version == 2

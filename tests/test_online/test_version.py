@@ -1,0 +1,75 @@
+"""VersionAuthority unit drills: one monotonic counter, publish vs confirm."""
+
+import threading
+
+import pytest
+
+from sheeprl_tpu.online import VersionAuthority
+
+pytestmark = [pytest.mark.online]
+
+
+def test_boot_step_is_version_zero():
+    auth = VersionAuthority(boot_step=100)
+    assert auth.version_for_step(100) == 0
+    assert auth.published_version == 0
+    assert auth.confirmed_version == 0
+
+
+def test_publish_mints_monotonic_versions_idempotently():
+    auth = VersionAuthority(boot_step=100)
+    v1 = auth.publish(104)
+    v2 = auth.publish(108)
+    assert (v1, v2) == (1, 2)
+    # republishing a known step returns its existing version, mints nothing
+    assert auth.publish(104) == 1
+    assert auth.published_version == 2
+    assert auth.version_for_step(104) == 1
+    assert auth.step_for_version(2) == 108
+
+
+def test_unknown_step_maps_to_boot_version():
+    auth = VersionAuthority(boot_step=100)
+    # a request stamped before the authority learned its step (or the
+    # served_step=-1 sentinel) falls back to the boot version — conservative:
+    # staleness can only be overestimated, never underestimated
+    assert auth.version_for_step(999) == 0
+    assert auth.version_for_step(-1) == 0
+
+
+def test_confirm_tracks_gauntlet_promotions_only():
+    auth = VersionAuthority(boot_step=100)
+    auth.publish(104)
+    auth.publish(108)
+    assert auth.confirmed_version == 0  # nothing promoted yet
+    assert auth.confirm(104) == 1
+    assert auth.confirmed_version == 1
+    assert auth.confirmed_step == 104
+    # confirming an unknown step is a no-op, not an invention
+    assert auth.confirm(999) is None
+    assert auth.confirmed_version == 1
+    assert auth.confirm(108) == 2
+    snap = auth.snapshot()
+    assert snap["published_version"] == 2
+    assert snap["confirmed_version"] == 2
+    assert snap["confirmed_step"] == 108
+
+
+def test_concurrent_publish_stays_monotonic():
+    auth = VersionAuthority(boot_step=0)
+    minted = []
+    lock = threading.Lock()
+
+    def worker(base: int) -> None:
+        for i in range(50):
+            v = auth.publish(base + i)
+            with lock:
+                minted.append(v)
+
+    threads = [threading.Thread(target=worker, args=(1 + t * 1000,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(set(minted)) == 200  # every distinct step got a distinct version
+    assert auth.published_version == 200

@@ -98,7 +98,7 @@ def test_kill_replica_mid_burst_zero_dropped(make_fleet, tmp_path):
             fault_injection={
                 "enabled": True,
                 "faults": [
-                    {"kind": "slow_inference", "replica": 0, "at_batch": 0, "duration_s": 0.15, "for_batches": 3}
+                    {"kind": "slow_inference", "replica": 0, "at_batch": 0, "duration_s": 0.25, "for_batches": 50}
                 ],
             },
         )
@@ -119,8 +119,9 @@ def test_kill_replica_mid_burst_zero_dropped(make_fleet, tmp_path):
         for t in threads:
             t.start()
         # kill only once replica 0 actually holds a batch — the slow_inference
-        # fault pins it for 0.15s, so the kill lands inside that window and
-        # strands it; a fixed sleep races thread scheduling on loaded boxes
+        # fault pins EVERY burst batch for 0.25s, so whichever batch we observe
+        # in flight, the kill lands inside its pin window and strands it; a
+        # narrower window races the observed batch completing before the kill
         assert _wait_until(lambda: len(server.slots[0].pool._inflight) > 0)
         assert server.kill_replica(0)
         for t in threads:
@@ -129,7 +130,11 @@ def test_kill_replica_mid_burst_zero_dropped(make_fleet, tmp_path):
         assert _wait_until(lambda: server.slots[0].alive)  # budgeted restart
         snap = server.snapshot()
         assert snap["failed"] == 0 and snap["restarts"] >= 1
-        assert snap["fleet"]["router"]["rerouted_requests"] >= 1  # stranded batch re-placed
+        # the stranded batch was re-homed: by the monitor's re-route-at-front,
+        # or by a hedge twin when the adaptive hedge scan (threshold learned
+        # down to ~ms on a warm ladder) beats the monitor pass to the rescue
+        router_snap = snap["fleet"]["router"]
+        assert router_snap["rerouted_requests"] + router_snap["hedged"] >= 1
 
         # request_done is emitted by the delivering replica thread right
         # after the future resolves — give the last few a beat to land
@@ -149,21 +154,27 @@ def test_kill_replica_mid_burst_zero_dropped(make_fleet, tmp_path):
     req = summary["requests"]
     assert req["traces"] == 120  # every admitted request minted one chain
     assert req["terminals"] == {"request_done": 120}  # zero dangling/expired
-    assert req["rerouted"] >= 1  # the kill's victims carry request_reroute
+    # the kill's victims carry request_reroute, or request_hedge when the
+    # adaptive hedge scan won the rescue race (same either/or as the snapshot)
+    assert req["rerouted"] + req["hedged"] >= 1
     assert "hedge_winner_dupes" not in req  # first-completion-wins held
     for tid, evs in merged["traces"].items():
         kinds = trace_tool.trace_kinds(evs)
         assert kinds[0] == "request_admit", (tid, kinds)
         assert kinds.count("request_done") == 1, (tid, kinds)
-    # the fault victim's chain: re-routed, then done on a survivor
+    # the fault victim's chain: re-homed, then done exactly once
     victims = [
         evs for evs in merged["traces"].values()
-        if any(e["kind"] == "request_reroute" for e in evs)
+        if any(e["kind"] in ("request_reroute", "request_hedge") for e in evs)
     ]
     assert victims
     for evs in victims:
         done = [e for e in evs if e["kind"] == "request_done"][0]
-        assert done["rerouted"] is True
+        rescued = [e["kind"] for e in evs]
+        if "request_reroute" in rescued:
+            assert done["rerouted"] is True
+        else:
+            assert done["hedged"] is True
     # the kill itself lands on the untraced (process-scoped) timeline
     assert any(e["kind"] == "replica_killed" for e in merged["untraced"])
 

@@ -1,0 +1,94 @@
+"""tools/drills.py: the chaos-drill registry scanner."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tools import drills
+
+pytestmark = [pytest.mark.online]
+
+
+def _write(root, rel, content):
+    path = os.path.join(root, rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(textwrap.dedent(content))
+    return path
+
+
+FAKE_DOMAINS = {"serve": ("replica_crash", "poison_swap"), "online": ("ring_full",)}
+
+
+def test_scan_attributes_kinds_markers_and_verdicts(tmp_path):
+    tests_root = str(tmp_path / "tests")
+    _write(
+        tests_root,
+        "test_chaos.py",
+        '''
+        import pytest
+
+        pytestmark = [pytest.mark.serve]
+
+        @pytest.mark.slow
+        def test_crash_drill():
+            faults = [{"kind": "replica_crash", "at_batch": 2}]
+
+        def test_ring_drill():
+            faults = [{"kind": "ring_full"}]
+
+        def test_not_a_drill():
+            assert 1 + 1 == 2
+        ''',
+    )
+    cache = tmp_path / ".pytest_cache" / "v" / "cache"
+    os.makedirs(cache)
+    crash_id = os.path.join(tests_root, "test_chaos.py") + "::test_crash_drill"
+    ring_id = os.path.join(tests_root, "test_chaos.py") + "::test_ring_drill"
+    (cache / "lastfailed").write_text(json.dumps({crash_id: True}))
+    (cache / "nodeids").write_text(json.dumps([crash_id, ring_id]))
+
+    registry = drills.scan(
+        tests_root, domains=FAKE_DOMAINS, cache_dir=str(tmp_path / ".pytest_cache")
+    )
+    by_name = {d["nodeid"].rsplit("::", 1)[1]: d for d in registry["drills"]}
+    assert set(by_name) == {"test_crash_drill", "test_ring_drill"}
+    crash = by_name["test_crash_drill"]
+    assert crash["fault_kinds"] == ["replica_crash"]
+    assert crash["domains"] == ["serve"]
+    assert crash["markers"] == ["serve", "slow"]  # module mark + decorator
+    assert crash["verdict"] == "failed"
+    ring = by_name["test_ring_drill"]
+    assert ring["verdict"] == "passed"
+    assert ring["domains"] == ["online"]
+    assert registry["coverage"]["serve"] == {"replica_crash": 1, "poison_swap": 0}
+    assert registry["uncovered"] == {"serve": ["poison_swap"]}
+    assert registry["totals"] == {"drills": 2, "kinds": 3, "kinds_covered": 2}
+
+
+def test_missing_cache_means_unknown_not_invented(tmp_path):
+    tests_root = str(tmp_path / "tests")
+    _write(tests_root, "test_x.py", 'def test_d():\n    k = "ring_full"\n')
+    registry = drills.scan(
+        tests_root, domains=FAKE_DOMAINS, cache_dir=str(tmp_path / "nope")
+    )
+    assert registry["drills"][0]["verdict"] == "unknown"
+
+
+def test_repo_registry_has_no_undrilled_fault_kind():
+    """The acceptance contract: every fault kind any domain registers has at
+    least one drill in the suite — including all six bridge kinds."""
+    registry = drills.scan("tests")
+    assert registry["uncovered"] == {}, registry["uncovered"]
+    online = registry["coverage"]["online"]
+    assert set(online) == {
+        "poison_publish",
+        "torn_publish",
+        "learner_kill",
+        "hook_exception",
+        "hook_hang",
+        "ring_full",
+    }
+    assert all(n >= 1 for n in online.values()), online

@@ -309,3 +309,32 @@ def test_regress_rewrite_preserves_jaxcheck_keys(tmp_path):
     assert doc["config_summary"]["pass"] == 1
     assert doc["static_findings"]["new"] == 0
     assert "cells" in doc and "summary" in doc  # the regress grid is still there
+
+
+def test_ci_baseline_gc_gate_is_clean():
+    """Tier-1 wiring of ``jaxcheck --ci --baseline-gc``: the CI shape of the
+    gate (report stale suppressions, never rewrite, exit nonzero) must pass
+    against the checked-in baseline."""
+    env = dict(os.environ, SHEEPRL_TPU_SKIP_ALGO_IMPORTS="1")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.jaxcheck", "--ci", "--baseline-gc"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no stale suppressions" in proc.stdout
+
+
+def test_online_package_scanned_with_zero_suppressions():
+    """The online-learning bridge is inside the default scan targets and
+    carries NO findings — not even baseline-suppressed ones."""
+    findings, files_scanned, errors = jaxcheck.scan(["sheeprl_tpu/online"], root=REPO)
+    assert files_scanned >= 8
+    assert errors == []
+    assert findings == [], [f.render() for f in findings]
+    # and no baseline entry exists for the package: zero new suppressions
+    baseline = load_baseline(os.path.join(REPO, "tools", "jaxcheck_baseline.json"))
+    assert not any("sheeprl_tpu/online/" in key for key in baseline)

@@ -94,6 +94,13 @@ METRICS: Dict[str, Tuple[bool, float]] = {
     "net_torn_frames": (False, 0.0),
     "net_reconnects": (False, 1.0),
     "net_heartbeat_gaps": (False, 1.0),
+    # online-learning bridge (ISSUE 20, kind=serve_train): eval-return
+    # improvement of the served policy over the run (the whole point of the
+    # loop — gated with its own floor below), and experience shed to
+    # backpressure/hook failure (counted, never silent; slack 1 because a
+    # deliberate ring-full drill window sheds by design)
+    "eval_return_delta": (True, 0.0),
+    "shed_experience": (False, 1.0),
 }
 
 # (cell-key glob, metric, absolute lower bound). Floors are enforced on the
@@ -112,6 +119,10 @@ METRIC_FLOORS: Tuple[Tuple[str, str, float], ...] = (
     # env-steps/s across its scenario instances — on every backend, CPU
     # included (the bar was set on a single-core CPU host).
     ("train:ppo:scenario_sweep:*", "sps_env", 100_000.0),
+    # The ISSUE-20 bar: a serve_train run must IMPROVE the served policy —
+    # eval return (mean feedback reward on a fixed eval set) strictly better
+    # at the end than at boot, on every backend, even on a first record.
+    ("serve_train:*", "eval_return_delta", 0.5),
 )
 
 
@@ -225,6 +236,11 @@ def record_metrics(rec: Dict[str, Any]) -> Dict[str, float]:
     goodput = slo_goodput(stats)
     if goodput is not None:
         out["qps@p95"] = goodput
+    online = rec.get("online")
+    if isinstance(online, dict):
+        for name in ("eval_return_delta", "shed_experience"):
+            if isinstance(online.get(name), (int, float)):
+                out[name] = float(online[name])
     net = rec.get("net")
     if isinstance(net, dict) and isinstance(net.get("transports"), dict):
         sums: Dict[str, float] = {}
@@ -497,6 +513,25 @@ def self_test() -> int:
         sweep_rec(3, 240000.0),
         sweep_rec(1, 60000.0, backend="fake"),
     ]
+
+    # ISSUE-20 serve_train cells: the online-learning loop gets its OWN kind
+    # (never pooled with plain serve cells) and carries the absolute
+    # eval-improvement floor — a run that fails to improve the served policy
+    # regresses even with no history
+    def st_rec(t, delta, env="linear_feedback"):
+        r = rec(t, "linear", None, env=env, variant="bridge")
+        r.pop("sps_env")
+        r["kind"] = "serve_train"
+        r["online"] = {"eval_return_delta": delta, "shed_experience": 0}
+        r["serve_stats"] = {"qps": 300.0, "p95_ms": 30.0, "slo_ms": 100.0}
+        return r
+
+    records += [
+        st_rec(1, 4.2),
+        st_rec(2, 4.6),
+        st_rec(3, 4.4),
+        st_rec(1, 0.1, env="linear_feedback_flat"),
+    ]
     doc = evaluate(records)
     got = {}
     for key, cell in doc["cells"].items():
@@ -547,6 +582,23 @@ def self_test() -> int:
     sweep_low = doc["cells"].get("train:ppo:scenario_sweep:fakex1p1:fused_scenarios")
     if sweep_low is None or sweep_low["verdict"] != "regress":
         failures.append(f"scenario_sweep floor: a 60k cell must regress even with no history, got {sweep_low}")
+    st_cell = doc["cells"].get("serve_train:linear:linear_feedback:cpux1p1:bridge")
+    if (
+        st_cell is None
+        or st_cell["verdict"] != "pass"
+        or st_cell["metrics"]["eval_return_delta"].get("floor") != 0.5
+        or "shed_experience" not in st_cell["metrics"]
+        or "qps@p95" not in st_cell["metrics"]
+    ):
+        failures.append(
+            f"serve_train cell: want own-kind cell flooring eval_return_delta and "
+            f"gating shed/goodput, got {st_cell}"
+        )
+    st_flat = doc["cells"].get("serve_train:linear:linear_feedback_flat:cpux1p1:bridge")
+    if st_flat is None or st_flat["verdict"] != "regress":
+        failures.append(
+            f"serve_train floor: a no-improvement run must regress even with no history, got {st_flat}"
+        )
     if slo_goodput({"qps": 900.0, "p95_ms": 250.0, "slo_ms": 100.0}) != 0.0:
         failures.append("qps@p95: an SLO miss must zero the goodput")
     if slo_goodput({"load_report": {"mode": "ramp", "max_good_qps": 123.0}}) != 123.0:
@@ -558,6 +610,7 @@ def self_test() -> int:
         for r in records
         if r["algo"] != "sac"
         and r.get("env") != "mfu_probe_xl"
+        and r.get("env") != "linear_feedback_flat"
         and not (r.get("env") == "scenario_sweep" and r.get("backend") == "fake")
     ]
     if exit_code(evaluate(healthy)) != 0:
